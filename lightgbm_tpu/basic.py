@@ -1,0 +1,452 @@
+"""Public ``Dataset`` and ``Booster`` classes.
+
+TPU-native re-implementation of the reference Python API surface
+(python-package/lightgbm/basic.py: Dataset:1747, Booster:3567) — same
+signatures and semantics, but backed directly by the JAX engine instead of a
+ctypes C API.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .config import Config
+from .dataset import BinnedDataset
+from .models.boosting import GBDT, create_boosting
+from .models.objective import create_objective
+from .models.tree import Tree
+from .utils import log
+from .utils.log import LightGBMError
+
+__all__ = ["Dataset", "Booster", "LightGBMError"]
+
+
+def _to_matrix(data) -> np.ndarray:
+    if isinstance(data, np.ndarray):
+        return data
+    if hasattr(data, "values"):  # pandas DataFrame
+        return np.asarray(data.values, dtype=np.float64)
+    if hasattr(data, "toarray"):  # scipy sparse
+        return np.asarray(data.toarray(), dtype=np.float64)
+    return np.asarray(data, dtype=np.float64)
+
+
+class Dataset:
+    """Training data wrapper (reference: basic.py Dataset:1747).
+
+    Construction is lazy like the reference: binning happens on first use
+    (``construct``), so parameters from ``train()`` can still apply.
+    """
+
+    def __init__(self, data, label=None, reference: Optional["Dataset"] = None,
+                 weight=None, group=None, init_score=None,
+                 feature_name: Union[str, List[str]] = "auto",
+                 categorical_feature: Union[str, List] = "auto",
+                 params: Optional[Dict[str, Any]] = None,
+                 free_raw_data: bool = True, position=None):
+        self.data = data
+        self.label = label
+        self.reference = reference
+        self.weight = weight
+        self.group = group
+        self.init_score = init_score
+        self.feature_name = feature_name
+        self.categorical_feature = categorical_feature
+        self.params = dict(params) if params else {}
+        self.free_raw_data = free_raw_data
+        self._inner: Optional[BinnedDataset] = None
+        self.used_indices: Optional[np.ndarray] = None
+
+    # ------------------------------------------------------------------
+    def construct(self, extra_params: Optional[Dict[str, Any]] = None) -> "Dataset":
+        if self._inner is not None:
+            return self
+        params = dict(self.params)
+        if extra_params:
+            merged = dict(extra_params)
+            merged.update(params)
+            params = merged
+        cfg = Config(params)
+        mat = _to_matrix(self.data)
+        feature_names = None
+        if isinstance(self.feature_name, list):
+            feature_names = list(self.feature_name)
+        elif hasattr(self.data, "columns"):
+            feature_names = [str(c) for c in self.data.columns]
+        cats: List[int] = []
+        if isinstance(self.categorical_feature, (list, tuple)):
+            for c in self.categorical_feature:
+                if isinstance(c, str) and feature_names and c in feature_names:
+                    cats.append(feature_names.index(c))
+                elif isinstance(c, int):
+                    cats.append(c)
+        elif cfg.categorical_feature:
+            cats = [int(x) for x in str(cfg.categorical_feature).split(",")
+                    if x.strip().lstrip("-").isdigit()]
+        ref_inner = None
+        if self.reference is not None:
+            self.reference.construct(extra_params)
+            ref_inner = self.reference._inner
+        self._inner = BinnedDataset.from_matrix(
+            mat, cfg, label=self.label, weight=self.weight, group=self.group,
+            init_score=self.init_score, feature_names=feature_names,
+            categorical_features=cats, reference=ref_inner)
+        self._raw_mat = None if self.free_raw_data else mat
+        return self
+
+    # ------------------------------------------------------------------
+    def set_label(self, label) -> "Dataset":
+        self.label = label
+        if self._inner is not None and label is not None:
+            self._inner.metadata.set_label(label)
+        return self
+
+    def set_weight(self, weight) -> "Dataset":
+        self.weight = weight
+        if self._inner is not None:
+            self._inner.metadata.set_weight(weight)
+        return self
+
+    def set_group(self, group) -> "Dataset":
+        self.group = group
+        if self._inner is not None:
+            self._inner.metadata.set_group(group)
+        return self
+
+    def set_init_score(self, init_score) -> "Dataset":
+        self.init_score = init_score
+        if self._inner is not None:
+            self._inner.metadata.set_init_score(init_score)
+        return self
+
+    def get_label(self):
+        if self._inner is not None and self._inner.metadata.label is not None:
+            return np.asarray(self._inner.metadata.label)
+        return np.asarray(self.label) if self.label is not None else None
+
+    def get_weight(self):
+        return self.weight
+
+    def get_group(self):
+        return self.group
+
+    def num_data(self) -> int:
+        if self._inner is not None:
+            return self._inner.num_data
+        return _to_matrix(self.data).shape[0]
+
+    def num_feature(self) -> int:
+        if self._inner is not None:
+            return self._inner.num_total_features
+        return _to_matrix(self.data).shape[1]
+
+    def get_feature_name(self) -> List[str]:
+        self.construct()
+        return list(self._inner.feature_names)
+
+    def subset(self, used_indices, params=None) -> "Dataset":
+        idx = np.asarray(used_indices)
+        mat = _to_matrix(self.data)[idx]
+        group = None
+        if self.group is not None:
+            # expand query sizes to per-row qids, slice, re-run-length encode
+            # (valid when the subset keeps whole queries, as cv() does)
+            sizes = np.asarray(self.group, dtype=np.int64)
+            qid = np.repeat(np.arange(len(sizes)), sizes)[idx]
+            _, group = np.unique(qid, return_counts=True)
+        init_score = None
+        if self.init_score is not None:
+            init_score = np.asarray(self.init_score)[idx]
+        sub = Dataset(
+            mat,
+            label=None if self.label is None else np.asarray(self.label)[idx],
+            weight=None if self.weight is None else np.asarray(self.weight)[idx],
+            group=group, init_score=init_score,
+            feature_name=self.feature_name,
+            categorical_feature=self.categorical_feature,
+            params=params or self.params)
+        sub.used_indices = idx
+        return sub
+
+    def create_valid(self, data, label=None, weight=None, group=None,
+                     init_score=None, params=None) -> "Dataset":
+        return Dataset(data, label=label, reference=self, weight=weight,
+                       group=group, init_score=init_score,
+                       params=params or self.params)
+
+    def save_binary(self, filename: str) -> "Dataset":
+        import pickle
+        self.construct()
+        with open(filename, "wb") as fh:
+            pickle.dump(self._inner, fh)
+        return self
+
+
+class Booster:
+    """Booster (reference: basic.py Booster:3567)."""
+
+    def __init__(self, params: Optional[Dict[str, Any]] = None,
+                 train_set: Optional[Dataset] = None,
+                 model_file: Optional[str] = None,
+                 model_str: Optional[str] = None):
+        params = params or {}
+        self.params = dict(params)
+        self.config = Config(params)
+        self._gbdt: Optional[GBDT] = None
+        self.train_set = train_set
+        self.best_iteration = -1
+        self.best_score: Dict[str, Dict[str, float]] = {}
+        self._valid_names: List[str] = []
+
+        if train_set is not None:
+            train_set.construct(self.params)
+            objective = create_objective(self.config)
+            self._gbdt = create_boosting(self.config, train_set._inner, objective)
+            self._objective = objective
+        elif model_file is not None:
+            with open(model_file) as fh:
+                self._load_model_string(fh.read())
+        elif model_str is not None:
+            self._load_model_string(model_str)
+        else:
+            log.fatal("Booster requires train_set, model_file or model_str")
+
+    # ------------------------------------------------------------------
+    def add_valid(self, data: Dataset, name: str) -> "Booster":
+        data.construct(self.params)
+        self._gbdt.add_valid_data(data._inner)
+        self._valid_names.append(name)
+        return self
+
+    def update(self, train_set: Optional[Dataset] = None, fobj=None) -> bool:
+        """One boosting iteration; returns True if no further splits were possible
+        (reference: basic.py Booster.update:4073)."""
+        if fobj is not None:
+            score = self._gbdt.scores
+            grad, hess = fobj(np.asarray(score), self.train_set)
+            return self.__boost(grad, hess)
+        return self._gbdt.train_one_iter()
+
+    def __boost(self, grad, hess) -> bool:
+        return self._gbdt.train_one_iter(np.asarray(grad, dtype=np.float32),
+                                         np.asarray(hess, dtype=np.float32))
+
+    def boost(self, grad, hess) -> bool:
+        return self.__boost(grad, hess)
+
+    def rollback_one_iter(self) -> "Booster":
+        self._gbdt.rollback_one_iter()
+        return self
+
+    @property
+    def current_iteration(self) -> int:
+        return self._gbdt.current_iteration
+
+    def num_trees(self) -> int:
+        return self._gbdt.num_trees()
+
+    def num_model_per_iteration(self) -> int:
+        return self._gbdt.num_tree_per_iteration
+
+    def num_feature(self) -> int:
+        return self._gbdt.max_feature_idx + 1
+
+    # ------------------------------------------------------------------
+    def eval_train(self, feval=None):
+        results = []
+        for name, val, is_max in self._gbdt.eval_train():
+            results.append(("training", name, val, is_max))
+        if feval is not None:
+            results.extend(self._custom_eval(feval, "training", train=True))
+        return results
+
+    def eval_valid(self, feval=None):
+        results = []
+        for vi, vname in enumerate(self._valid_names):
+            for name, val, is_max in self._gbdt.eval_valid(vi):
+                results.append((vname, name, val, is_max))
+            if feval is not None:
+                results.extend(self._custom_eval(feval, vname, valid_index=vi))
+        return results
+
+    def _custom_eval(self, feval, dataset_name, train=False, valid_index=0):
+        fevals = feval if isinstance(feval, list) else [feval]
+        out = []
+        if train:
+            score = np.asarray(self._gbdt.scores)
+            dataset = self.train_set
+        else:
+            score = np.asarray(self._gbdt.valid_scores[valid_index])
+            dataset = None
+        for f in fevals:
+            res = f(score, dataset)
+            if isinstance(res, tuple):
+                res = [res]
+            for name, val, is_max in res:
+                out.append((dataset_name, name, val, is_max))
+        return out
+
+    # ------------------------------------------------------------------
+    def predict(self, data, start_iteration: int = 0,
+                num_iteration: Optional[int] = None,
+                raw_score: bool = False, pred_leaf: bool = False,
+                pred_contrib: bool = False, **kwargs) -> np.ndarray:
+        if num_iteration is None:
+            # after early stopping, default to the best iteration
+            # (reference: basic.py Booster.predict)
+            num_iteration = self.best_iteration if self.best_iteration > 0 else -1
+        elif num_iteration == 0:
+            num_iteration = -1
+        mat = _to_matrix(data)
+        if pred_leaf:
+            return self._gbdt.predict_leaf_index(mat)
+        if pred_contrib:
+            return self.predict_contrib(mat, start_iteration, num_iteration)
+        return self._gbdt.predict(mat, raw_score=raw_score,
+                                  start_iteration=start_iteration,
+                                  num_iteration=num_iteration)
+
+    def predict_contrib(self, data, start_iteration=0, num_iteration=-1):
+        """SHAP feature contributions via per-tree path attribution
+        (reference: tree.h PredictContrib / TreeSHAP)."""
+        from .models.shap import predict_contrib
+        return predict_contrib(self._gbdt, np.asarray(data, dtype=np.float64),
+                               start_iteration, num_iteration)
+
+    # ------------------------------------------------------------------
+    def model_to_string(self, num_iteration: int = -1,
+                        start_iteration: int = 0,
+                        importance_type: str = "split") -> str:
+        """reference: GBDT::SaveModelToString (gbdt_model_text.cpp:280-430)."""
+        g = self._gbdt
+        cfg = self.config
+        K = g.num_tree_per_iteration
+        lines = ["tree"]
+        lines.append("version=v4")
+        lines.append(f"num_class={g.num_class}")
+        lines.append(f"num_tree_per_iteration={K}")
+        lines.append(f"label_index={g.label_idx}")
+        lines.append(f"max_feature_idx={g.max_feature_idx}")
+        obj = g.objective
+        if obj is not None:
+            lines.append(f"objective={obj.to_string()}")
+        if g.average_output:
+            lines.append("average_output")
+        lines.append("feature_names=" + " ".join(g.feature_names))
+        infos = []
+        if g.train_data is not None:
+            for bm in g.train_data.bin_mappers:
+                infos.append(bm.feature_info())
+        lines.append("feature_infos=" + " ".join(infos))
+        total = len(g.models)
+        end = total if num_iteration < 0 else min(total, (start_iteration + num_iteration) * K)
+        tree_strs = [g.models[i].to_string(i - start_iteration * K)
+                     for i in range(start_iteration * K, end)]
+        tree_sizes = [len(s) + 1 for s in tree_strs]
+        lines.append("tree_sizes=" + " ".join(str(s) for s in tree_sizes))
+        lines.append("")
+        body = "\n".join(lines) + "\n"
+        body += "\n".join(tree_strs)
+        body += "end of trees\n"
+        imp = self.feature_importance(importance_type="split")
+        pairs = [(imp[i], g.feature_names[i]) for i in range(len(imp)) if imp[i] > 0]
+        pairs.sort(key=lambda x: -x[0])
+        body += "\nfeature_importances:\n"
+        for v, n in pairs:
+            body += f"{n}={int(v)}\n"
+        body += "\nparameters:\n" + self.config.save_to_string() + "\nend of parameters\n"
+        return body
+
+    def save_model(self, filename: str, num_iteration: int = -1,
+                   start_iteration: int = 0,
+                   importance_type: str = "split") -> "Booster":
+        with open(filename, "w") as fh:
+            fh.write(self.model_to_string(num_iteration, start_iteration,
+                                          importance_type))
+        return self
+
+    def _load_model_string(self, text: str) -> None:
+        """reference: GBDT::LoadModelFromString (gbdt_model_text.cpp:430-560)."""
+        header: Dict[str, str] = {}
+        lines = text.split("\n")
+        i = 0
+        while i < len(lines):
+            line = lines[i].strip()
+            if line.startswith("Tree="):
+                break
+            if "=" in line:
+                k, v = line.split("=", 1)
+                header[k.strip()] = v.strip()
+            elif line == "average_output":
+                header["average_output"] = "1"
+            i += 1
+        self.config = Config({"objective": header.get("objective", "regression").split(" ")[0],
+                              "num_class": int(header.get("num_class", 1))})
+        objective = create_objective(self.config)
+        self._gbdt = GBDT(self.config, None, objective)
+        self._objective = objective
+        g = self._gbdt
+        g.num_tree_per_iteration = int(header.get("num_tree_per_iteration", 1))
+        g.num_class = int(header.get("num_class", 1))
+        g.label_idx = int(header.get("label_index", 0))
+        g.max_feature_idx = int(header.get("max_feature_idx", 0))
+        g.feature_names = header.get("feature_names", "").split()
+        g.average_output = "average_output" in header
+        # parse trees
+        blocks = text.split("Tree=")[1:]
+        for blk in blocks:
+            body = blk.split("end of trees")[0]
+            g.models.append(Tree.from_string("Tree=" + body))
+
+    def dump_model(self, num_iteration: int = -1, start_iteration: int = 0) -> dict:
+        """reference: GBDT::DumpModel (gbdt_model_text.cpp:23-120)."""
+        g = self._gbdt
+        K = g.num_tree_per_iteration
+        total = len(g.models)
+        end = total if num_iteration < 0 else min(total, (start_iteration + num_iteration) * K)
+        return {
+            "name": "tree",
+            "version": "v4",
+            "num_class": g.num_class,
+            "num_tree_per_iteration": K,
+            "label_index": g.label_idx,
+            "max_feature_idx": g.max_feature_idx,
+            "objective": g.objective.to_string() if g.objective else "none",
+            "average_output": g.average_output,
+            "feature_names": list(g.feature_names),
+            "tree_info": [dict(tree_index=i, **g.models[i].to_json())
+                          for i in range(start_iteration * K, end)],
+        }
+
+    # ------------------------------------------------------------------
+    def feature_importance(self, importance_type: str = "split",
+                           iteration: Optional[int] = None) -> np.ndarray:
+        """reference: GBDT::FeatureImportance (gbdt.cpp)."""
+        n = self._gbdt.max_feature_idx + 1
+        imp = np.zeros(n, dtype=np.float64)
+        for tree in self._gbdt.models:
+            for node in range(tree.num_nodes()):
+                f = int(tree.split_feature[node])
+                if f < n:
+                    if importance_type == "split":
+                        imp[f] += 1
+                    else:
+                        imp[f] += max(tree.split_gain[node], 0.0)
+        return imp
+
+    def feature_name(self) -> List[str]:
+        return list(self._gbdt.feature_names)
+
+    def free_dataset(self) -> "Booster":
+        return self
+
+    def reset_parameter(self, params: Dict[str, Any]) -> "Booster":
+        self.params.update(params)
+        self.config = Config(self.params)
+        if self._gbdt is not None:
+            self._gbdt.config = self.config
+            self._gbdt.shrinkage_rate = float(self.config.learning_rate)
+        return self

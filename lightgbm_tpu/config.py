@@ -1,0 +1,444 @@
+"""Parameter system.
+
+TPU-native re-implementation of the reference parameter schema
+(include/LightGBM/config.h, src/io/config.cpp, src/io/config_auto.cpp):
+the same parameter names, aliases, defaults and validation rules, but held in a
+single table-driven Python ``Config`` instead of a generated C++ struct.
+
+The alias table and defaults follow `config_auto.cpp` (GetMembersFromString
+/ parameter2aliases); the derived-flag logic follows `Config::Set`
+(src/io/config.cpp).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+from .utils import log
+
+_NO_DEFAULT = object()
+
+
+@dataclass
+class _Param:
+    name: str
+    default: Any
+    typ: type
+    aliases: Tuple[str, ...] = ()
+    check: Optional[str] = None  # e.g. ">=0.0", ">0", "0.0<=x<=1.0"
+
+
+def _p(name, default, typ, aliases=(), check=None):
+    return _Param(name, default, typ, tuple(aliases), check)
+
+
+# ---------------------------------------------------------------------------
+# Parameter table — mirrors config.h sections: Core / Learning control / IO /
+# Objective / Metric / Network / Device.  (reference: include/LightGBM/config.h)
+# ---------------------------------------------------------------------------
+_PARAMS: List[_Param] = [
+    # --- Core ---
+    _p("config", "", str, ("config_file",)),
+    _p("task", "train", str, ("task_type",)),
+    _p("objective", "regression", str,
+       ("objective_type", "app", "application", "loss")),
+    _p("boosting", "gbdt", str, ("boosting_type", "boost")),
+    _p("data_sample_strategy", "bagging", str),
+    _p("data", "", str, ("train", "train_data", "train_data_file", "data_filename")),
+    _p("valid", "", str, ("test", "valid_data", "valid_data_file", "test_data",
+                          "test_data_file", "valid_filenames")),
+    _p("num_iterations", 100, int,
+       ("num_iteration", "n_iter", "num_tree", "num_trees", "num_round",
+        "num_rounds", "nrounds", "num_boost_round", "n_estimators", "max_iter"),
+       ">=0"),
+    _p("learning_rate", 0.1, float, ("shrinkage_rate", "eta"), ">0.0"),
+    _p("num_leaves", 31, int,
+       ("num_leaf", "max_leaves", "max_leaf", "max_leaf_nodes"), ">1"),
+    _p("tree_learner", "serial", str,
+       ("tree", "tree_type", "tree_learner_type")),
+    _p("num_threads", 0, int,
+       ("num_thread", "nthread", "nthreads", "n_jobs")),
+    _p("device_type", "tpu", str, ("device",)),
+    _p("seed", None, int, ("random_seed", "random_state")),
+    _p("deterministic", False, bool),
+    # --- Learning control ---
+    _p("force_col_wise", False, bool),
+    _p("force_row_wise", False, bool),
+    _p("histogram_pool_size", -1.0, float, ("hist_pool_size",)),
+    _p("max_depth", -1, int),
+    _p("min_data_in_leaf", 20, int,
+       ("min_data_per_leaf", "min_data", "min_child_samples", "min_samples_leaf"),
+       ">=0"),
+    _p("min_sum_hessian_in_leaf", 1e-3, float,
+       ("min_sum_hessian_per_leaf", "min_sum_hessian", "min_hessian",
+        "min_child_weight"), ">=0.0"),
+    _p("bagging_fraction", 1.0, float,
+       ("sub_row", "subsample", "bagging"), "0.0<x<=1.0"),
+    _p("pos_bagging_fraction", 1.0, float,
+       ("pos_sub_row", "pos_subsample", "pos_bagging"), "0.0<x<=1.0"),
+    _p("neg_bagging_fraction", 1.0, float,
+       ("neg_sub_row", "neg_subsample", "neg_bagging"), "0.0<x<=1.0"),
+    _p("bagging_freq", 0, int, ("subsample_freq",)),
+    _p("bagging_seed", 3, int, ("bagging_fraction_seed",)),
+    _p("bagging_by_query", False, bool),
+    _p("feature_fraction", 1.0, float,
+       ("sub_feature", "colsample_bytree"), "0.0<x<=1.0"),
+    _p("feature_fraction_bynode", 1.0, float,
+       ("sub_feature_bynode", "colsample_bynode"), "0.0<x<=1.0"),
+    _p("feature_fraction_seed", 2, int),
+    _p("extra_trees", False, bool, ("extra_tree",)),
+    _p("extra_seed", 6, int),
+    _p("early_stopping_round", 0, int,
+       ("early_stopping_rounds", "early_stopping", "n_iter_no_change")),
+    _p("early_stopping_min_delta", 0.0, float, (), ">=0.0"),
+    _p("first_metric_only", False, bool),
+    _p("max_delta_step", 0.0, float, ("max_tree_output", "max_leaf_output")),
+    _p("lambda_l1", 0.0, float, ("reg_alpha", "l1_regularization"), ">=0.0"),
+    _p("lambda_l2", 0.0, float,
+       ("reg_lambda", "lambda", "l2_regularization"), ">=0.0"),
+    _p("linear_lambda", 0.0, float, (), ">=0.0"),
+    _p("min_gain_to_split", 0.0, float, ("min_split_gain",), ">=0.0"),
+    _p("drop_rate", 0.1, float, ("rate_drop",), "0.0<=x<=1.0"),
+    _p("max_drop", 50, int),
+    _p("skip_drop", 0.5, float, (), "0.0<=x<=1.0"),
+    _p("xgboost_dart_mode", False, bool),
+    _p("uniform_drop", False, bool),
+    _p("drop_seed", 4, int),
+    _p("top_rate", 0.2, float, (), "0.0<=x<=1.0"),
+    _p("other_rate", 0.1, float, (), "0.0<=x<=1.0"),
+    _p("min_data_per_group", 100, int, (), ">0"),
+    _p("max_cat_threshold", 32, int, (), ">0"),
+    _p("cat_l2", 10.0, float, (), ">=0.0"),
+    _p("cat_smooth", 10.0, float, (), ">=0.0"),
+    _p("max_cat_to_onehot", 4, int, (), ">0"),
+    _p("top_k", 20, int, ("topk",), ">0"),
+    _p("monotone_constraints", "", str, ("mc", "monotone_constraint")),
+    _p("monotone_constraints_method", "basic", str, ("monotone_constraining_method", "mc_method")),
+    _p("monotone_penalty", 0.0, float, ("monotone_splits_penalty", "ms_penalty", "mc_penalty"), ">=0.0"),
+    _p("feature_contri", "", str, ("feature_contrib", "fc", "fp", "feature_penalty")),
+    _p("forcedsplits_filename", "", str, ("fs", "forced_splits_filename", "forced_splits_file", "forced_splits")),
+    _p("refit_decay_rate", 0.9, float, (), "0.0<=x<=1.0"),
+    _p("cegb_tradeoff", 1.0, float, (), ">=0.0"),
+    _p("cegb_penalty_split", 0.0, float, (), ">=0.0"),
+    _p("cegb_penalty_feature_lazy", "", str),
+    _p("cegb_penalty_feature_coupled", "", str),
+    _p("path_smooth", 0.0, float, (), ">=0.0"),
+    _p("interaction_constraints", "", str),
+    _p("verbosity", 1, int, ("verbose",)),
+    _p("input_model", "", str, ("model_input", "model_in")),
+    _p("output_model", "LightGBM_model.txt", str,
+       ("model_output", "model_out")),
+    _p("saved_feature_importance_type", 0, int),
+    _p("snapshot_freq", -1, int, ("save_period",)),
+    _p("use_quantized_grad", False, bool),
+    _p("num_grad_quant_bins", 4, int),
+    _p("quant_train_renew_leaf", False, bool),
+    _p("stochastic_rounding", True, bool),
+    # --- IO / dataset ---
+    _p("linear_tree", False, bool, ("linear_trees",)),
+    _p("max_bin", 255, int, ("max_bins",), ">1"),
+    _p("max_bin_by_feature", "", str),
+    _p("min_data_in_bin", 3, int, (), ">0"),
+    _p("bin_construct_sample_cnt", 200000, int,
+       ("subsample_for_bin",), ">0"),
+    _p("data_random_seed", 1, int, ("data_seed",)),
+    _p("is_enable_sparse", True, bool,
+       ("is_sparse", "enable_sparse", "sparse")),
+    _p("enable_bundle", True, bool, ("is_enable_bundle", "bundle")),
+    _p("use_missing", True, bool),
+    _p("zero_as_missing", False, bool),
+    _p("feature_pre_filter", True, bool),
+    _p("pre_partition", False, bool, ("is_pre_partition",)),
+    _p("two_round", False, bool,
+       ("two_round_loading", "use_two_round_loading")),
+    _p("header", False, bool, ("has_header",)),
+    _p("label_column", "", str, ("label",)),
+    _p("weight_column", "", str, ("weight",)),
+    _p("group_column", "", str,
+       ("group", "group_id", "query_column", "query", "query_id")),
+    _p("ignore_column", "", str,
+       ("ignore_feature", "blacklist")),
+    _p("categorical_feature", "", str,
+       ("cat_feature", "categorical_column", "cat_column", "categorical_features")),
+    _p("forcedbins_filename", "", str),
+    _p("save_binary", False, bool, ("is_save_binary", "is_save_binary_file")),
+    _p("precise_float_parser", False, bool),
+    _p("parser_config_file", "", str),
+    # --- Predict ---
+    _p("start_iteration_predict", 0, int),
+    _p("num_iteration_predict", -1, int),
+    _p("predict_raw_score", False, bool,
+       ("is_predict_raw_score", "predict_rawscore", "raw_score")),
+    _p("predict_leaf_index", False, bool,
+       ("is_predict_leaf_index", "leaf_index")),
+    _p("predict_contrib", False, bool,
+       ("is_predict_contrib", "contrib")),
+    _p("predict_disable_shape_check", False, bool),
+    _p("pred_early_stop", False, bool),
+    _p("pred_early_stop_freq", 10, int),
+    _p("pred_early_stop_margin", 10.0, float),
+    _p("output_result", "LightGBM_predict_result.txt", str,
+       ("predict_result", "prediction_result", "predict_name",
+        "prediction_name", "pred_name", "name_pred")),
+    # --- Convert ---
+    _p("convert_model_language", "", str),
+    _p("convert_model", "gbdt_prediction.cpp", str,
+       ("convert_model_file",)),
+    # --- Objective ---
+    _p("objective_seed", 5, int),
+    _p("num_class", 1, int, ("num_classes",), ">0"),
+    _p("is_unbalance", False, bool,
+       ("unbalance", "unbalanced_sets")),
+    _p("scale_pos_weight", 1.0, float, (), ">0.0"),
+    _p("sigmoid", 1.0, float, (), ">0.0"),
+    _p("boost_from_average", True, bool),
+    _p("reg_sqrt", False, bool),
+    _p("alpha", 0.9, float, (), ">0.0"),
+    _p("fair_c", 1.0, float, (), ">0.0"),
+    _p("poisson_max_delta_step", 0.7, float, (), ">0.0"),
+    _p("tweedie_variance_power", 1.5, float, (), "1.0<=x<2.0"),
+    _p("lambdarank_truncation_level", 30, int, (), ">0"),
+    _p("lambdarank_norm", True, bool),
+    _p("label_gain", "", str),
+    _p("lambdarank_position_bias_regularization", 0.0, float, (), ">=0.0"),
+    # --- Metric ---
+    _p("metric", "", str, ("metrics", "metric_types")),
+    _p("metric_freq", 1, int, ("output_freq",), ">0"),
+    _p("is_provide_training_metric", False, bool,
+       ("training_metric", "is_training_metric", "train_metric")),
+    _p("eval_at", "1,2,3,4,5", str,
+       ("ndcg_eval_at", "ndcg_at", "map_eval_at", "map_at")),
+    _p("multi_error_top_k", 1, int, (), ">0"),
+    _p("auc_mu_weights", "", str),
+    # --- Network ---
+    _p("num_machines", 1, int, ("num_machine",), ">0"),
+    _p("local_listen_port", 12400, int, ("local_port", "port"), ">0"),
+    _p("time_out", 120, int, (), ">0"),
+    _p("machine_list_filename", "", str,
+       ("machine_list_file", "machine_list", "mlist")),
+    _p("machines", "", str, ("workers", "nodes")),
+    # --- Device ---
+    _p("gpu_platform_id", -1, int),
+    _p("gpu_device_id", -1, int),
+    _p("gpu_use_dp", False, bool),
+    _p("num_gpu", 1, int, (), ">0"),
+    # --- TPU-specific (new in this framework) ---
+    _p("tpu_hist_dtype", "float32", str),       # float32 | bfloat16_pair
+    _p("tpu_row_chunk", 8192, int, (), ">0"),   # rows per histogram matmul chunk
+    _p("tpu_feature_block", 64, int, (), ">0"),  # feature groups per histogram block
+    _p("tpu_min_bucket_log2", 10, int, (), ">=0"),  # smallest partition bucket
+    _p("tpu_donate_state", True, bool),
+]
+
+_PARAM_BY_NAME: Dict[str, _Param] = {p.name: p for p in _PARAMS}
+_ALIAS2NAME: Dict[str, str] = {}
+for _param in _PARAMS:
+    _ALIAS2NAME[_param.name] = _param.name
+    for _a in _param.aliases:
+        _ALIAS2NAME.setdefault(_a, _param.name)
+
+_OBJECTIVE_ALIASES = {
+    # objective-string aliases (reference: config.cpp ParseObjectiveAlias)
+    "regression": "regression", "regression_l2": "regression", "l2": "regression",
+    "mean_squared_error": "regression", "mse": "regression", "l2_root": "regression",
+    "root_mean_squared_error": "regression", "rmse": "regression",
+    "regression_l1": "regression_l1", "l1": "regression_l1",
+    "mean_absolute_error": "regression_l1", "mae": "regression_l1",
+    "multiclass": "multiclass", "softmax": "multiclass",
+    "multiclassova": "multiclassova", "multiclass_ova": "multiclassova",
+    "ova": "multiclassova", "ovr": "multiclassova",
+    "xentropy": "cross_entropy", "cross_entropy": "cross_entropy",
+    "xentlambda": "cross_entropy_lambda", "cross_entropy_lambda": "cross_entropy_lambda",
+    "mean_absolute_percentage_error": "mape", "mape": "mape",
+    "none": "none", "null": "none", "custom": "none", "na": "none",
+    "binary": "binary", "huber": "huber", "fair": "fair", "poisson": "poisson",
+    "quantile": "quantile", "gamma": "gamma", "tweedie": "tweedie",
+    "lambdarank": "lambdarank", "rank_xendcg": "rank_xendcg",
+    "xendcg": "rank_xendcg", "xe_ndcg": "rank_xendcg",
+    "xe_ndcg_mart": "rank_xendcg", "xendcg_mart": "rank_xendcg",
+}
+
+_METRIC_ALIASES = {
+    # reference: config.cpp ParseMetricAlias
+    "null": "", "none": "", "na": "custom",
+    "mean_squared_error": "l2", "mse": "l2", "regression_l2": "l2", "regression": "l2",
+    "l2_root": "rmse", "root_mean_squared_error": "rmse",
+    "mean_absolute_error": "l1", "mae": "l1", "regression_l1": "l1",
+    "binary_logloss": "binary_logloss", "binary": "binary_logloss",
+    "ndcg": "ndcg", "lambdarank": "ndcg", "rank_xendcg": "ndcg",
+    "xendcg": "ndcg", "xe_ndcg": "ndcg", "xe_ndcg_mart": "ndcg", "xendcg_mart": "ndcg",
+    "mean_average_precision": "map",
+    "multiclass": "multi_logloss", "softmax": "multi_logloss",
+    "multiclassova": "multi_logloss", "multiclass_ova": "multi_logloss",
+    "ova": "multi_logloss", "ovr": "multi_logloss",
+    "xentropy": "xentropy", "cross_entropy": "xentropy",
+    "xentlambda": "xentlambda", "cross_entropy_lambda": "xentlambda",
+    "kldiv": "kullback_leibler", "kullback_leibler": "kullback_leibler",
+    "mean_absolute_percentage_error": "mape", "mape": "mape",
+}
+
+
+def _coerce(param: _Param, value: Any) -> Any:
+    if param.typ is bool:
+        if isinstance(value, bool):
+            return value
+        if isinstance(value, (int, float)):
+            return bool(value)
+        s = str(value).strip().lower()
+        if s in ("true", "1", "+", "yes", "on"):
+            return True
+        if s in ("false", "0", "-", "no", "off"):
+            return False
+        log.fatal("Invalid boolean value %s for parameter %s", value, param.name)
+    if param.typ is int:
+        if value is None:
+            return None
+        return int(float(value))
+    if param.typ is float:
+        return float(value)
+    return str(value)
+
+
+def _check_value(param: _Param, v: Any) -> None:
+    if param.check is None or v is None or param.typ is str:
+        return
+    c = param.check
+    ok = True
+    if "<=x<" in c or "<x<=" in c or "<=x<=" in c or "<x<" in c:
+        import re
+        m = re.match(r"([-\d.eE+]+)(<=|<)x(<=|<)([-\d.eE+]+)", c)
+        lo, lop, hip, hi = float(m.group(1)), m.group(2), m.group(3), float(m.group(4))
+        ok = (lo <= v if lop == "<=" else lo < v) and (v <= hi if hip == "<=" else v < hi)
+    elif c.startswith(">="):
+        ok = v >= float(c[2:])
+    elif c.startswith(">"):
+        ok = v > float(c[1:])
+    elif c.startswith("<="):
+        ok = v <= float(c[2:])
+    elif c.startswith("<"):
+        ok = v < float(c[1:])
+    if not ok:
+        log.fatal("Parameter %s should satisfy %s, got %s", param.name, c, v)
+
+
+class Config:
+    """Resolved training configuration (reference: include/LightGBM/config.h)."""
+
+    def __init__(self, params: Optional[Dict[str, Any]] = None, **kwargs):
+        merged: Dict[str, Any] = {}
+        if params:
+            merged.update(params)
+        merged.update(kwargs)
+        self._raw = dict(merged)
+        # canonicalize aliases; earlier (canonical) names win on conflict, like
+        # the reference KeyAliasTransform keeping the first-priority alias.
+        resolved: Dict[str, Any] = {}
+        self._unknown: Dict[str, Any] = {}
+        for key, value in merged.items():
+            k = str(key).strip().lower().replace("-", "_")
+            name = _ALIAS2NAME.get(k)
+            if name is None:
+                self._unknown[k] = value
+                continue
+            if name in resolved and k != name:
+                continue  # canonical key already set; alias loses
+            resolved[name] = value
+        for p in _PARAMS:
+            if p.name in resolved and resolved[p.name] is not None:
+                v = _coerce(p, resolved[p.name])
+                _check_value(p, v)
+                setattr(self, p.name, v)
+            else:
+                setattr(self, p.name, p.default)
+        self._post_process()
+
+    # -- derived state (reference: Config::Set, src/io/config.cpp) --
+    def _post_process(self) -> None:
+        self.objective = _OBJECTIVE_ALIASES.get(
+            str(self.objective).lower(), str(self.objective).lower())
+        # boosting aliases; "goss" boosting folds into gbdt + goss strategy
+        b = str(self.boosting).lower()
+        b = {"gbrt": "gbdt", "gbm": "gbdt", "random_forest": "rf"}.get(b, b)
+        if b == "goss":
+            b = "gbdt"
+            self.data_sample_strategy = "goss"
+        self.boosting = b
+        if self.seed is not None:
+            # reference: config.cpp uses seed to derive the other seeds
+            base = int(self.seed)
+            self.data_random_seed = base + 1
+            self.bagging_seed = base + 3
+            self.drop_seed = base + 4
+            self.feature_fraction_seed = base + 2
+            self.extra_seed = base + 6
+            self.objective_seed = base + 5
+        else:
+            self.seed = 0
+        # metric list
+        raw_metrics = [m.strip().lower() for m in str(self.metric).split(",") if m.strip()]
+        self.metric_list: List[str] = []
+        for m in raw_metrics:
+            m = _METRIC_ALIASES.get(m, m)
+            if m and m not in self.metric_list:
+                self.metric_list.append(m)
+        self.eval_at_list = [int(x) for x in str(self.eval_at).split(",") if x.strip()]
+        # parallel flags (reference: config.cpp Config::Set)
+        tl = str(self.tree_learner).lower()
+        tl = {"serial": "serial", "feature": "feature", "feature_parallel": "feature",
+              "data": "data", "data_parallel": "data", "voting": "voting",
+              "voting_parallel": "voting"}.get(tl, tl)
+        self.tree_learner = tl
+        self.is_parallel = tl != "serial" and self.num_machines > 1
+        self.is_data_based_parallel = tl in ("data", "voting") and self.num_machines > 1
+        self.bagging_by_ = None
+        if self.verbosity is not None:
+            log.set_verbosity(self.verbosity)
+
+    # ------------------------------------------------------------------
+    def update(self, params: Dict[str, Any]) -> "Config":
+        raw = dict(self._raw)
+        raw.update(params)
+        return Config(raw)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {p.name: getattr(self, p.name) for p in _PARAMS}
+
+    def non_default_items(self) -> Dict[str, Any]:
+        out = {}
+        for p in _PARAMS:
+            v = getattr(self, p.name)
+            if v != p.default:
+                out[p.name] = v
+        return out
+
+    def save_to_string(self) -> str:
+        """Model-file `parameters:` section (reference: SaveMembersToString)."""
+        lines = []
+        for p in _PARAMS:
+            v = getattr(self, p.name)
+            if isinstance(v, bool):
+                v = int(v)
+            lines.append(f"[{p.name}: {v}]")
+        return "\n".join(lines)
+
+    @staticmethod
+    def canonical_name(key: str) -> Optional[str]:
+        return _ALIAS2NAME.get(str(key).strip().lower().replace("-", "_"))
+
+
+def parse_config_file(path: str) -> Dict[str, str]:
+    """Parse a `key=value` config file (reference: Application ctor KV2Map)."""
+    out: Dict[str, str] = {}
+    with open(path, "r") as fh:
+        for line in fh:
+            line = line.split("#", 1)[0].strip()
+            if not line or "=" not in line:
+                continue
+            k, v = line.split("=", 1)
+            out[k.strip()] = v.strip()
+    return out
+
+
+def param_alias_map() -> Dict[str, str]:
+    return dict(_ALIAS2NAME)

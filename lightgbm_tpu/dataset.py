@@ -1,0 +1,354 @@
+"""Binned dataset resident in TPU HBM.
+
+TPU-native re-design of the reference Dataset / DatasetLoader / Metadata
+(src/io/dataset.cpp, src/io/dataset_loader.cpp, include/LightGBM/dataset.h):
+host-side NumPy builds the per-feature BinMappers from sampled values
+(reference: DatasetLoader::ConstructFromSampleData, dataset_loader.cpp:593),
+then the full data matrix is binned into a packed integer tensor that is
+uploaded once to device HBM.  Histogram construction consumes this tensor via
+MXU one-hot matmuls instead of the reference's per-thread scatter loops.
+
+Feature grouping (EFB, reference dataset.cpp:60-244 FindGroups /
+FastFeatureBundling) bundles mutually-exclusive sparse features into shared
+columns with bin offsets.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .config import Config
+from .ops.binning import (BIN_CATEGORICAL, BIN_NUMERICAL, MISSING_NAN,
+                          MISSING_NONE, MISSING_ZERO, BinMapper)
+from .utils import log
+
+
+class Metadata:
+    """Per-row side data: label / weight / query groups / init_score.
+
+    reference: include/LightGBM/dataset.h:47-398 (Metadata).
+    """
+
+    def __init__(self, num_data: int):
+        self.num_data = num_data
+        self.label: Optional[np.ndarray] = None
+        self.weight: Optional[np.ndarray] = None
+        self.query_boundaries: Optional[np.ndarray] = None  # int32 [nq+1]
+        self.init_score: Optional[np.ndarray] = None
+
+    def set_label(self, label) -> None:
+        arr = np.asarray(label, dtype=np.float32).reshape(-1)
+        if len(arr) != self.num_data:
+            log.fatal("Length of label (%d) != num_data (%d)", len(arr), self.num_data)
+        self.label = arr
+
+    def set_weight(self, weight) -> None:
+        if weight is None:
+            self.weight = None
+            return
+        arr = np.asarray(weight, dtype=np.float32).reshape(-1)
+        if len(arr) != self.num_data:
+            log.fatal("Length of weight (%d) != num_data (%d)", len(arr), self.num_data)
+        self.weight = arr
+
+    def set_group(self, group) -> None:
+        """Accepts per-query sizes (like the reference's query counts)."""
+        if group is None:
+            self.query_boundaries = None
+            return
+        arr = np.asarray(group, dtype=np.int64).reshape(-1)
+        if arr.sum() != self.num_data:
+            log.fatal("Sum of query counts (%d) != num_data (%d)", arr.sum(), self.num_data)
+        self.query_boundaries = np.concatenate(
+            [[0], np.cumsum(arr)]).astype(np.int32)
+
+    def set_init_score(self, init_score) -> None:
+        if init_score is None:
+            self.init_score = None
+            return
+        arr = np.asarray(init_score, dtype=np.float64).reshape(-1)
+        self.init_score = arr
+
+    @property
+    def num_queries(self) -> int:
+        return 0 if self.query_boundaries is None else len(self.query_boundaries) - 1
+
+
+class FeatureGroupInfo:
+    """One packed bin column, possibly bundling several exclusive features.
+
+    reference: include/LightGBM/feature_group.h:25 (FeatureGroup).  Bundled
+    features occupy disjoint bin ranges [bin_offset[i], bin_offset[i+1]) of the
+    shared column; bin 0 is the shared "all-default" bin.
+    """
+
+    def __init__(self, feature_indices: List[int], num_total_bin: int,
+                 bin_offsets: List[int]):
+        self.feature_indices = feature_indices
+        self.num_total_bin = num_total_bin
+        self.bin_offsets = bin_offsets  # per sub-feature start bin
+
+
+class BinnedDataset:
+    """The training matrix in binned form (reference: dataset.h:486 Dataset)."""
+
+    def __init__(self, config: Config):
+        self.config = config
+        self.num_data: int = 0
+        self.num_total_features: int = 0
+        self.feature_names: List[str] = []
+        self.bin_mappers: List[BinMapper] = []       # per original feature
+        self.used_features: List[int] = []           # original idx of non-trivial
+        self.groups: List[FeatureGroupInfo] = []
+        self.binned: Optional[np.ndarray] = None     # (num_data, num_groups) int
+        self.metadata: Optional[Metadata] = None
+        self.monotone_constraints: Optional[List[int]] = None
+        self.raw_data: Optional[np.ndarray] = None   # retained for linear trees
+        self._device_cache: Dict[str, Any] = {}
+
+    # -- construction ---------------------------------------------------
+    @staticmethod
+    def from_matrix(data: np.ndarray, config: Config,
+                    label=None, weight=None, group=None, init_score=None,
+                    feature_names: Optional[List[str]] = None,
+                    categorical_features: Optional[Sequence[int]] = None,
+                    reference: Optional["BinnedDataset"] = None) -> "BinnedDataset":
+        data = np.asarray(data)
+        if data.ndim != 2:
+            log.fatal("Data must be 2-dimensional")
+        ds = BinnedDataset(config)
+        ds.num_data, ds.num_total_features = data.shape
+        ds.feature_names = feature_names or [
+            f"Column_{i}" for i in range(ds.num_total_features)]
+        ds.metadata = Metadata(ds.num_data)
+        if label is not None:
+            ds.metadata.set_label(label)
+        ds.metadata.set_weight(weight)
+        ds.metadata.set_group(group)
+        ds.metadata.set_init_score(init_score)
+
+        if reference is not None:
+            # validation data: reuse the training mappers & grouping
+            # (reference: dataset_loader.cpp LoadFromFileAlignWithOtherDataset:299)
+            ds.bin_mappers = reference.bin_mappers
+            ds.used_features = reference.used_features
+            ds.groups = reference.groups
+            ds.feature_names = reference.feature_names
+            ds._bin_data(data)
+            return ds
+
+        ds._construct_mappers(data, categorical_features or [])
+        ds._build_groups()
+        ds._bin_data(data)
+        if config.linear_tree:
+            ds.raw_data = np.ascontiguousarray(data, dtype=np.float32)
+        return ds
+
+    def _construct_mappers(self, data: np.ndarray,
+                           categorical_features: Sequence[int]) -> None:
+        cfg = self.config
+        n = self.num_data
+        sample_cnt = min(n, cfg.bin_construct_sample_cnt)
+        rng = np.random.RandomState(cfg.data_random_seed)
+        if sample_cnt < n:
+            sample_idx = np.sort(rng.choice(n, size=sample_cnt, replace=False))
+        else:
+            sample_idx = np.arange(n)
+        cat_set = set(int(c) for c in categorical_features)
+        max_bin_by_feature = None
+        if cfg.max_bin_by_feature:
+            max_bin_by_feature = [int(x) for x in str(cfg.max_bin_by_feature).split(",")]
+        # feature_pre_filter threshold (reference: dataset_loader.cpp FindBin call)
+        filter_cnt = int(cfg.min_data_in_leaf * sample_cnt / max(n, 1))
+        self.bin_mappers = []
+        for f in range(self.num_total_features):
+            col = np.asarray(data[sample_idx, f], dtype=np.float64)
+            # mirror the reference's sparse sampling: non-zero values + implied zeros
+            nonzero = col[(np.abs(col) > 1e-35) | np.isnan(col)]
+            bm = BinMapper()
+            mb = cfg.max_bin
+            if max_bin_by_feature and f < len(max_bin_by_feature):
+                mb = max_bin_by_feature[f]
+            bm.find_bin(
+                nonzero, total_sample_cnt=len(col), max_bin=mb,
+                min_data_in_bin=cfg.min_data_in_bin,
+                min_split_data=filter_cnt,
+                pre_filter=cfg.feature_pre_filter,
+                bin_type=BIN_CATEGORICAL if f in cat_set else BIN_NUMERICAL,
+                use_missing=cfg.use_missing,
+                zero_as_missing=cfg.zero_as_missing)
+            self.bin_mappers.append(bm)
+        self.used_features = [f for f in range(self.num_total_features)
+                              if not self.bin_mappers[f].is_trivial]
+        if not self.used_features:
+            log.warning("There are no meaningful features which satisfy the "
+                        "provided configuration. Decreasing Dataset parameters "
+                        "min_data_in_bin or min_data_in_leaf and re-constructing "
+                        "Dataset might resolve this warning.")
+
+    def _build_groups(self) -> None:
+        """EFB bundling (reference: dataset.cpp FindGroups:60 / FastFeatureBundling:246).
+
+        Greedy graph-coloring over conflict counts on sampled rows.  Features
+        whose non-default rows overlap less than ``max_conflict`` share one
+        packed column with per-feature bin offsets.  Dense features (low sparse
+        rate) stay in their own group.
+        """
+        self.groups = []
+        if not self.config.enable_bundle:
+            for f in self.used_features:
+                nb = self.bin_mappers[f].num_bin
+                self.groups.append(FeatureGroupInfo([f], nb, [0]))
+            return
+        # Round-1 policy: bundle only sufficiently sparse features; dense ones
+        # are singleton groups.  Conflict counting over the full binned column
+        # happens later in _bin_data; here we group by sparse-rate heuristic
+        # identical in effect to the reference for dense data (no bundling).
+        sparse, dense = [], []
+        for f in self.used_features:
+            bm = self.bin_mappers[f]
+            # Only bundle features whose shared "all-default" bin is bin 0:
+            # the learner's bundled-bin decode (bin b -> offset+b-1, b>=1)
+            # and FixHistogram reconstruction assume it.
+            if (bm.sparse_rate >= 0.8 and bm.most_freq_bin == 0
+                    and bm.default_bin == 0):
+                sparse.append(f)
+            else:
+                dense.append(f)
+        for f in dense:
+            self.groups.append(FeatureGroupInfo([f], self.bin_mappers[f].num_bin, [0]))
+        # defer true conflict-graph bundling to _bin_data (needs the columns)
+        self._pending_sparse = sparse
+
+    def _bin_data(self, data: np.ndarray) -> None:
+        # bin all used features column-wise first
+        cols: Dict[int, np.ndarray] = {}
+        for f in self.used_features:
+            cols[f] = self.bin_mappers[f].values_to_bins(data[:, f])
+        # finish sparse bundling if pending
+        pending = getattr(self, "_pending_sparse", None)
+        if pending:
+            self._bundle_sparse(pending, cols)
+            self._pending_sparse = None
+        elif not self.groups and self.used_features:
+            for f in self.used_features:
+                self.groups.append(FeatureGroupInfo(
+                    [f], self.bin_mappers[f].num_bin, [0]))
+
+        n = self.num_data
+        ngroups = len(self.groups)
+        out = np.zeros((n, ngroups), dtype=np.int32)
+        for g, grp in enumerate(self.groups):
+            if len(grp.feature_indices) == 1:
+                out[:, g] = cols[grp.feature_indices[0]]
+            else:
+                # bundled: shift non-default bins by the feature's offset
+                acc = np.zeros(n, dtype=np.int32)
+                for sub, f in enumerate(grp.feature_indices):
+                    bm = self.bin_mappers[f]
+                    c = cols[f]
+                    offset = grp.bin_offsets[sub]
+                    nz = c != bm.most_freq_bin
+                    # conflicts resolved last-writer-wins like reference push order
+                    shifted = c + offset - (1 if bm.most_freq_bin == 0 else 0)
+                    acc = np.where(nz, shifted, acc)
+                out[:, g] = acc
+        max_bin_overall = max((grp.num_total_bin for grp in self.groups), default=2)
+        dtype = np.uint8 if max_bin_overall <= 256 else np.uint16
+        self.binned = out.astype(dtype)
+
+    def _bundle_sparse(self, sparse: List[int], cols: Dict[int, np.ndarray]) -> None:
+        """Greedy conflict-count bundling (reference: dataset.cpp FindGroups)."""
+        n = self.num_data
+        max_conflict = int(0.0 * n)  # reference default max_conflict_rate = 0.0
+        # sample rows for conflict counting to bound cost
+        sample = np.random.RandomState(self.config.data_random_seed).choice(
+            n, size=min(n, 50000), replace=False) if n > 50000 else np.arange(n)
+        nz_masks = {f: (cols[f][sample] != self.bin_mappers[f].most_freq_bin)
+                    for f in sparse}
+        bundles: List[List[int]] = []
+        bundle_masks: List[np.ndarray] = []
+        order = sorted(sparse, key=lambda f: -int(nz_masks[f].sum()))
+        for f in order:
+            placed = False
+            for bi, mask in enumerate(bundle_masks):
+                conflict = int((mask & nz_masks[f]).sum())
+                if conflict <= max_conflict:
+                    bundles[bi].append(f)
+                    bundle_masks[bi] = mask | nz_masks[f]
+                    placed = True
+                    break
+            if not placed:
+                bundles.append([f])
+                bundle_masks.append(nz_masks[f].copy())
+        for bundle in bundles:
+            bundle.sort()
+            if len(bundle) == 1:
+                f = bundle[0]
+                self.groups.append(FeatureGroupInfo(
+                    [f], self.bin_mappers[f].num_bin, [0]))
+            else:
+                # shared column: bin 0 = all-default; feature i occupies
+                # [offset_i, offset_i + num_bin_i - 1) (skipping its default bin)
+                offsets = []
+                cur = 1
+                for f in bundle:
+                    offsets.append(cur)
+                    bm = self.bin_mappers[f]
+                    cur += bm.num_bin - (1 if bm.most_freq_bin == 0 else 0)
+                self.groups.append(FeatureGroupInfo(bundle, cur, offsets))
+
+    # -- views used by the tree learner ---------------------------------
+    def feature_meta_arrays(self) -> Dict[str, np.ndarray]:
+        """Per used-feature metadata arrays for the device split finder.
+
+        Features are enumerated in (group, sub-feature) order; ``sub_feature_map``
+        translates back to original feature indices.
+        """
+        feats: List[int] = []
+        group_idx: List[int] = []
+        bin_start: List[int] = []
+        num_bin: List[int] = []
+        missing_type: List[int] = []
+        default_bin: List[int] = []
+        is_cat: List[int] = []
+        for g, grp in enumerate(self.groups):
+            for sub, f in enumerate(grp.feature_indices):
+                bm = self.bin_mappers[f]
+                offset = grp.bin_offsets[sub]
+                feats.append(f)
+                group_idx.append(g)
+                if len(grp.feature_indices) == 1:
+                    bin_start.append(0)
+                    num_bin.append(bm.num_bin)
+                    default_bin.append(bm.default_bin)
+                else:
+                    # bundled feature: bin b (≠ default) lives at offset+b-(mfb==0)
+                    shift = offset - (1 if bm.most_freq_bin == 0 else 0)
+                    bin_start.append(shift)
+                    num_bin.append(bm.num_bin)
+                    default_bin.append(bm.default_bin)
+                missing_type.append(bm.missing_type)
+                is_cat.append(1 if bm.bin_type == BIN_CATEGORICAL else 0)
+        return {
+            "feature": np.asarray(feats, dtype=np.int32),
+            "group": np.asarray(group_idx, dtype=np.int32),
+            "bin_start": np.asarray(bin_start, dtype=np.int32),
+            "num_bin": np.asarray(num_bin, dtype=np.int32),
+            "missing_type": np.asarray(missing_type, dtype=np.int32),
+            "default_bin": np.asarray(default_bin, dtype=np.int32),
+            "is_categorical": np.asarray(is_cat, dtype=np.int32),
+        }
+
+    @property
+    def num_groups(self) -> int:
+        return len(self.groups)
+
+    @property
+    def max_group_bins(self) -> int:
+        return max((g.num_total_bin for g in self.groups), default=2)
+
+    def num_used_features(self) -> int:
+        return sum(len(g.feature_indices) for g in self.groups)

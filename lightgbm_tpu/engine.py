@@ -1,0 +1,209 @@
+"""Training entry points: ``train`` and ``cv``.
+
+TPU-native re-implementation of python-package/lightgbm/engine.py
+(train:66, cv:580, CVBooster:339) with the same signatures.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import numpy as np
+
+from . import callback as callback_mod
+from .basic import Booster, Dataset
+from .callback import EarlyStopException
+from .config import Config
+from .utils import log
+
+__all__ = ["train", "cv", "CVBooster"]
+
+
+def train(params: Dict[str, Any], train_set: Dataset,
+          num_boost_round: int = 100,
+          valid_sets: Optional[List[Dataset]] = None,
+          valid_names: Optional[List[str]] = None,
+          feval=None, init_model=None, keep_training_booster: bool = False,
+          callbacks: Optional[List[Callable]] = None) -> Booster:
+    """Train a booster (reference: engine.py train:66)."""
+    params = dict(params or {})
+    cfg = Config(params)
+    if "num_iterations" in {Config.canonical_name(k) for k in params}:
+        num_boost_round = cfg.num_iterations
+
+    booster = Booster(params=params, train_set=train_set)
+    if init_model is not None:
+        log.warning("init_model continuation is not yet implemented; "
+                    "starting fresh")
+
+    valid_contain_train = False
+    name_valid_sets = []
+    if valid_sets is not None:
+        if valid_names is None:
+            valid_names = [f"valid_{i}" for i in range(len(valid_sets))]
+        for i, vs in enumerate(valid_sets):
+            if vs is train_set:
+                valid_contain_train = True
+                name_valid_sets.append(valid_names[i] if valid_names else "training")
+                continue
+            vs.reference = train_set
+            booster.add_valid(vs, valid_names[i])
+    if valid_contain_train:
+        booster._gbdt.config = booster._gbdt.config.update(
+            {"is_provide_training_metric": True})
+
+    callbacks = list(callbacks) if callbacks else []
+    if cfg.early_stopping_round and cfg.early_stopping_round > 0:
+        callbacks.append(callback_mod.early_stopping(
+            cfg.early_stopping_round, cfg.first_metric_only,
+            verbose=cfg.verbosity >= 1, min_delta=cfg.early_stopping_min_delta))
+    callbacks_before = [cb for cb in callbacks
+                        if getattr(cb, "before_iteration", False)]
+    callbacks_after = [cb for cb in callbacks
+                       if not getattr(cb, "before_iteration", False)]
+    callbacks_before.sort(key=lambda cb: getattr(cb, "order", 0))
+    callbacks_after.sort(key=lambda cb: getattr(cb, "order", 0))
+
+    booster.best_iteration = -1
+    train_data_name = "training"
+    for i in range(num_boost_round):
+        for cb in callbacks_before:
+            cb(callback_mod.CallbackEnv(
+                model=booster, params=params, iteration=i,
+                begin_iteration=0, end_iteration=num_boost_round,
+                evaluation_result_list=None))
+        should_stop = booster.update()
+        evaluation_result_list = []
+        if valid_contain_train:
+            evaluation_result_list.extend(booster.eval_train(feval))
+        if booster._valid_names:
+            evaluation_result_list.extend(booster.eval_valid(feval))
+        try:
+            for cb in callbacks_after:
+                cb(callback_mod.CallbackEnv(
+                    model=booster, params=params, iteration=i,
+                    begin_iteration=0, end_iteration=num_boost_round,
+                    evaluation_result_list=evaluation_result_list))
+        except EarlyStopException as es:
+            booster.best_iteration = es.best_iteration + 1
+            for item in es.best_score:
+                booster.best_score.setdefault(item[0], {})[item[1]] = item[2]
+            break
+        if should_stop:
+            break
+    return booster
+
+
+class CVBooster:
+    """Ensemble of per-fold boosters (reference: engine.py CVBooster:339)."""
+
+    def __init__(self):
+        self.boosters: List[Booster] = []
+        self.best_iteration = -1
+
+    def append(self, booster: Booster) -> None:
+        self.boosters.append(booster)
+
+    def __getattr__(self, name):
+        def handler_function(*args, **kwargs):
+            return [getattr(b, name)(*args, **kwargs) for b in self.boosters]
+        return handler_function
+
+
+def _make_n_folds(full_data: Dataset, nfold: int, params, seed,
+                  stratified: bool, shuffle: bool):
+    full_data.construct(params)
+    num_data = full_data.num_data()
+    rng = np.random.RandomState(seed)
+    if stratified and full_data.get_label() is not None:
+        label = np.asarray(full_data.get_label())
+        folds = [[] for _ in range(nfold)]
+        for cls in np.unique(label):
+            idx = np.nonzero(label == cls)[0]
+            if shuffle:
+                rng.shuffle(idx)
+            for i, chunk in enumerate(np.array_split(idx, nfold)):
+                folds[i].extend(chunk.tolist())
+        test_indices = [np.asarray(sorted(f)) for f in folds]
+    else:
+        idx = np.arange(num_data)
+        if shuffle:
+            rng.shuffle(idx)
+        test_indices = [np.sort(c) for c in np.array_split(idx, nfold)]
+    for test_idx in test_indices:
+        train_idx = np.setdiff1d(np.arange(num_data), test_idx)
+        yield train_idx, test_idx
+
+
+def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
+       folds=None, nfold: int = 5, stratified: bool = True, shuffle: bool = True,
+       metrics=None, feval=None, init_model=None,
+       fpreproc=None, seed: int = 0, callbacks=None,
+       eval_train_metric: bool = False,
+       return_cvbooster: bool = False) -> Dict[str, Any]:
+    """Cross validation (reference: engine.py cv:580)."""
+    params = dict(params or {})
+    if metrics is not None:
+        params["metric"] = metrics
+    cfg = Config(params)
+    if "num_iterations" in {Config.canonical_name(k) for k in params}:
+        num_boost_round = cfg.num_iterations
+    if cfg.objective in ("lambdarank", "rank_xendcg") and stratified:
+        stratified = False
+
+    if folds is not None:
+        fold_iter = folds
+    else:
+        fold_iter = _make_n_folds(train_set, nfold, params, seed,
+                                  stratified and cfg.objective in (
+                                      "binary", "multiclass", "multiclassova"),
+                                  shuffle)
+
+    cvbooster = CVBooster()
+    fold_data = []
+    for train_idx, test_idx in fold_iter:
+        tr = train_set.subset(train_idx, params)
+        te = train_set.subset(test_idx, params)
+        te.reference = tr
+        bst = Booster(params=params, train_set=tr)
+        bst.add_valid(te, "valid")
+        cvbooster.append(bst)
+        fold_data.append((tr, te))
+
+    callbacks = list(callbacks) if callbacks else []
+    es_cb = None
+    if cfg.early_stopping_round and cfg.early_stopping_round > 0:
+        es_cb = cfg.early_stopping_round
+
+    results: Dict[str, List[float]] = {}
+    best_iter = num_boost_round
+    # per-metric early-stopping state (mirrors the early_stopping callback:
+    # stop when ANY tracked metric exceeds its patience)
+    best_mean: Dict[str, float] = {}
+    best_round: Dict[str, int] = {}
+    for i in range(num_boost_round):
+        all_evals: Dict[str, List[float]] = {}
+        for bst in cvbooster.boosters:
+            bst.update()
+            for dname, mname, val, is_max in bst.eval_valid():
+                all_evals.setdefault((mname, is_max), []).append(val)
+        stop_now = False
+        for (mname, is_max), vals in all_evals.items():
+            mean, std = float(np.mean(vals)), float(np.std(vals))
+            results.setdefault(f"valid {mname}-mean", []).append(mean)
+            results.setdefault(f"valid {mname}-stdv", []).append(std)
+            if es_cb is not None:
+                cur = mean if is_max else -mean
+                if mname not in best_mean or cur > best_mean[mname]:
+                    best_mean[mname] = cur
+                    best_round[mname] = i
+                elif i - best_round[mname] >= es_cb:
+                    stop_now = True
+                    best_iter = best_round[mname] + 1
+        if stop_now:
+            break
+    cvbooster.best_iteration = best_iter
+    if return_cvbooster:
+        results["cvbooster"] = cvbooster
+    return results

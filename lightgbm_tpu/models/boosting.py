@@ -1,0 +1,534 @@
+"""Boosting engines: GBDT, DART, RF, with bagging and GOSS sampling.
+
+TPU-native re-design of the reference boosting layer (src/boosting/gbdt.cpp,
+dart.hpp, rf.hpp, bagging.hpp, goss.hpp): the per-iteration loop
+(gbdt.cpp TrainOneIter:338-441) orchestrates device-resident state — scores,
+gradients, the binned dataset, and the tree learner's partition arrays all
+stay in HBM; the host only sequences iterations and pulls finished trees.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..dataset import BinnedDataset
+from ..ops.predict import predict_leaf_binned
+from ..utils import log
+from .learner import SerialTreeLearner
+from .metric import Metric, create_metrics
+from .objective import ObjectiveFunction
+from .tree import Tree, tree_from_device_record
+
+K_EPSILON = 1e-15
+
+
+class GBDT:
+    """Gradient Boosting Decision Tree engine (reference: src/boosting/gbdt.cpp)."""
+
+    def __init__(self, config: Config, train_data: Optional[BinnedDataset],
+                 objective: Optional[ObjectiveFunction]):
+        self.config = config
+        self.train_data = train_data
+        self.objective = objective
+        self.models: List[Tree] = []
+        self.device_trees: List[Dict[str, Any]] = []  # node arrays + leaf values
+        self.iter = 0
+        self.shrinkage_rate = float(config.learning_rate)
+        self.num_tree_per_iteration = (objective.num_model_per_iteration
+                                       if objective else max(config.num_class, 1))
+        self.num_class = max(config.num_class, 1)
+        self.average_output = False
+        self.init_scores = [0.0] * self.num_tree_per_iteration
+        self.max_feature_idx = 0
+        self.feature_names: List[str] = []
+        self.label_idx = 0
+        self.valid_sets: List[Tuple[BinnedDataset, List[Metric], jnp.ndarray]] = []
+        self.valid_scores: List[jnp.ndarray] = []
+        self.train_metrics: List[Metric] = []
+        self.best_iter: Dict[str, int] = {}
+        self.es_first_metric_only = bool(config.first_metric_only)
+
+        if train_data is not None:
+            self._setup_training(train_data)
+
+    # ------------------------------------------------------------------
+    def _setup_training(self, train_data: BinnedDataset) -> None:
+        cfg = self.config
+        self.learner = SerialTreeLearner(train_data, cfg)
+        self.num_data = train_data.num_data
+        self.max_feature_idx = train_data.num_total_features - 1
+        self.feature_names = list(train_data.feature_names)
+        if self.objective is not None:
+            self.objective.init(train_data.metadata)
+        self.train_metrics = create_metrics(
+            cfg, self.objective.name if self.objective else None)
+        for m in self.train_metrics:
+            m.init(train_data.metadata)
+
+        K = self.num_tree_per_iteration
+        shape = (self.num_data,) if K == 1 else (self.num_data, K)
+        self.scores = jnp.zeros(shape, dtype=jnp.float32)
+        if train_data.metadata.init_score is not None:
+            init = np.asarray(train_data.metadata.init_score, dtype=np.float32)
+            if K > 1:
+                init = init.reshape(K, self.num_data).T
+            self.scores = jnp.asarray(init.reshape(shape))
+            self.has_init_score = True
+        else:
+            self.has_init_score = False
+
+        # boost from average (reference: gbdt.cpp:313-336)
+        if (self.objective is not None and not self.has_init_score
+                and cfg.boost_from_average):
+            for k in range(K):
+                s = self.objective.boost_from_score(k)
+                if abs(s) > K_EPSILON:
+                    self.init_scores[k] = s
+                    if K == 1:
+                        self.scores = self.scores + s
+                    else:
+                        self.scores = self.scores.at[:, k].add(s)
+                    log.info("Start training from score %f", s)
+
+        # sampling state
+        self.bag_rng = jax.random.PRNGKey(cfg.bagging_seed)
+        self.feat_rng = jax.random.PRNGKey(cfg.feature_fraction_seed)
+        self.goss = cfg.data_sample_strategy == "goss"
+        self.need_bagging = (not self.goss and cfg.bagging_freq > 0
+                             and cfg.bagging_fraction < 1.0)
+        self._cached_bag = None
+        self.train_binned = self.learner.binned_dev[: self.num_data]
+
+        self._traverse_train = jax.jit(
+            lambda nodes, binned: predict_leaf_binned(binned, nodes))
+
+    # ------------------------------------------------------------------
+    def add_valid_data(self, valid_data: BinnedDataset) -> None:
+        metrics = create_metrics(
+            self.config, self.objective.name if self.objective else None)
+        for m in metrics:
+            m.init(valid_data.metadata)
+        binned = jnp.asarray(valid_data.binned)
+        K = self.num_tree_per_iteration
+        shape = (valid_data.num_data,) if K == 1 else (valid_data.num_data, K)
+        score = jnp.zeros(shape, dtype=jnp.float32)
+        if valid_data.metadata.init_score is not None:
+            init = np.asarray(valid_data.metadata.init_score, dtype=np.float32)
+            if K > 1:
+                init = init.reshape(K, valid_data.num_data).T
+            score = jnp.asarray(init.reshape(shape))
+        else:
+            for k in range(K):
+                if abs(self.init_scores[k]) > K_EPSILON:
+                    if K == 1:
+                        score = score + self.init_scores[k]
+                    else:
+                        score = score.at[:, k].add(self.init_scores[k])
+        self.valid_sets.append((valid_data, metrics, binned))
+        self.valid_scores.append(score)
+
+    # ------------------------------------------------------------------
+    def _compute_gradients(self):
+        g, h = self.objective.get_gradients(self.scores)
+        return g, h
+
+    def _bagging_indices(self, it: int):
+        """Row sampling (reference: bagging.hpp / goss.hpp).
+
+        Returns (indices_padded (N_pad,), bag_cnt, grad_scale fn or None).
+        """
+        cfg = self.config
+        N = self.num_data
+        if self.goss:
+            return None  # handled in _goss_sample with gradients
+        if not self.need_bagging:
+            idx, cnt = self.learner.init_indices(None)
+            return idx, cnt
+        if it % cfg.bagging_freq == 0 or self._cached_bag is None:
+            self.bag_rng, sub = jax.random.split(self.bag_rng)
+            cnt = max(int(N * cfg.bagging_fraction), 1)
+            perm = jax.random.permutation(sub, N).astype(jnp.int32)
+            pad = jnp.full((self.learner.N_pad - N,), N, dtype=jnp.int32)
+            idx = jnp.concatenate([perm, pad])
+            self._cached_bag = (idx, cnt)
+        return self._cached_bag
+
+    def _goss_sample(self, grad, hess, it: int):
+        """GOSS (reference: goss.hpp Helper:116-165): keep the top_rate fraction
+        by |g*h|, sample other_rate of the rest and up-weight by
+        (1-top_rate)/other_rate."""
+        cfg = self.config
+        N = self.num_data
+        if grad.ndim == 2:
+            imp = jnp.sum(jnp.abs(grad * hess), axis=1)
+        else:
+            imp = jnp.abs(grad * hess)
+        top_k = max(int(N * cfg.top_rate), 1)
+        other_k = max(int(N * cfg.other_rate), 1)
+        threshold = jax.lax.top_k(imp, top_k)[0][-1]
+        is_top = imp >= threshold
+        self.bag_rng, sub = jax.random.split(self.bag_rng)
+        n_top = jnp.sum(is_top.astype(jnp.int32))
+        rest = jnp.maximum(N - n_top, 1)
+        prob = other_k / rest.astype(jnp.float32)
+        keep_other = (~is_top) & (jax.random.uniform(sub, (N,)) < prob)
+        selected = is_top | keep_other
+        multiply = (N - top_k) / other_k
+        scale = jnp.where(keep_other, multiply, 1.0)
+        if grad.ndim == 2:
+            grad = grad * scale[:, None]
+            hess = hess * scale[:, None]
+        else:
+            grad = grad * scale
+            hess = hess * scale
+        # pack selected rows to the front (stable)
+        order = jnp.argsort(jnp.where(selected, 0, 1), stable=True)
+        cnt = jnp.sum(selected.astype(jnp.int32))
+        pad = jnp.full((self.learner.N_pad - N,), N, dtype=jnp.int32)
+        idx = jnp.concatenate([order.astype(jnp.int32), pad])
+        return grad, hess, idx, cnt
+
+    def _feature_mask(self, it: int):
+        frac = float(self.config.feature_fraction)
+        F = self.learner.F
+        if frac >= 1.0 or F <= 1:
+            return jnp.ones((F,), dtype=bool)
+        k = max(int(F * frac), 1)
+        self.feat_rng, sub = jax.random.split(self.feat_rng)
+        perm = jax.random.permutation(sub, F)
+        mask = jnp.zeros((F,), dtype=bool).at[perm[:k]].set(True)
+        return mask
+
+    # ------------------------------------------------------------------
+    def train_one_iter(self, grad=None, hess=None) -> bool:
+        """One boosting iteration (reference: gbdt.cpp TrainOneIter:338).
+
+        Returns True when training should stop (no further splits possible).
+        """
+        if grad is None or hess is None:
+            grad, hess = self._compute_gradients()
+        else:
+            grad = jnp.asarray(grad, dtype=jnp.float32)
+            hess = jnp.asarray(hess, dtype=jnp.float32)
+            if self.num_tree_per_iteration > 1 and grad.ndim == 1:
+                grad = grad.reshape(self.num_tree_per_iteration, self.num_data).T
+                hess = hess.reshape(self.num_tree_per_iteration, self.num_data).T
+
+        if self.goss:
+            grad, hess, indices, bag_cnt = self._goss_sample(grad, hess, self.iter)
+        else:
+            indices, bag_cnt = self._bagging_indices(self.iter)
+
+        feature_mask = self._feature_mask(self.iter)
+        K = self.num_tree_per_iteration
+        should_stop = True
+        for k in range(K):
+            gk = grad[:, k] if K > 1 else grad
+            hk = hess[:, k] if K > 1 else hess
+            record = self.learner.build_tree(gk, hk, indices, bag_cnt, feature_mask)
+            num_nodes = int(record["s"])
+            if num_nodes > 0:
+                should_stop = False
+            leaf_value_dev = record["leaf_value"]
+            if (self.objective is not None
+                    and self.objective.is_renew_tree_output and num_nodes > 0):
+                leaf_value_dev = self._renew_tree_output(record, num_nodes, k)
+            # device score update via traversal
+            nodes = self.learner.node_arrays_for_predict(record)
+            delta_leaf = leaf_value_dev * self.shrinkage_rate
+            self._apply_score_update(nodes, delta_leaf, k)
+            # host tree for the model
+            host_record = {key: np.asarray(val) for key, val in record.items()
+                           if key.startswith(("node_", "leaf_"))}
+            host_record["leaf_value"] = np.asarray(leaf_value_dev)
+            tree = tree_from_device_record(
+                host_record, num_nodes, self.train_data.bin_mappers,
+                None, shrinkage=self.shrinkage_rate)
+            # fold the boost-from-average init score into the first
+            # iteration's trees (reference: gbdt.cpp:408-424 AddBias /
+            # AsConstantTree) so the saved model is self-contained
+            if (len(self.models) < K and abs(self.init_scores[k]) > K_EPSILON):
+                if num_nodes > 0:
+                    tree.leaf_value = tree.leaf_value + self.init_scores[k]
+                    tree.internal_value = tree.internal_value + self.init_scores[k]
+                else:
+                    tree.leaf_value = np.asarray([self.init_scores[k]])
+            self.models.append(tree)
+            self.device_trees.append({"nodes": nodes, "leaf_value": delta_leaf})
+        self.iter += 1
+        if should_stop:
+            log.warning("Stopped training because there are no more leaves "
+                        "that meet the split requirements")
+        return should_stop
+
+    def _apply_score_update(self, nodes, delta_leaf, k: int) -> None:
+        leaf_train = self._traverse_train(nodes, self.train_binned)
+        delta = jnp.take(delta_leaf, leaf_train)
+        if self.num_tree_per_iteration == 1:
+            self.scores = self.scores + delta
+        else:
+            self.scores = self.scores.at[:, k].add(delta)
+        for vi, (vd, metrics, binned) in enumerate(self.valid_sets):
+            leaf_v = predict_leaf_binned(binned, nodes)
+            dv = jnp.take(delta_leaf, leaf_v)
+            if self.num_tree_per_iteration == 1:
+                self.valid_scores[vi] = self.valid_scores[vi] + dv
+            else:
+                self.valid_scores[vi] = self.valid_scores[vi].at[:, k].add(dv)
+
+    def _renew_tree_output(self, record, num_nodes: int, k: int):
+        """L1-family leaf renewal (reference: RegressionL1loss::RenewTreeOutput;
+        applied through SerialTreeLearner::RenewTreeOutput)."""
+        alpha = self.objective.renew_leaf_alpha()
+        weights = self.objective.renew_weights()
+        num_leaves = num_nodes + 1
+        indices = np.asarray(record["indices"])
+        leaf_start = np.asarray(record["leaf_start"])
+        leaf_cnt = np.asarray(record["leaf_cnt"])
+        label = np.asarray(self.objective.label)
+        score = np.asarray(self.scores if self.num_tree_per_iteration == 1
+                           else self.scores[:, k])
+        w = np.asarray(weights) if weights is not None else None
+        new_values = np.asarray(record["leaf_value"]).copy()
+        from .objective import _weighted_percentile_host
+        for leaf in range(num_leaves):
+            s, c = int(leaf_start[leaf]), int(leaf_cnt[leaf])
+            if c <= 0:
+                continue
+            rows = indices[s:s + c]
+            rows = rows[rows < self.num_data]
+            resid = label[rows] - score[rows]
+            new_values[leaf] = _weighted_percentile_host(
+                resid, None if w is None else w[rows], alpha)
+        return jnp.asarray(new_values, dtype=jnp.float32)
+
+    # ------------------------------------------------------------------
+    def eval_metrics(self) -> Dict[str, List[Tuple[str, float, bool]]]:
+        """Evaluate all metrics; returns {dataset_name: [(metric, value, is_max_better)]}."""
+        out: Dict[str, List[Tuple[str, float, bool]]] = {}
+        if self.train_metrics and self.config.is_provide_training_metric:
+            res = []
+            for m in self.train_metrics:
+                for name, val in m.eval(self.scores, self.objective):
+                    res.append((name, val, m.is_max_better))
+            out["training"] = res
+        for vi, (vd, metrics, _) in enumerate(self.valid_sets):
+            res = []
+            for m in metrics:
+                for name, val in m.eval(self.valid_scores[vi], self.objective):
+                    res.append((name, val, m.is_max_better))
+            out[f"valid_{vi}"] = res
+        return out
+
+    def eval_valid(self, vi: int = 0):
+        if vi >= len(self.valid_sets):
+            return []
+        _, metrics, _ = self.valid_sets[vi]
+        res = []
+        for m in metrics:
+            for name, val in m.eval(self.valid_scores[vi], self.objective):
+                res.append((name, val, m.is_max_better))
+        return res
+
+    def eval_train(self):
+        res = []
+        for m in self.train_metrics:
+            for name, val in m.eval(self.scores, self.objective):
+                res.append((name, val, m.is_max_better))
+        return res
+
+    # ------------------------------------------------------------------
+    def num_trees(self) -> int:
+        return len(self.models)
+
+    @property
+    def current_iteration(self) -> int:
+        return self.iter
+
+    def predict_raw(self, data: np.ndarray, start_iteration: int = 0,
+                    num_iteration: int = -1) -> np.ndarray:
+        """Raw-score batch prediction on host feature values
+        (reference: gbdt_prediction.cpp PredictRaw)."""
+        data = np.asarray(data, dtype=np.float64)
+        n = data.shape[0]
+        K = self.num_tree_per_iteration
+        # init scores are folded into the first iteration's trees (AddBias),
+        # so raw prediction is a plain sum over trees
+        out = np.zeros((n, K), dtype=np.float64)
+        total_iters = len(self.models) // K
+        end_iter = total_iters if num_iteration <= 0 else min(
+            total_iters, start_iteration + num_iteration)
+        for it in range(start_iteration, end_iter):
+            for k in range(K):
+                out[:, k] += self.models[it * K + k].predict(data)
+        if self.average_output and end_iter > start_iteration:
+            out /= (end_iter - start_iteration)
+        return out[:, 0] if K == 1 else out
+
+    def predict(self, data: np.ndarray, raw_score: bool = False, **kw) -> np.ndarray:
+        raw = self.predict_raw(data, **kw)
+        if raw_score or self.objective is None:
+            return raw
+        conv = self.objective.convert_output(jnp.asarray(raw))
+        return np.asarray(conv)
+
+    def predict_leaf_index(self, data: np.ndarray) -> np.ndarray:
+        data = np.asarray(data, dtype=np.float64)
+        out = np.zeros((data.shape[0], len(self.models)), dtype=np.int32)
+        for t, tree in enumerate(self.models):
+            out[:, t] = tree.predict_leaf(data)
+        return out
+
+    def rollback_one_iter(self) -> None:
+        """reference: gbdt.cpp RollbackOneIter:443."""
+        if self.iter <= 0:
+            return
+        K = self.num_tree_per_iteration
+        for k in range(K):
+            dt = self.device_trees.pop()
+            tree = self.models.pop()
+            nodes, delta_leaf = dt["nodes"], dt["leaf_value"]
+            leaf_train = self._traverse_train(nodes, self.train_binned)
+            delta = jnp.take(delta_leaf, leaf_train)
+            kk = K - 1 - k
+            if K == 1:
+                self.scores = self.scores - delta
+            else:
+                self.scores = self.scores.at[:, kk].add(-delta)
+            for vi, (vd, metrics, binned) in enumerate(self.valid_sets):
+                leaf_v = predict_leaf_binned(binned, nodes)
+                dv = jnp.take(delta_leaf, leaf_v)
+                if K == 1:
+                    self.valid_scores[vi] = self.valid_scores[vi] - dv
+                else:
+                    self.valid_scores[vi] = self.valid_scores[vi].at[:, kk].add(-dv)
+        self.iter -= 1
+
+
+class DART(GBDT):
+    """DART boosting (reference: src/boosting/dart.hpp:23)."""
+
+    def __init__(self, config: Config, train_data, objective):
+        super().__init__(config, train_data, objective)
+        self.drop_rng = np.random.RandomState(config.drop_seed)
+        self.tree_weights: List[float] = []  # per model tree
+
+    def train_one_iter(self, grad=None, hess=None) -> bool:
+        # select trees to drop (reference: dart.hpp DroppingTrees:97)
+        cfg = self.config
+        K = self.num_tree_per_iteration
+        n_iters = len(self.models) // K
+        drop_iters: List[int] = []
+        if n_iters > 0 and self.drop_rng.rand() >= cfg.skip_drop:
+            if cfg.uniform_drop:
+                mask = self.drop_rng.rand(n_iters) < cfg.drop_rate
+                drop_iters = [i for i in range(n_iters) if mask[i]]
+            else:
+                k_drop = max(int(n_iters * cfg.drop_rate), 1)
+                k_drop = min(k_drop, cfg.max_drop if cfg.max_drop > 0 else k_drop)
+                drop_iters = sorted(self.drop_rng.choice(
+                    n_iters, size=min(k_drop, n_iters), replace=False).tolist())
+        # remove dropped trees' contributions from scores
+        for it in drop_iters:
+            for k in range(K):
+                t_idx = it * K + k
+                self._add_tree_to_scores(t_idx, -1.0)
+        stop = super().train_one_iter(grad, hess)
+        # normalize (reference: dart.hpp Normalize)
+        n_drop = len(drop_iters)
+        if n_drop > 0:
+            if cfg.xgboost_dart_mode:
+                new_w = self.shrinkage_rate / (n_drop + self.shrinkage_rate)
+                old_factor = n_drop / (n_drop + self.shrinkage_rate)
+            else:
+                new_w = 1.0 / (n_drop + 1)
+                old_factor = n_drop / (n_drop + 1.0)
+            # scale the new trees
+            for k in range(K):
+                t_idx = len(self.models) - K + k
+                scale = new_w / self.shrinkage_rate
+                self._scale_tree(t_idx, scale)
+            # scale dropped trees and re-add
+            for it in drop_iters:
+                for k in range(K):
+                    t_idx = it * K + k
+                    self._scale_tree(t_idx, old_factor)
+                    self._add_tree_to_scores(t_idx, 1.0)
+        return stop
+
+    def _scale_tree(self, t_idx: int, factor: float) -> None:
+        self.models[t_idx].leaf_value *= factor
+        self.models[t_idx].internal_value *= factor
+        dt = self.device_trees[t_idx]
+        dt["leaf_value"] = dt["leaf_value"] * factor
+
+    def _add_tree_to_scores(self, t_idx: int, sign: float) -> None:
+        dt = self.device_trees[t_idx]
+        K = self.num_tree_per_iteration
+        k = t_idx % K
+        leaf_train = self._traverse_train(dt["nodes"], self.train_binned)
+        delta = jnp.take(dt["leaf_value"], leaf_train) * sign
+        if K == 1:
+            self.scores = self.scores + delta
+        else:
+            self.scores = self.scores.at[:, k].add(delta)
+        for vi, (vd, metrics, binned) in enumerate(self.valid_sets):
+            leaf_v = predict_leaf_binned(binned, dt["nodes"])
+            dv = jnp.take(dt["leaf_value"], leaf_v) * sign
+            if K == 1:
+                self.valid_scores[vi] = self.valid_scores[vi] + dv
+            else:
+                self.valid_scores[vi] = self.valid_scores[vi].at[:, k].add(dv)
+
+
+class RF(GBDT):
+    """Random forest mode (reference: src/boosting/rf.hpp:25)."""
+
+    def __init__(self, config: Config, train_data, objective):
+        if config.bagging_freq <= 0 or config.bagging_fraction >= 1.0:
+            if config.feature_fraction >= 1.0:
+                log.fatal("Random forest mode requires bagging "
+                          "(bagging_freq > 0 and bagging_fraction < 1) or "
+                          "feature_fraction < 1")
+        super().__init__(config, train_data, objective)
+        self.average_output = True
+        self.shrinkage_rate = 1.0
+        # gradients are always taken at the init score
+        self._base_grad = None
+
+    def _compute_gradients(self):
+        if self._base_grad is None:
+            K = self.num_tree_per_iteration
+            shape = ((self.num_data,) if K == 1 else (self.num_data, K))
+            base = jnp.zeros(shape, dtype=jnp.float32)
+            for k in range(K):
+                if abs(self.init_scores[k]) > K_EPSILON:
+                    if K == 1:
+                        base = base + self.init_scores[k]
+                    else:
+                        base = base.at[:, k].add(self.init_scores[k])
+            self._base_grad = self.objective.get_gradients(base)
+        return self._base_grad
+
+    def _apply_score_update(self, nodes, delta_leaf, k: int) -> None:
+        # scores store the running SUM; metrics divide by iteration count via
+        # average_output handling in eval (approximated by scaling on read)
+        super()._apply_score_update(nodes, delta_leaf, k)
+
+
+def create_boosting(config: Config, train_data, objective) -> GBDT:
+    """reference: Boosting::CreateBoosting (include/LightGBM/boosting.h:314)."""
+    b = config.boosting
+    if b == "gbdt":
+        return GBDT(config, train_data, objective)
+    if b == "dart":
+        return DART(config, train_data, objective)
+    if b == "rf":
+        return RF(config, train_data, objective)
+    log.fatal("Unknown boosting type %s", b)

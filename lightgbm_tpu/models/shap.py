@@ -1,0 +1,188 @@
+"""SHAP feature contributions (TreeSHAP).
+
+TPU-native re-implementation of the reference's PredictContrib path
+(include/LightGBM/tree.h TreeSHAP, src/io/tree.cpp): the exact polynomial-time
+TreeSHAP recursion over decision paths, evaluated per (row, tree) on the host.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List
+
+import numpy as np
+
+from .tree import K_DEFAULT_LEFT_MASK, K_CATEGORICAL_MASK, MISSING_NAN, MISSING_ZERO
+
+K_ZERO_THRESHOLD = 1e-35
+
+
+class _PathElement:
+    __slots__ = ("feature_index", "zero_fraction", "one_fraction", "pweight")
+
+    def __init__(self, feature_index=-1, zero_fraction=0.0, one_fraction=0.0,
+                 pweight=0.0):
+        self.feature_index = feature_index
+        self.zero_fraction = zero_fraction
+        self.one_fraction = one_fraction
+        self.pweight = pweight
+
+
+def _extend_path(path: List[_PathElement], unique_depth: int,
+                 zero_fraction: float, one_fraction: float,
+                 feature_index: int) -> None:
+    path[unique_depth].feature_index = feature_index
+    path[unique_depth].zero_fraction = zero_fraction
+    path[unique_depth].one_fraction = one_fraction
+    path[unique_depth].pweight = 1.0 if unique_depth == 0 else 0.0
+    for i in range(unique_depth - 1, -1, -1):
+        path[i + 1].pweight += one_fraction * path[i].pweight * (i + 1) / (unique_depth + 1)
+        path[i].pweight = zero_fraction * path[i].pweight * (unique_depth - i) / (unique_depth + 1)
+
+
+def _unwind_path(path: List[_PathElement], unique_depth: int, path_index: int) -> None:
+    one_fraction = path[path_index].one_fraction
+    zero_fraction = path[path_index].zero_fraction
+    next_one_portion = path[unique_depth].pweight
+    for i in range(unique_depth - 1, -1, -1):
+        if one_fraction != 0:
+            tmp = path[i].pweight
+            path[i].pweight = next_one_portion * (unique_depth + 1) / ((i + 1) * one_fraction)
+            next_one_portion = tmp - path[i].pweight * zero_fraction * (unique_depth - i) / (unique_depth + 1)
+        else:
+            path[i].pweight = path[i].pweight * (unique_depth + 1) / (
+                zero_fraction * (unique_depth - i))
+    for i in range(path_index, unique_depth):
+        path[i].feature_index = path[i + 1].feature_index
+        path[i].zero_fraction = path[i + 1].zero_fraction
+        path[i].one_fraction = path[i + 1].one_fraction
+
+
+def _unwound_path_sum(path: List[_PathElement], unique_depth: int,
+                      path_index: int) -> float:
+    one_fraction = path[path_index].one_fraction
+    zero_fraction = path[path_index].zero_fraction
+    next_one_portion = path[unique_depth].pweight
+    total = 0.0
+    for i in range(unique_depth - 1, -1, -1):
+        if one_fraction != 0:
+            tmp = next_one_portion * (unique_depth + 1) / ((i + 1) * one_fraction)
+            total += tmp
+            next_one_portion = path[i].pweight - tmp * zero_fraction * (
+                (unique_depth - i) / (unique_depth + 1))
+        else:
+            total += path[i].pweight / (zero_fraction * (unique_depth - i) / (unique_depth + 1))
+    return total
+
+
+def _decision(tree, node: int, value: float) -> bool:
+    dtp = int(tree.decision_type[node])
+    mtype = (dtp >> 2) & 3
+    default_left = bool(dtp & K_DEFAULT_LEFT_MASK)
+    if math.isnan(value) and mtype != MISSING_NAN:
+        value = 0.0
+    if (mtype == MISSING_ZERO and abs(value) <= K_ZERO_THRESHOLD) or \
+            (mtype == MISSING_NAN and math.isnan(value)):
+        return default_left
+    return value <= tree.threshold[node]
+
+
+def _tree_shap(tree, x: np.ndarray, phi: np.ndarray, node: int,
+               unique_depth: int, parent_path: List[_PathElement],
+               parent_zero_fraction: float, parent_one_fraction: float,
+               parent_feature_index: int) -> None:
+    path = [ _PathElement(p.feature_index, p.zero_fraction, p.one_fraction,
+                          p.pweight) for p in parent_path[:unique_depth] ]
+    path += [_PathElement() for _ in range(len(parent_path) - unique_depth)]
+    _extend_path(path, unique_depth, parent_zero_fraction,
+                 parent_one_fraction, parent_feature_index)
+
+    if node < 0:  # leaf
+        leaf = ~node
+        for i in range(1, unique_depth + 1):
+            w = _unwound_path_sum(path, unique_depth, i)
+            el = path[i]
+            phi[el.feature_index] += w * (el.one_fraction - el.zero_fraction) * \
+                tree.leaf_value[leaf]
+        return
+
+    hot, cold = ((tree.left_child[node], tree.right_child[node])
+                 if _decision(tree, node, x[tree.split_feature[node]])
+                 else (tree.right_child[node], tree.left_child[node]))
+    w_node = _node_weight(tree, node)
+    hot_zero_fraction = _child_weight(tree, hot) / w_node
+    cold_zero_fraction = _child_weight(tree, cold) / w_node
+    incoming_zero_fraction = 1.0
+    incoming_one_fraction = 1.0
+
+    # if we split on the same feature as an ancestor, undo that path entry
+    path_index = 0
+    f = int(tree.split_feature[node])
+    while path_index <= unique_depth:
+        if path[path_index].feature_index == f:
+            break
+        path_index += 1
+    if path_index != unique_depth + 1:
+        incoming_zero_fraction = path[path_index].zero_fraction
+        incoming_one_fraction = path[path_index].one_fraction
+        _unwind_path(path, unique_depth, path_index)
+        unique_depth -= 1
+
+    _tree_shap(tree, x, phi, int(hot), unique_depth + 1, path,
+               hot_zero_fraction * incoming_zero_fraction,
+               incoming_one_fraction, f)
+    _tree_shap(tree, x, phi, int(cold), unique_depth + 1, path,
+               cold_zero_fraction * incoming_zero_fraction, 0.0, f)
+
+
+def _node_weight(tree, node: int) -> float:
+    cnt = float(tree.internal_count[node])
+    return cnt if cnt > 0 else 1.0
+
+
+def _child_weight(tree, child: int) -> float:
+    if child < 0:
+        c = float(tree.leaf_count[~child])
+    else:
+        c = float(tree.internal_count[child])
+    return c if c > 0 else 1.0
+
+
+def _expected_value(tree) -> float:
+    """Weighted average output of the tree (for the bias term)."""
+    total = tree.leaf_count[:tree.num_leaves].sum()
+    if total <= 0:
+        return float(tree.leaf_value[0]) if tree.num_leaves else 0.0
+    return float(np.sum(tree.leaf_value[:tree.num_leaves] *
+                        tree.leaf_count[:tree.num_leaves]) / total)
+
+
+def predict_contrib(gbdt, data: np.ndarray, start_iteration: int = 0,
+                    num_iteration: int = -1) -> np.ndarray:
+    """SHAP values with the expected-value bias in the last column
+    (reference: c_api predict with predict_contrib=true)."""
+    n, nf = data.shape
+    num_features = gbdt.max_feature_idx + 1
+    K = gbdt.num_tree_per_iteration
+    total_iters = len(gbdt.models) // K
+    end_iter = total_iters if num_iteration < 0 else min(
+        total_iters, start_iteration + num_iteration)
+    out = np.zeros((n, K, num_features + 1), dtype=np.float64)
+    max_leaves = max((t.num_leaves for t in gbdt.models), default=2)
+    for it in range(start_iteration, end_iter):
+        for k in range(K):
+            tree = gbdt.models[it * K + k]
+            if tree.num_leaves <= 1:
+                out[:, k, -1] += tree.leaf_value[0] if len(tree.leaf_value) else 0.0
+                continue
+            expected = _expected_value(tree)
+            maxd = tree.num_leaves + 2
+            parent_path = [_PathElement() for _ in range(maxd + 1)]
+            for r in range(n):
+                phi = np.zeros(num_features + 1)
+                _tree_shap(tree, data[r], phi, 0, 0, parent_path, 1.0, 1.0, -1)
+                out[r, k, :-1] += phi[:-1]
+                out[r, k, -1] += expected
+    if K == 1:
+        return out[:, 0, :]
+    return out.reshape(n, K * (num_features + 1))

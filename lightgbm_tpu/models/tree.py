@@ -1,0 +1,302 @@
+"""Host-side tree model: structure, prediction on raw features, text serde.
+
+TPU-native counterpart of the reference Tree (include/LightGBM/tree.h:25-729,
+src/io/tree.cpp): training happens on device (models/learner.py); the finished
+tree is pulled to the host as flat arrays in the reference's layout so that
+model files are interchangeable with the reference's text format
+(src/boosting/gbdt_model_text.cpp, src/io/tree.cpp Tree::ToString:340-408).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional
+
+import numpy as np
+
+K_ZERO_THRESHOLD = 1e-35
+
+# decision_type bit layout (reference: include/LightGBM/tree.h:19-20,260-278)
+K_CATEGORICAL_MASK = 1
+K_DEFAULT_LEFT_MASK = 2
+
+MISSING_NONE = 0
+MISSING_ZERO = 1
+MISSING_NAN = 2
+
+
+class Tree:
+    """Flat-array binary tree (reference: include/LightGBM/tree.h:25)."""
+
+    def __init__(self, num_leaves: int):
+        n = max(num_leaves - 1, 0)
+        self.num_leaves = num_leaves
+        self.split_feature: np.ndarray = np.zeros(n, dtype=np.int32)
+        self.threshold_bin: np.ndarray = np.zeros(n, dtype=np.int32)
+        self.threshold: np.ndarray = np.zeros(n, dtype=np.float64)
+        self.decision_type: np.ndarray = np.zeros(n, dtype=np.int8)
+        self.left_child: np.ndarray = np.zeros(n, dtype=np.int32)
+        self.right_child: np.ndarray = np.zeros(n, dtype=np.int32)
+        self.split_gain: np.ndarray = np.zeros(n, dtype=np.float64)
+        self.internal_value: np.ndarray = np.zeros(n, dtype=np.float64)
+        self.internal_weight: np.ndarray = np.zeros(n, dtype=np.float64)
+        self.internal_count: np.ndarray = np.zeros(n, dtype=np.int64)
+        self.leaf_value: np.ndarray = np.zeros(num_leaves, dtype=np.float64)
+        self.leaf_weight: np.ndarray = np.zeros(num_leaves, dtype=np.float64)
+        self.leaf_count: np.ndarray = np.zeros(num_leaves, dtype=np.int64)
+        self.shrinkage: float = 1.0
+        self.num_cat: int = 0
+        self.cat_boundaries: List[int] = [0]
+        self.cat_threshold: List[int] = []
+        self.is_linear: bool = False
+
+    # -- decision bits --------------------------------------------------
+    @staticmethod
+    def pack_decision_type(categorical: bool, default_left: bool,
+                           missing_type: int) -> int:
+        d = 0
+        if categorical:
+            d |= K_CATEGORICAL_MASK
+        if default_left:
+            d |= K_DEFAULT_LEFT_MASK
+        d |= (missing_type & 3) << 2
+        return d
+
+    @staticmethod
+    def unpack_decision_type(d: int):
+        return bool(d & K_CATEGORICAL_MASK), bool(d & K_DEFAULT_LEFT_MASK), (d >> 2) & 3
+
+    # -- prediction on raw feature values -------------------------------
+    def predict(self, data: np.ndarray) -> np.ndarray:
+        """Vectorized traversal (reference: tree.h Predict/NumericalDecision:335)."""
+        n = data.shape[0]
+        if self.num_leaves <= 1:
+            return np.full(n, self.leaf_value[0] if len(self.leaf_value) else 0.0)
+        out_leaf = self.predict_leaf(data)
+        return self.leaf_value[out_leaf]
+
+    def predict_leaf(self, data: np.ndarray) -> np.ndarray:
+        n = data.shape[0]
+        if self.num_leaves <= 1:
+            return np.zeros(n, dtype=np.int32)
+        node = np.zeros(n, dtype=np.int32)
+        active = np.ones(n, dtype=bool)
+        result = np.zeros(n, dtype=np.int32)
+        for _ in range(self.num_leaves * 2):
+            if not active.any():
+                break
+            nid = node[active]
+            f = self.split_feature[nid]
+            fval = data[active, f].astype(np.float64)
+            dtp = self.decision_type[nid]
+            is_cat = (dtp & K_CATEGORICAL_MASK) != 0
+            dleft = (dtp & K_DEFAULT_LEFT_MASK) != 0
+            mtype = (dtp.astype(np.int32) >> 2) & 3
+            nan_mask = np.isnan(fval)
+            fv = np.where(nan_mask & (mtype != MISSING_NAN), 0.0, fval)
+            is_missing = ((mtype == MISSING_ZERO) & (np.abs(fv) <= K_ZERO_THRESHOLD)) | \
+                         ((mtype == MISSING_NAN) & nan_mask)
+            goes_left = np.where(is_missing, dleft, fv <= self.threshold[nid])
+            if is_cat.any():
+                goes_left = np.where(
+                    is_cat, self._categorical_decision(nid, fval), goes_left)
+            nxt = np.where(goes_left, self.left_child[nid], self.right_child[nid])
+            leaf_hit = nxt < 0
+            act_idx = np.nonzero(active)[0]
+            result[act_idx[leaf_hit]] = ~nxt[leaf_hit]
+            node[act_idx] = np.where(leaf_hit, node[act_idx], nxt)
+            still = np.zeros(n, dtype=bool)
+            still[act_idx[~leaf_hit]] = True
+            active = still
+        return result
+
+    def _categorical_decision(self, nid, fval):
+        """reference: tree.h CategoricalDecision:400 (bitset membership)."""
+        goes_left = np.zeros(len(nid), dtype=bool)
+        for i in range(len(nid)):
+            node = int(nid[i])
+            v = fval[i]
+            if math.isnan(v) or int(v) < 0:
+                goes_left[i] = False
+                continue
+            iv = int(v)
+            cat_idx = int(self.threshold[node])
+            lo = self.cat_boundaries[cat_idx]
+            hi = self.cat_boundaries[cat_idx + 1]
+            word = iv // 32
+            if word < hi - lo:
+                goes_left[i] = bool(
+                    (self.cat_threshold[lo + word] >> (iv % 32)) & 1)
+        return goes_left
+
+    # -- serialization ---------------------------------------------------
+    def to_string(self, tree_index: int) -> str:
+        """reference: Tree::ToString (src/io/tree.cpp:340)."""
+        def join(arr, fmt="{:g}"):
+            return " ".join(fmt.format(x) for x in arr)
+
+        lines = [f"Tree={tree_index}",
+                 f"num_leaves={self.num_leaves}",
+                 f"num_cat={self.num_cat}"]
+        if self.num_leaves > 1:
+            lines.append("split_feature=" + join(self.split_feature, "{:d}"))
+            lines.append("split_gain=" + join(self.split_gain))
+            lines.append("threshold=" + " ".join(
+                repr(float(t)) for t in self.threshold))
+            lines.append("decision_type=" + join(self.decision_type, "{:d}"))
+            lines.append("left_child=" + join(self.left_child, "{:d}"))
+            lines.append("right_child=" + join(self.right_child, "{:d}"))
+            lines.append("leaf_value=" + " ".join(
+                repr(float(v)) for v in self.leaf_value[:self.num_leaves]))
+            lines.append("leaf_weight=" + join(self.leaf_weight[:self.num_leaves]))
+            lines.append("leaf_count=" + join(self.leaf_count[:self.num_leaves], "{:d}"))
+            lines.append("internal_value=" + join(self.internal_value))
+            lines.append("internal_weight=" + join(self.internal_weight))
+            lines.append("internal_count=" + join(self.internal_count, "{:d}"))
+            if self.num_cat > 0:
+                lines.append("cat_boundaries=" + join(self.cat_boundaries, "{:d}"))
+                lines.append("cat_threshold=" + join(self.cat_threshold, "{:d}"))
+        else:
+            lines.append("leaf_value=" + repr(float(
+                self.leaf_value[0] if len(self.leaf_value) else 0.0)))
+        lines.append(f"is_linear={int(self.is_linear)}")
+        lines.append(f"shrinkage={self.shrinkage:g}")
+        lines.append("")
+        lines.append("")
+        return "\n".join(lines)
+
+    @classmethod
+    def from_string(cls, text: str) -> "Tree":
+        kv: Dict[str, str] = {}
+        for line in text.splitlines():
+            line = line.strip()
+            if "=" in line:
+                k, v = line.split("=", 1)
+                kv[k] = v
+
+        num_leaves = int(kv.get("num_leaves", 1))
+        t = cls(num_leaves)
+        t.num_cat = int(kv.get("num_cat", 0))
+
+        def parse(key, dtype, n):
+            if key not in kv or not kv[key].strip():
+                return np.zeros(n, dtype=dtype)
+            return np.asarray([dtype(x) for x in kv[key].split()], dtype=dtype)
+
+        if num_leaves > 1:
+            n = num_leaves - 1
+            t.split_feature = parse("split_feature", np.int32, n)
+            t.split_gain = parse("split_gain", np.float64, n)
+            t.threshold = parse("threshold", np.float64, n)
+            t.decision_type = parse("decision_type", np.int8, n)
+            t.left_child = parse("left_child", np.int32, n)
+            t.right_child = parse("right_child", np.int32, n)
+            t.leaf_value = parse("leaf_value", np.float64, num_leaves)
+            t.leaf_weight = parse("leaf_weight", np.float64, num_leaves)
+            t.leaf_count = parse("leaf_count", np.int64, num_leaves)
+            t.internal_value = parse("internal_value", np.float64, n)
+            t.internal_weight = parse("internal_weight", np.float64, n)
+            t.internal_count = parse("internal_count", np.int64, n)
+            if t.num_cat > 0:
+                t.cat_boundaries = [int(x) for x in kv["cat_boundaries"].split()]
+                t.cat_threshold = [int(x) for x in kv["cat_threshold"].split()]
+        else:
+            t.leaf_value = np.asarray([float(kv.get("leaf_value", 0.0))])
+        t.shrinkage = float(kv.get("shrinkage", 1.0))
+        t.is_linear = bool(int(kv.get("is_linear", 0)))
+        return t
+
+    def to_json(self) -> dict:
+        """reference: Tree::ToJSON (src/io/tree.cpp:411)."""
+        out = {"num_leaves": int(self.num_leaves), "num_cat": int(self.num_cat),
+               "shrinkage": self.shrinkage}
+        if self.num_leaves == 1:
+            out["tree_structure"] = {"leaf_value": float(
+                self.leaf_value[0] if len(self.leaf_value) else 0.0)}
+        else:
+            out["tree_structure"] = self._node_to_json(0)
+        return out
+
+    def _node_to_json(self, node: int) -> dict:
+        if node >= 0:
+            cat, dleft, mtype = self.unpack_decision_type(int(self.decision_type[node]))
+            return {
+                "split_index": int(node),
+                "split_feature": int(self.split_feature[node]),
+                "split_gain": float(self.split_gain[node]),
+                "threshold": float(self.threshold[node]),
+                "decision_type": "==" if cat else "<=",
+                "default_left": bool(dleft),
+                "missing_type": ["None", "Zero", "NaN"][mtype],
+                "internal_value": float(self.internal_value[node]),
+                "internal_weight": float(self.internal_weight[node]),
+                "internal_count": int(self.internal_count[node]),
+                "left_child": self._node_to_json(int(self.left_child[node])),
+                "right_child": self._node_to_json(int(self.right_child[node])),
+            }
+        leaf = ~node
+        return {
+            "leaf_index": int(leaf),
+            "leaf_value": float(self.leaf_value[leaf]),
+            "leaf_weight": float(self.leaf_weight[leaf]),
+            "leaf_count": int(self.leaf_count[leaf]),
+        }
+
+    def apply_shrinkage(self, rate: float) -> None:
+        """reference: Tree::Shrinkage (tree.h)."""
+        self.leaf_value = self.leaf_value * rate
+        self.internal_value = self.internal_value * rate
+        self.shrinkage *= rate
+
+    def num_nodes(self) -> int:
+        return max(self.num_leaves - 1, 0)
+
+
+def tree_from_device_record(record: Dict[str, np.ndarray], num_nodes: int,
+                            bin_mappers, learner_meta,
+                            shrinkage: float = 1.0) -> Tree:
+    """Convert the device learner's state record into a host Tree.
+
+    Maps bin thresholds back to real-valued thresholds via the feature's
+    BinMapper upper bounds (reference: BinMapper::BinToValue used by
+    Tree::RealThreshold).
+    """
+    num_leaves = num_nodes + 1
+    t = Tree(num_leaves)
+    if num_nodes == 0:
+        t.leaf_value = np.asarray([0.0])
+        return t
+    nslice = slice(0, num_nodes)
+    t.split_feature = np.asarray(record["node_feature"][nslice], dtype=np.int32)
+    t.threshold_bin = np.asarray(record["node_threshold"][nslice], dtype=np.int32)
+    t.left_child = np.asarray(record["node_left"][nslice], dtype=np.int32)
+    t.right_child = np.asarray(record["node_right"][nslice], dtype=np.int32)
+    t.split_gain = np.asarray(record["node_gain"][nslice], dtype=np.float64)
+    t.internal_value = np.asarray(record["node_internal_value"][nslice], dtype=np.float64)
+    t.internal_weight = np.asarray(record["node_internal_weight"][nslice], dtype=np.float64)
+    t.internal_count = np.asarray(record["node_internal_count"][nslice], dtype=np.int64)
+    default_left = np.asarray(record["node_default_left"][nslice])
+    missing = np.asarray(record["node_missing_type"][nslice], dtype=np.int32)
+    t.decision_type = np.asarray(
+        [Tree.pack_decision_type(False, bool(dl), int(mt))
+         for dl, mt in zip(default_left, missing)], dtype=np.int8)
+    # real-valued thresholds from bin upper bounds
+    thresholds = np.zeros(num_nodes, dtype=np.float64)
+    for i in range(num_nodes):
+        f = int(t.split_feature[i])
+        bm = bin_mappers[f]
+        b = int(t.threshold_bin[i])
+        ub = bm.bin_upper_bound
+        b = min(b, len(ub) - 1)
+        v = ub[b]
+        if math.isinf(v) or math.isnan(v):
+            v = bm.bin_upper_bound[max(b - 1, 0)] if len(ub) > 1 else 0.0
+            v = max(v, bm.max_val) + 1.0 if math.isinf(v) or math.isnan(v) else v
+        thresholds[i] = v
+    t.threshold = thresholds
+    t.leaf_value = np.asarray(record["leaf_value"][:num_leaves], dtype=np.float64)
+    t.leaf_weight = np.asarray(record["leaf_sum_h"][:num_leaves], dtype=np.float64)
+    t.leaf_count = np.asarray(record["leaf_cnt"][:num_leaves], dtype=np.int64)
+    if shrinkage != 1.0:
+        t.apply_shrinkage(shrinkage)
+    return t

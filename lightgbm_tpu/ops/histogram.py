@@ -1,0 +1,82 @@
+"""Histogram construction on TPU.
+
+TPU-native replacement for the reference histogram kernels
+(src/io/dense_bin.hpp ConstructHistogram, src/treelearner/cuda/
+cuda_histogram_constructor.cu): TPUs have no fast scatter-add, so the
+(rows x groups) -> (groups x bins) accumulation is reformulated as a one-hot
+MXU matmul: for each row chunk, hist[g, b, c] += sum_r (bin[r, g] == b) * gh[r, c].
+The one-hot factor is exact in bfloat16/float32 and the contraction runs on the
+systolic array; per-chunk partials accumulate in float32.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def histogram_leaf(bins_slice: jnp.ndarray, gh_slice: jnp.ndarray,
+                   num_bins: int, row_chunk: int = 2048) -> jnp.ndarray:
+    """Build the (G, B, 2) grad/hess histogram for one leaf's row slice.
+
+    Args:
+      bins_slice: (S, G) integer bins for the leaf's rows (padding rows must
+        have their gh zeroed by the caller).
+      gh_slice: (S, 2) float32 gradient/hessian pairs (zeros on padding).
+      num_bins: padded bin count B (static).
+      row_chunk: rows per MXU matmul chunk (static).
+
+    Returns:
+      (G, B, 2) float32 histogram.
+    """
+    S, G = bins_slice.shape
+    B = num_bins
+    C = min(S, row_chunk)
+    n_chunks = (S + C - 1) // C
+    pad = n_chunks * C - S
+    if pad:
+        bins_slice = jnp.pad(bins_slice, ((0, pad), (0, 0)))
+        gh_slice = jnp.pad(gh_slice, ((0, pad), (0, 0)))
+
+    bins_c = bins_slice.reshape(n_chunks, C, G)
+    gh_c = gh_slice.reshape(n_chunks, C, 2)
+    iota_b = jax.lax.broadcasted_iota(jnp.int32, (1, B, 1), 1)
+
+    def body(acc, chunk):
+        bins_chunk, gh_chunk = chunk
+        # (G, B, C) one-hot: exact in f32; contraction over rows on the MXU
+        onehot = (bins_chunk.T[:, None, :].astype(jnp.int32) == iota_b)
+        partial = jnp.einsum(
+            "gbc,cj->gbj", onehot.astype(jnp.float32), gh_chunk,
+            preferred_element_type=jnp.float32)
+        return acc + partial, None
+
+    if n_chunks == 1:
+        onehot = (bins_c[0].T[:, None, :].astype(jnp.int32) == iota_b)
+        return jnp.einsum("gbc,cj->gbj", onehot.astype(jnp.float32), gh_c[0],
+                          preferred_element_type=jnp.float32)
+    acc0 = jnp.zeros((G, B, 2), dtype=jnp.float32)
+    acc, _ = jax.lax.scan(body, acc0, (bins_c, gh_c))
+    return acc
+
+
+def gather_leaf_rows(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
+                     indices: jnp.ndarray, start: jnp.ndarray, size: int,
+                     count: jnp.ndarray):
+    """Slice a leaf's row ids out of the partition array and gather its data.
+
+    ``indices`` is padded so that ``start + size`` never exceeds its length;
+    padding entries point at the sentinel row (all-zero gh).  Rows beyond
+    ``count`` inside the slice belong to *other* leaves, so their gh is zeroed.
+
+    Returns (bins (size, G), gh (size, 2)).
+    """
+    idx = jax.lax.dynamic_slice(indices, (start,), (size,))
+    pos = jax.lax.iota(jnp.int32, size)
+    valid = pos < count
+    bins = jnp.take(binned, idx, axis=0)
+    g = jnp.take(grad, idx) * valid
+    h = jnp.take(hess, idx) * valid
+    return bins, jnp.stack([g, h], axis=1)

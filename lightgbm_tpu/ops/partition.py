@@ -1,0 +1,63 @@
+"""Leaf partition on TPU.
+
+TPU-native replacement for the reference DataPartition
+(src/treelearner/data_partition.hpp) and the CUDA bitvector+prefix-sum path
+(src/treelearner/cuda/cuda_data_partition.cu:288-907).  TPUs have no fast
+scatter, so the stable two-way partition of a leaf's row-index range is done
+with one stable sort over a power-of-two bucket slice:
+
+  key 0 = goes left, key 1 = goes right, key 2 = padding (rows of *other*
+  leaves inside the bucket slice).  A stable sort groups left/right blocks in
+  original order and leaves the padding rows in their original trailing
+  positions, so the slice can be written back in place.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+MISSING_NONE = 0
+MISSING_ZERO = 1
+MISSING_NAN = 2
+
+
+def split_decision(bin_values: jnp.ndarray, threshold, default_left,
+                   missing_type, default_bin, nan_bin) -> jnp.ndarray:
+    """Per-row goes-left decision for a numerical split.
+
+    reference: DenseBin::Split (src/io/dense_bin.hpp:237-310) — values in the
+    missing bin follow ``default_left``; otherwise bin <= threshold goes left.
+    """
+    b = bin_values.astype(jnp.int32)
+    is_missing = jnp.where(
+        missing_type == MISSING_ZERO, b == default_bin,
+        jnp.where(missing_type == MISSING_NAN, b == nan_bin, False))
+    natural = b <= threshold
+    return jnp.where(is_missing, default_left, natural)
+
+
+def partition_leaf(indices: jnp.ndarray, binned_col_getter, start, count,
+                   size: int, goes_left_of_rows):
+    """Stably partition one leaf's index range in place.
+
+    Args:
+      indices: (N_pad,) int32 partition array (padded with sentinel rows).
+      binned_col_getter: unused here; decision comes via ``goes_left_of_rows``.
+      start: dynamic slice start.
+      count: dynamic number of valid rows in the leaf.
+      size: static bucket size (power of two >= count).
+      goes_left_of_rows: fn(row_ids (size,)) -> bool (size,).
+
+    Returns (new_indices, left_count).
+    """
+    idx = jax.lax.dynamic_slice(indices, (start,), (size,))
+    pos = jax.lax.iota(jnp.int32, size)
+    valid = pos < count
+    goes_left = goes_left_of_rows(idx) & valid
+    key = jnp.where(valid, jnp.where(goes_left, 0, 1), 2).astype(jnp.int32)
+    order = jnp.argsort(key, stable=True)
+    new_idx = jnp.take(idx, order)
+    out = jax.lax.dynamic_update_slice(indices, new_idx, (start,))
+    left_count = jnp.sum(goes_left.astype(jnp.int32))
+    return out, left_count

@@ -1,0 +1,65 @@
+"""On-device tree traversal over binned data.
+
+TPU-native equivalent of Tree::AddPredictionToScore on binned data
+(reference: include/LightGBM/tree.h:133-140, src/io/cuda/cuda_tree.cu):
+all rows advance one level per step of a while_loop; finished rows hold their
+(negative) leaf reference.  The loop runs ~tree-depth iterations, fully
+vectorized across rows.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .partition import split_decision
+
+
+def predict_leaf_binned(binned: jnp.ndarray, node: dict,
+                        num_nodes_limit: int | None = None) -> jnp.ndarray:
+    """Return the leaf index for every row of a binned matrix.
+
+    Args:
+      binned: (N, G) integer group-bin matrix.
+      node: device dict with per-internal-node arrays (shape (L-1,)):
+        'col', 'bin_start', 'is_bundled', 'num_bin', 'default_bin',
+        'missing_type', 'threshold', 'default_left', 'left', 'right'
+        (children: >=0 internal node id, <0 encoded leaf ~leaf_id),
+        plus scalar 'num_nodes'.
+    """
+    n = binned.shape[0]
+    num_nodes = node["num_nodes"]
+    cur = jnp.zeros((n,), dtype=jnp.int32)
+    # empty tree (single leaf): everything is leaf 0
+    def empty(_):
+        return jnp.full((n,), 0, dtype=jnp.int32)
+
+    def run(_):
+        def cond(state):
+            c = state
+            return jnp.any(c >= 0)
+
+        def body(state):
+            c = state
+            active = c >= 0
+            nid = jnp.maximum(c, 0)
+            col = node["col"][nid]
+            gb = jnp.take_along_axis(
+                binned, col[:, None].astype(jnp.int32), axis=1)[:, 0].astype(jnp.int32)
+            # bundled features: recover the feature-local bin
+            fb_raw = gb - node["bin_start"][nid]
+            nb = node["num_bin"][nid]
+            in_range = (fb_raw >= 1) & (fb_raw <= nb - 1)
+            fb = jnp.where(node["is_bundled"][nid] == 1,
+                           jnp.where(in_range, fb_raw, node["default_bin"][nid]),
+                           gb)
+            goes_left = split_decision(
+                fb, node["threshold"][nid], node["default_left"][nid],
+                node["missing_type"][nid], node["default_bin"][nid], nb - 1)
+            nxt = jnp.where(goes_left, node["left"][nid], node["right"][nid])
+            return jnp.where(active, nxt, c)
+
+        final = jax.lax.while_loop(cond, body, cur)
+        return -(final + 1)  # decode ~leaf
+
+    return jax.lax.cond(num_nodes > 0, run, empty, operand=None)

@@ -1,0 +1,235 @@
+"""Best-split search over histograms, vectorized across (feature, bin).
+
+TPU-native replacement for the reference's per-feature sequential threshold
+scan (src/treelearner/feature_histogram.hpp FindBestThresholdSequentially:830,
+GetSplitGains:759, CalculateSplittedLeafOutput:717) and the CUDA best-split
+kernels (src/treelearner/cuda/cuda_best_split_finder.cu): the forward/reverse
+accumulations become masked cumulative sums over the bin axis, gains are
+evaluated for every (feature, bin, direction) candidate at once on the VPU,
+and the arg-max reduction reproduces the reference's scan-order tie-breaking:
+
+  * reverse scan runs "first" (forward replaces only on strictly-greater gain),
+  * within the reverse scan larger thresholds win ties,
+  * within the forward scan smaller thresholds win ties,
+  * across features the smaller feature index wins ties.
+
+Missing-value handling mirrors the reference dispatch
+(feature_histogram.hpp FuncForNumricalL3:272-455):
+  * MissingType::Zero  -> both scans skip the default(zero) bin; zeros follow
+    ``default_left`` (reverse scan => default_left=True).
+  * MissingType::NaN   -> the last bin holds NaNs; the reverse scan keeps it
+    out of the right side (NaN defaults left), the forward scan keeps it right.
+  * MissingType::None  -> single reverse scan, no skipping.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+K_EPSILON = 1e-15
+K_MIN_SCORE = -jnp.inf
+
+MISSING_NONE = 0
+MISSING_ZERO = 1
+MISSING_NAN = 2
+
+
+class SplitContext(NamedTuple):
+    """Static per-feature metadata, device-resident (shapes (F,))."""
+    num_bin: jnp.ndarray        # int32
+    missing_type: jnp.ndarray   # int32
+    default_bin: jnp.ndarray    # int32
+    is_categorical: jnp.ndarray  # int32 (categorical handled separately)
+    feature_index: jnp.ndarray  # int32 original feature id (for reporting)
+
+
+class BestSplit(NamedTuple):
+    gain: jnp.ndarray           # f32 scalar, relative gain (already minus shift)
+    feature: jnp.ndarray        # int32, index into the used-feature enumeration
+    threshold: jnp.ndarray      # int32 bin threshold
+    default_left: jnp.ndarray   # bool
+    left_sum_g: jnp.ndarray
+    left_sum_h: jnp.ndarray
+    right_sum_g: jnp.ndarray
+    right_sum_h: jnp.ndarray
+    left_count: jnp.ndarray     # int32 (hessian-estimated, like the reference)
+    right_count: jnp.ndarray
+    left_output: jnp.ndarray
+    right_output: jnp.ndarray
+
+
+def _threshold_l1(s, l1):
+    return jnp.sign(s) * jnp.maximum(0.0, jnp.abs(s) - l1)
+
+
+def leaf_output(sum_g, sum_h, l1, l2, max_delta_step):
+    """reference: CalculateSplittedLeafOutput (feature_histogram.hpp:717)."""
+    ret = -_threshold_l1(sum_g, l1) / (sum_h + l2)
+    if max_delta_step > 0:
+        ret = jnp.clip(ret, -max_delta_step, max_delta_step)
+    return ret
+
+
+def _leaf_gain_given_output(sum_g, sum_h, l1, l2, out):
+    sg = _threshold_l1(sum_g, l1)
+    return -(2.0 * sg * out + (sum_h + l2) * out * out)
+
+
+def leaf_gain(sum_g, sum_h, l1, l2, max_delta_step):
+    """reference: GetLeafGain (feature_histogram.hpp:800)."""
+    if max_delta_step > 0:
+        out = leaf_output(sum_g, sum_h, l1, l2, max_delta_step)
+        return _leaf_gain_given_output(sum_g, sum_h, l1, l2, out)
+    sg = _threshold_l1(sum_g, l1)
+    return sg * sg / (sum_h + l2)
+
+
+def find_best_split(feat_hist: jnp.ndarray, ctx: SplitContext,
+                    sum_g, sum_h, num_data,
+                    l1: float, l2: float, max_delta_step: float,
+                    min_gain_to_split: float, min_data_in_leaf: int,
+                    min_sum_hessian: float,
+                    feature_mask: jnp.ndarray | None = None) -> BestSplit:
+    """Find the best numerical split for one leaf.
+
+    Args:
+      feat_hist: (F, BF, 2) per-feature histogram view (default-bin stats
+        already reconstructed for bundled features).
+      ctx: per-feature metadata.
+      sum_g/sum_h/num_data: leaf aggregates (sum_h WITHOUT the 2*eps pad; the
+        pad is applied here like FindBestThreshold, feature_histogram.hpp:165).
+      feature_mask: optional (F,) bool — features allowed at this node
+        (feature_fraction / interaction constraints).
+    """
+    F, BF, _ = feat_hist.shape
+    G = feat_hist[..., 0]
+    H = feat_hist[..., 1]
+    sum_h_tot = sum_h + 2 * K_EPSILON
+    num_data = num_data.astype(jnp.float32) if hasattr(num_data, "astype") else jnp.float32(num_data)
+    cnt_factor = num_data / sum_h_tot
+
+    bins = jax.lax.broadcasted_iota(jnp.int32, (F, BF), 1)
+    nb = ctx.num_bin[:, None]
+    in_range = bins < nb
+    missing = ctx.missing_type[:, None]
+    dflt = ctx.default_bin[:, None]
+    is_zero_miss = missing == MISSING_ZERO
+    is_nan_miss = missing == MISSING_NAN
+    two_scan = (ctx.num_bin[:, None] > 2) & (missing != MISSING_NONE)
+
+    # per-bin estimated counts (reference rounds per bin: Common::RoundInt)
+    cnt_bin = jnp.floor(H * cnt_factor + 0.5).astype(jnp.int32) * in_range
+
+    # --- forward scan (missing goes right) ---
+    skip_fwd = is_zero_miss & (bins == dflt)
+    Gf = jnp.where(in_range & ~skip_fwd, G, 0.0)
+    Hf = jnp.where(in_range & ~skip_fwd, H, 0.0)
+    Cf = jnp.where(in_range & ~skip_fwd, cnt_bin, 0)
+    left_g_f = jnp.cumsum(Gf, axis=1)
+    left_h_f = jnp.cumsum(Hf, axis=1) + K_EPSILON
+    left_c_f = jnp.cumsum(Cf, axis=1)
+    right_g_f = sum_g - left_g_f
+    right_h_f = sum_h_tot - left_h_f
+    right_c_f = num_data.astype(jnp.int32) - left_c_f
+
+    # --- reverse scan (missing goes left) ---
+    # right side accumulates bins (t, bmax]; bmax excludes the NaN bin.
+    # The single-scan fallback (num_bin<=2 or MissingType::None,
+    # feature_histogram.hpp:421-451) neither skips the default bin nor
+    # excludes the NaN bin, hence the `two_scan` factors.
+    bmax = nb - 1 - (is_nan_miss & two_scan).astype(jnp.int32)
+    skip_rev = two_scan & is_zero_miss & (bins == dflt)
+    mask_rev = in_range & ~skip_rev & (bins <= bmax)
+    Gr = jnp.where(mask_rev, G, 0.0)
+    Hr = jnp.where(mask_rev, H, 0.0)
+    Cr = jnp.where(mask_rev, cnt_bin, 0)
+    cum_g_r = jnp.cumsum(Gr, axis=1)
+    cum_h_r = jnp.cumsum(Hr, axis=1)
+    cum_c_r = jnp.cumsum(Cr, axis=1)
+    tot_g_r = cum_g_r[:, -1:]
+    tot_h_r = cum_h_r[:, -1:]
+    tot_c_r = cum_c_r[:, -1:]
+    right_g_r = tot_g_r - cum_g_r
+    right_h_r = tot_h_r - cum_h_r + K_EPSILON
+    right_c_r = tot_c_r - cum_c_r
+    left_g_r = sum_g - right_g_r
+    left_h_r = sum_h_tot - right_h_r
+    left_c_r = num_data.astype(jnp.int32) - right_c_r
+
+    gain_shift = leaf_gain(sum_g, sum_h_tot, l1, l2, max_delta_step)
+    min_gain_shift = gain_shift + min_gain_to_split
+
+    def side_gain(gl, hl, gr, hr):
+        return (leaf_gain(gl, hl, l1, l2, max_delta_step) +
+                leaf_gain(gr, hr, l1, l2, max_delta_step))
+
+    gain_f = side_gain(left_g_f, left_h_f, right_g_f, right_h_f)
+    gain_r = side_gain(left_g_r, left_h_r, right_g_r, right_h_r)
+
+    def common_valid(lc, rc, lh, rh):
+        return ((lc >= min_data_in_leaf) & (rc >= min_data_in_leaf) &
+                (lh >= min_sum_hessian) & (rh >= min_sum_hessian))
+
+    # forward thresholds: t in [0, num_bin-2], skip t == default_bin (Zero)
+    valid_f = (two_scan & in_range & (bins <= nb - 2) &
+               ~(is_zero_miss & (bins == dflt)) &
+               common_valid(left_c_f, right_c_f, left_h_f, right_h_f) &
+               (gain_f > min_gain_shift))
+    # reverse thresholds: t in [0, bmax-1], skip t == default_bin-1 (Zero)
+    valid_r = (in_range & (bins <= bmax - 1) &
+               ~(two_scan & is_zero_miss & (bins == dflt - 1)) &
+               common_valid(left_c_r, right_c_r, left_h_r, right_h_r) &
+               (gain_r > min_gain_shift))
+
+    numerical = ctx.is_categorical[:, None] == 0
+    valid_f &= numerical
+    valid_r &= numerical
+    if feature_mask is not None:
+        valid_f &= feature_mask[:, None]
+        valid_r &= feature_mask[:, None]
+
+    neg = jnp.float32(K_MIN_SCORE)
+    gain_f = jnp.where(valid_f, gain_f, neg)
+    gain_r = jnp.where(valid_r, gain_r, neg)
+
+    # per-feature best, with scan-order tie-breaking
+    best_t_f = jnp.argmax(gain_f, axis=1)            # first (smallest t) wins
+    best_gain_f = jnp.take_along_axis(gain_f, best_t_f[:, None], axis=1)[:, 0]
+    rev_flip = gain_r[:, ::-1]
+    best_t_r_flip = jnp.argmax(rev_flip, axis=1)      # largest t wins ties
+    best_t_r = BF - 1 - best_t_r_flip
+    best_gain_r = jnp.take_along_axis(gain_r, best_t_r[:, None], axis=1)[:, 0]
+
+    use_fwd = best_gain_f > best_gain_r              # strict: reverse wins ties
+    feat_gain = jnp.where(use_fwd, best_gain_f, best_gain_r)
+    feat_thresh = jnp.where(use_fwd, best_t_f, best_t_r)
+    # default_left: reverse scan => True; single-scan NaN feature => False
+    single_nan = (~two_scan & is_nan_miss)[:, 0]
+    feat_default_left = jnp.where(use_fwd, False, True) & ~single_nan
+
+    best_f = jnp.argmax(feat_gain)                   # smallest feature wins ties
+    best_gain = feat_gain[best_f]
+    best_t = feat_thresh[best_f]
+    fwd_sel = use_fwd[best_f]
+
+    lg = jnp.where(fwd_sel, left_g_f[best_f, best_t], left_g_r[best_f, best_t])
+    lh = jnp.where(fwd_sel, left_h_f[best_f, best_t], left_h_r[best_f, best_t])
+    lc = jnp.where(fwd_sel, left_c_f[best_f, best_t], left_c_r[best_f, best_t])
+    rg = sum_g - lg
+    rh = sum_h_tot - lh
+    rc = num_data.astype(jnp.int32) - lc
+
+    return BestSplit(
+        gain=jnp.where(best_gain > neg, best_gain - min_gain_shift, neg),
+        feature=best_f.astype(jnp.int32),
+        threshold=best_t.astype(jnp.int32),
+        default_left=feat_default_left[best_f],
+        left_sum_g=lg, left_sum_h=lh - K_EPSILON,
+        right_sum_g=rg, right_sum_h=rh - K_EPSILON,
+        left_count=lc.astype(jnp.int32), right_count=rc.astype(jnp.int32),
+        left_output=leaf_output(lg, lh, l1, l2, max_delta_step),
+        right_output=leaf_output(rg, rh, l1, l2, max_delta_step),
+    )

@@ -1,0 +1,28 @@
+"""Test configuration: force an 8-device virtual CPU mesh before JAX import.
+
+Mirrors the reference's distributed-without-cluster testing strategy
+(tests/distributed/_test_distributed.py spawns N localhost processes); here N
+virtual XLA host devices stand in for N TPU chips.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+# Site plugins (e.g. a TPU tunnel) may have force-registered themselves and
+# overridden jax_platforms; pin CPU explicitly so tests never touch hardware.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(42)

@@ -1,0 +1,84 @@
+"""Binning unit tests vs NumPy oracles (reference semantics: src/io/bin.cpp)."""
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.ops.binning import (BIN_CATEGORICAL, MISSING_NAN,
+                                      MISSING_NONE, MISSING_ZERO, BinMapper,
+                                      greedy_find_bin)
+
+
+def test_greedy_find_bin_few_distinct():
+    vals = [1.0, 2.0, 3.0]
+    counts = [10, 10, 10]
+    bounds = greedy_find_bin(vals, counts, 10, 30, 1)
+    assert bounds[-1] == np.inf
+    assert len(bounds) == 3
+    # boundaries at midpoints
+    assert 1.0 < bounds[0] < 2.0
+    assert 2.0 < bounds[1] < 3.0
+
+
+def test_greedy_find_bin_min_data():
+    vals = [1.0, 2.0, 3.0, 4.0]
+    counts = [1, 1, 1, 100]
+    bounds = greedy_find_bin(vals, counts, 10, 103, 3)
+    # first boundary only after accumulating >= 3
+    assert len(bounds) == 2
+
+
+def test_bin_mapper_roundtrip():
+    rng = np.random.RandomState(0)
+    vals = rng.normal(size=5000)
+    bm = BinMapper()
+    bm.find_bin(vals, total_sample_cnt=5000, max_bin=255)
+    assert bm.missing_type == MISSING_NONE
+    assert 2 <= bm.num_bin <= 255
+    bins = bm.values_to_bins(vals)
+    # every value maps into the bin whose upper bound is the first >= value
+    for v, b in zip(vals[:200], bins[:200]):
+        assert v <= bm.bin_upper_bound[b]
+        if b > 0:
+            assert v > bm.bin_upper_bound[b - 1]
+
+
+def test_bin_mapper_nan_missing():
+    rng = np.random.RandomState(1)
+    vals = rng.normal(size=1000)
+    vals[::7] = np.nan
+    bm = BinMapper()
+    bm.find_bin(vals, total_sample_cnt=1000, max_bin=64)
+    assert bm.missing_type == MISSING_NAN
+    bins = bm.values_to_bins(vals)
+    assert (bins[::7] == bm.num_bin - 1).all()
+
+
+def test_bin_mapper_zero_as_missing():
+    rng = np.random.RandomState(2)
+    vals = rng.normal(size=1000)
+    vals[::3] = 0.0
+    bm = BinMapper()
+    nonzero = vals[np.abs(vals) > 1e-35]
+    bm.find_bin(nonzero, total_sample_cnt=1000, max_bin=64, zero_as_missing=True)
+    assert bm.missing_type in (MISSING_ZERO, MISSING_NONE)
+    bins = bm.values_to_bins(vals)
+    assert (bins[::3] == bm.default_bin).all()
+
+
+def test_bin_mapper_categorical():
+    rng = np.random.RandomState(3)
+    vals = rng.choice([0, 1, 2, 5, 9], size=2000, p=[0.4, 0.3, 0.2, 0.05, 0.05])
+    bm = BinMapper()
+    bm.find_bin(vals.astype(np.float64), total_sample_cnt=2000, max_bin=32,
+                bin_type=BIN_CATEGORICAL)
+    assert bm.bin_type == BIN_CATEGORICAL
+    # most frequent category gets bin 1
+    assert bm.bin_2_categorical[1] == 0
+    bins = bm.values_to_bins(vals.astype(np.float64))
+    assert (bins[vals == 0] == 1).all()
+
+
+def test_trivial_feature():
+    bm = BinMapper()
+    bm.find_bin(np.zeros(0), total_sample_cnt=100, max_bin=255)
+    assert bm.is_trivial

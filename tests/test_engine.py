@@ -1,0 +1,134 @@
+"""End-to-end training tests (reference model: tests/python_package_test/test_engine.py)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def make_regression(n=500, f=10, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, f))
+    y = X[:, 0] * 2.0 + np.sin(X[:, 1] * 3.0) + 0.1 * rng.normal(size=n)
+    return X, y
+
+
+def make_binary(n=500, f=10, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, f))
+    logit = X[:, 0] * 2.0 + X[:, 1] - X[:, 2]
+    y = (logit + rng.normal(size=n) * 0.5 > 0).astype(np.float64)
+    return X, y
+
+
+def test_regression_l2_learns():
+    X, y = make_regression()
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "learning_rate": 0.1, "min_data_in_leaf": 5,
+                     "verbosity": -1}, ds, num_boost_round=30)
+    pred = bst.predict(X)
+    mse0 = np.mean((y - y.mean()) ** 2)
+    mse = np.mean((y - pred) ** 2)
+    assert mse < 0.3 * mse0
+
+
+def test_binary_learns():
+    X, y = make_binary()
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "min_data_in_leaf": 5, "verbosity": -1},
+                    ds, num_boost_round=30)
+    pred = bst.predict(X)
+    assert pred.min() >= 0 and pred.max() <= 1
+    acc = np.mean((pred > 0.5) == y)
+    assert acc > 0.85
+
+
+def test_prediction_consistency_in_and_out_of_training():
+    """Device traversal scores must match host-tree raw predictions."""
+    X, y = make_regression(300, 5)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                     "min_data_in_leaf": 5, "verbosity": -1},
+                    ds, num_boost_round=10)
+    raw = bst.predict(X, raw_score=True)
+    train_scores = np.asarray(bst._gbdt.scores)
+    np.testing.assert_allclose(raw, train_scores, rtol=1e-4, atol=1e-4)
+
+
+def test_early_stopping():
+    X, y = make_regression(400, 8, seed=1)
+    Xv, yv = make_regression(200, 8, seed=2)
+    ds = lgb.Dataset(X, label=y)
+    vs = lgb.Dataset(Xv, label=yv, reference=ds)
+    bst = lgb.train({"objective": "regression", "num_leaves": 31,
+                     "verbosity": -1, "metric": "l2"},
+                    ds, num_boost_round=200, valid_sets=[vs],
+                    callbacks=[lgb.early_stopping(5, verbose=False)])
+    assert bst.best_iteration > 0
+    assert bst.best_iteration <= 200
+
+
+def test_model_save_load_roundtrip(tmp_path):
+    X, y = make_binary(300, 6)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "min_data_in_leaf": 5, "verbosity": -1},
+                    ds, num_boost_round=5)
+    path = str(tmp_path / "model.txt")
+    bst.save_model(path)
+    bst2 = lgb.Booster(model_file=path)
+    p1 = bst.predict(X, raw_score=True)
+    p2 = bst2.predict(X, raw_score=True)
+    np.testing.assert_allclose(p1, p2, rtol=1e-6, atol=1e-10)
+
+
+def test_multiclass():
+    rng = np.random.RandomState(5)
+    n = 600
+    X = rng.normal(size=(n, 6))
+    y = np.argmax(X[:, :3] + rng.normal(size=(n, 3)) * 0.3, axis=1).astype(np.float64)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "num_leaves": 15, "min_data_in_leaf": 5,
+                     "verbosity": -1}, ds, num_boost_round=20)
+    pred = bst.predict(X)
+    assert pred.shape == (n, 3)
+    np.testing.assert_allclose(pred.sum(axis=1), 1.0, rtol=1e-5)
+    acc = np.mean(np.argmax(pred, axis=1) == y)
+    assert acc > 0.8
+
+
+def test_bagging_and_feature_fraction():
+    X, y = make_regression(600, 12, seed=3)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "bagging_fraction": 0.6, "bagging_freq": 1,
+                     "feature_fraction": 0.7, "min_data_in_leaf": 5,
+                     "verbosity": -1}, ds, num_boost_round=30)
+    pred = bst.predict(X)
+    mse0 = np.mean((y - y.mean()) ** 2)
+    assert np.mean((y - pred) ** 2) < 0.5 * mse0
+
+
+def test_goss():
+    X, y = make_regression(800, 10, seed=4)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "data_sample_strategy": "goss", "verbosity": -1,
+                     "min_data_in_leaf": 5}, ds, num_boost_round=30)
+    pred = bst.predict(X)
+    mse0 = np.mean((y - y.mean()) ** 2)
+    assert np.mean((y - pred) ** 2) < 0.5 * mse0
+
+
+def test_l1_objective_renewal():
+    X, y = make_regression(400, 8, seed=6)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "regression_l1", "num_leaves": 15,
+                     "min_data_in_leaf": 5, "verbosity": -1},
+                    ds, num_boost_round=30)
+    pred = bst.predict(X)
+    mae0 = np.mean(np.abs(y - np.median(y)))
+    assert np.mean(np.abs(y - pred)) < 0.7 * mae0
