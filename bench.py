@@ -1,0 +1,84 @@
+"""Benchmark: HIGGS-like synthetic training throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline (BASELINE.md): the reference CPU learner trains HIGGS (10.5M rows x
+28 features, num_leaves=255, 500 iterations) in 130.094 s on 2x E5-2690 v4.
+Until the real HIGGS file is available in-image, this benchmark trains on a
+synthetic dataset with HIGGS' shape scaled by BENCH_ROWS (default 1M rows) and
+extrapolates the 500-iteration wall clock linearly in row count; vs_baseline
+is baseline_wall / extrapolated_wall (>1 means faster than the reference CPU).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+ROWS = int(os.environ.get("BENCH_ROWS", 1_000_000))
+FEATURES = 28
+NUM_LEAVES = int(os.environ.get("BENCH_LEAVES", 255))
+ITERS = int(os.environ.get("BENCH_ITERS", 50))
+BASELINE_WALL_S = 130.094
+BASELINE_ROWS = 10_500_000
+BASELINE_ITERS = 500
+
+
+def main():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    if os.environ.get("BENCH_PLATFORM"):
+        import jax
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(7)
+    X = rng.normal(size=(ROWS, FEATURES)).astype(np.float32)
+    w = rng.normal(size=FEATURES)
+    logit = X.dot(w) * 0.5
+    y = (logit + rng.normal(size=ROWS) > 0).astype(np.float32)
+
+    params = {
+        "objective": "binary",
+        "num_leaves": NUM_LEAVES,
+        "learning_rate": 0.1,
+        "max_bin": 255,
+        "verbosity": -1,
+        "metric": "",
+    }
+    ds = lgb.Dataset(X, label=y)
+    ds.construct(params)
+
+    # warmup: compile the tree builder (1 iteration)
+    bst = lgb.Booster(params=params, train_set=ds)
+    t0 = time.time()
+    bst.update()
+    warm = time.time() - t0
+
+    t0 = time.time()
+    for _ in range(ITERS):
+        bst.update()
+    wall = time.time() - t0
+    per_iter = wall / ITERS
+
+    # extrapolate to the baseline workload (500 iters, 10.5M rows)
+    est_500 = per_iter * BASELINE_ITERS * (BASELINE_ROWS / ROWS)
+    vs_baseline = BASELINE_WALL_S / est_500
+
+    print(json.dumps({
+        "metric": f"higgs_synth_{ROWS}x{FEATURES}_L{NUM_LEAVES}_wall_per_iter",
+        "value": round(per_iter, 4),
+        "unit": "s/iter",
+        "vs_baseline": round(vs_baseline, 4),
+        "detail": {
+            "iters_timed": ITERS,
+            "warmup_compile_s": round(warm, 2),
+            "extrapolated_higgs_500iter_s": round(est_500, 2),
+            "baseline_higgs_500iter_s": BASELINE_WALL_S,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -61,6 +61,18 @@ class GBDT:
     def _setup_training(self, train_data: BinnedDataset) -> None:
         cfg = self.config
         self.learner = SerialTreeLearner(train_data, cfg)
+        self.sharded_builder = None
+        if cfg.tree_learner != "serial":
+            import jax as _jax
+            ndev = len(_jax.devices())
+            if ndev > 1:
+                from ..parallel.trainer import ShardedTreeBuilder
+                self.sharded_builder = ShardedTreeBuilder(train_data, cfg)
+                log.info("Using %s-parallel tree learner over %d devices",
+                         cfg.tree_learner, ndev)
+            else:
+                log.warning("tree_learner=%s requested but only one device is "
+                            "visible; training serially", cfg.tree_learner)
         self.num_data = train_data.num_data
         self.max_feature_idx = train_data.num_total_features - 1
         self.feature_names = list(train_data.feature_names)
@@ -220,7 +232,13 @@ class GBDT:
                 grad = grad.reshape(self.num_tree_per_iteration, self.num_data).T
                 hess = hess.reshape(self.num_tree_per_iteration, self.num_data).T
 
-        if self.goss:
+        use_sharded = self.sharded_builder is not None
+        if use_sharded:
+            indices = bag_cnt = None
+            if self.goss or self.need_bagging:
+                log.warning("bagging/GOSS row sampling is not yet supported by "
+                            "the distributed tree learners; using all rows")
+        elif self.goss:
             grad, hess, indices, bag_cnt = self._goss_sample(grad, hess, self.iter)
         else:
             indices, bag_cnt = self._bagging_indices(self.iter)
@@ -231,14 +249,23 @@ class GBDT:
         for k in range(K):
             gk = grad[:, k] if K > 1 else grad
             hk = hess[:, k] if K > 1 else hess
-            record = self.learner.build_tree(gk, hk, indices, bag_cnt, feature_mask)
+            if use_sharded:
+                record = self.sharded_builder.build_tree(gk, hk, feature_mask)
+            else:
+                record = self.learner.build_tree(gk, hk, indices, bag_cnt,
+                                                 feature_mask)
             num_nodes = int(record["s"])
             if num_nodes > 0:
                 should_stop = False
             leaf_value_dev = record["leaf_value"]
             if (self.objective is not None
                     and self.objective.is_renew_tree_output and num_nodes > 0):
-                leaf_value_dev = self._renew_tree_output(record, num_nodes, k)
+                if use_sharded:
+                    log.warning("leaf-output renewal (%s objective) is not yet "
+                                "supported by the distributed learners",
+                                self.objective.name)
+                else:
+                    leaf_value_dev = self._renew_tree_output(record, num_nodes, k)
             # device score update via traversal
             nodes = self.learner.node_arrays_for_predict(record)
             delta_leaf = leaf_value_dev * self.shrinkage_rate

@@ -45,13 +45,31 @@ NEG_INF = float("-inf")
 
 
 class SerialTreeLearner:
-    """Builds one tree per call, entirely on device."""
+    """Builds one tree per call, entirely on device.
 
-    def __init__(self, dataset: BinnedDataset, config: Config):
+    With ``axis_name`` set, the same program runs SPMD inside ``shard_map``:
+      * ``parallel_mode='data'``  — rows sharded; per-leaf histograms are
+        ``psum``ed over ICI so every device sees global statistics and makes
+        identical split decisions (TPU analog of the reference
+        DataParallelTreeLearner's ReduceScatter+Allreduce,
+        src/treelearner/data_parallel_tree_learner.cpp:282-441).
+      * ``parallel_mode='feature'`` — rows replicated, the split *search* is
+        sharded via a per-device feature mask and the winning split is agreed
+        with an arg-max reduction (TPU analog of FeatureParallelTreeLearner's
+        SyncUpGlobalBestSplit, src/treelearner/parallel_tree_learner.h:209).
+    """
+
+    def __init__(self, dataset: BinnedDataset, config: Config,
+                 axis_name: Optional[str] = None,
+                 parallel_mode: str = "serial",
+                 num_shards: int = 1,
+                 local_num_data: Optional[int] = None):
         self.ds = dataset
         self.cfg = config
+        self.axis_name = axis_name
+        self.parallel_mode = parallel_mode
+        self.num_shards = num_shards
         meta = dataset.feature_meta_arrays()
-        self.N = dataset.num_data
         self.G = max(dataset.num_groups, 1)
         self.B = max(dataset.max_group_bins, 2)
         self.F = len(meta["feature"])
@@ -98,12 +116,17 @@ class SerialTreeLearner:
         self.default_pos = jnp.asarray(default_pos)
 
         # ---- binned matrix with sentinel row ----
-        binned = dataset.binned
-        if binned is None:
-            raise ValueError("dataset has no binned data")
-        sentinel = np.zeros((1, binned.shape[1]), dtype=binned.dtype)
-        self.binned_dev = jnp.asarray(np.concatenate([binned, sentinel], axis=0))
-        self.binned_flat = self.binned_dev.reshape(-1).astype(jnp.int32)
+        if local_num_data is None:
+            binned = dataset.binned
+            if binned is None:
+                raise ValueError("dataset has no binned data")
+            sentinel = np.zeros((1, binned.shape[1]), dtype=binned.dtype)
+            self.binned_dev = jnp.asarray(np.concatenate([binned, sentinel], axis=0))
+            self.N = dataset.num_data
+        else:
+            # SPMD: the (local_rows+1, G) shard arrives as an argument
+            self.binned_dev = None
+            self.N = local_num_data
 
         # ---- chunked processing geometry ----
         self.row_chunk = min(int(config.tpu_row_chunk), max(self.N, 8))
@@ -136,7 +159,7 @@ class SerialTreeLearner:
         return jnp.asarray(idx), cnt
 
     # ------------------------------------------------------------------
-    def _hist_leaf(self, indices, start, cnt, grad, hess):
+    def _hist_leaf(self, binned, indices, start, cnt, grad, hess):
         """Histogram of one leaf's rows via dynamically-counted fixed chunks.
 
         One compiled program serves every leaf size: ``n_chunks`` is a traced
@@ -152,7 +175,7 @@ class SerialTreeLearner:
             idx = jax.lax.dynamic_slice(indices, (start + ci * C,), (C,))
             gpos = ci * C + jax.lax.iota(jnp.int32, C)
             valid = (gpos < cnt).astype(jnp.float32)
-            bins = jnp.take(self.binned_dev, idx, axis=0)      # (C, G)
+            bins = jnp.take(binned, idx, axis=0)               # (C, G)
             g = jnp.take(grad, idx, mode="clip") * valid
             h = jnp.take(hess, idx, mode="clip") * valid
             gh = jnp.stack([g, h], axis=1)
@@ -160,18 +183,18 @@ class SerialTreeLearner:
             return acc + jnp.einsum("gbc,cj->gbj", onehot.astype(jnp.float32),
                                     gh, preferred_element_type=jnp.float32)
 
-        acc0 = jnp.zeros((G, B, 2), dtype=jnp.float32)
+        acc0 = self._pvary(jnp.zeros((G, B, 2), dtype=jnp.float32))
         return jax.lax.fori_loop(0, n_chunks, body, acc0)
 
-    def _goes_left(self, idx, scalars):
+    def _goes_left(self, binned_flat, idx, scalars):
         col, bstart, isb, nb, dbin, mtype, thr, dl = scalars
-        gb = jnp.take(self.binned_flat, idx * self.G + col, mode="clip")
+        gb = jnp.take(binned_flat, idx * self.G + col, mode="clip")
         fb_raw = gb - bstart
         in_r = (fb_raw >= 1) & (fb_raw <= nb - 1)
         fb = jnp.where(isb == 1, jnp.where(in_r, fb_raw, dbin), gb)
         return split_decision(fb, thr, dl, mtype, dbin, nb - 1)
 
-    def _partition_leaf(self, indices, scratch, start, cnt,
+    def _partition_leaf(self, binned_flat, indices, scratch, start, cnt,
                         decision_scalars, leaf, new_leaf):
         """Stable two-way partition of the leaf's index range, chunked.
 
@@ -190,7 +213,7 @@ class SerialTreeLearner:
             idx = jax.lax.dynamic_slice(indices, (start + ci * C,), (C,))
             gpos = ci * C + jax.lax.iota(jnp.int32, C)
             valid = gpos < cnt
-            gl = self._goes_left(idx, decision_scalars) & valid
+            gl = self._goes_left(binned_flat, idx, decision_scalars) & valid
             return idx, valid, gl
 
         def pass1(ci, counts):
@@ -198,7 +221,8 @@ class SerialTreeLearner:
             return counts.at[ci].set(jnp.sum(gl.astype(jnp.int32)))
 
         counts = jax.lax.fori_loop(
-            0, n_chunks, pass1, jnp.zeros((self.max_chunks,), jnp.int32))
+            0, n_chunks, pass1,
+            self._pvary(jnp.zeros((self.max_chunks,), jnp.int32)))
         left_bases = jnp.cumsum(counts) - counts
         total_left = jnp.sum(counts)
 
@@ -248,15 +272,47 @@ class SerialTreeLearner:
         return best._replace(gain=gain)
 
     # ------------------------------------------------------------------
-    def _build_tree_impl(self, grad, hess, indices, bag_cnt, feature_mask):
+    def _pvary(self, x):
+        """Mark a value as device-varying for shard_map's vma type system
+        (loop carries initialized from constants need this under SPMD)."""
+        if self.axis_name is None:
+            return x
+
+        def mark(a):
+            vma = getattr(jax.typeof(a), "vma", frozenset())
+            if self.axis_name in vma:
+                return a
+            return jax.lax.pcast(a, (self.axis_name,), to="varying")
+
+        return jax.tree.map(mark, x)
+
+    def _psum(self, x):
+        if self.axis_name is not None and self.parallel_mode == "data":
+            return jax.lax.psum(x, self.axis_name)
+        return x
+
+    def _sync_best(self, best):
+        """Agree on the global best split across feature-sharded devices
+        (reference: SyncUpGlobalBestSplit, parallel_tree_learner.h:209-232)."""
+        if self.axis_name is None or self.parallel_mode != "feature":
+            return best
+        gathered = jax.tree.map(
+            lambda a: jax.lax.all_gather(a, self.axis_name), best)
+        winner = jnp.argmax(gathered.gain)
+        return jax.tree.map(lambda a: a[winner], gathered)
+
+    def _build_tree_impl(self, binned, grad, hess, indices, bag_cnt, feature_mask):
         L, G, B, F = self.L, self.G, self.B, self.F
         nodes = self.max_splits
+        binned_flat = binned.reshape(-1).astype(jnp.int32)
 
-        root_hist = self._hist_leaf(indices, jnp.int32(0), bag_cnt, grad, hess)
+        root_hist = self._psum(
+            self._hist_leaf(binned, indices, jnp.int32(0), bag_cnt, grad, hess))
+        bag_cnt_g = self._psum(bag_cnt)
         sum_g = root_hist[0, :, 0].sum()
         sum_h = root_hist[0, :, 1].sum()
-        best0 = self._leaf_best_split(root_hist, sum_g, sum_h, bag_cnt,
-                                      jnp.int32(0), feature_mask)
+        best0 = self._sync_best(self._leaf_best_split(
+            root_hist, sum_g, sum_h, bag_cnt_g, jnp.int32(0), feature_mask))
 
         def arr(val, dtype=jnp.float32):
             return jnp.full((L,), val, dtype=dtype)
@@ -269,6 +325,7 @@ class SerialTreeLearner:
             "hist": jnp.zeros((L, G, B, 2), dtype=jnp.float32).at[0].set(root_hist),
             "leaf_start": arr(0, jnp.int32).at[0].set(0),
             "leaf_cnt": arr(0, jnp.int32).at[0].set(bag_cnt),
+            "leaf_cnt_g": arr(0, jnp.int32).at[0].set(bag_cnt_g),
             "leaf_sum_g": arr(0.0).at[0].set(sum_g),
             "leaf_sum_h": arr(0.0).at[0].set(sum_h),
             "leaf_depth": arr(0, jnp.int32),
@@ -306,6 +363,9 @@ class SerialTreeLearner:
             "node_missing_type": jnp.zeros((nodes,), jnp.int32),
         }
 
+        # uniform vma typing under shard_map: mark the whole state varying
+        state = self._pvary(state)
+
         def cond(st):
             return (st["s"] < nodes) & (~st["done"])
 
@@ -314,7 +374,7 @@ class SerialTreeLearner:
             gain = st["best_gain"][best_leaf]
 
             def no_split(st):
-                return {**st, "done": jnp.bool_(True)}
+                return self._pvary({**st, "done": jnp.bool_(True)})
 
             def do_split(st):
                 s = st["s"]
@@ -330,21 +390,26 @@ class SerialTreeLearner:
                 mtype = self.ctx.missing_type[f_enum]
                 start = st["leaf_start"][best_leaf]
                 cnt = st["leaf_cnt"][best_leaf]
+                cnt_g = st["leaf_cnt_g"][best_leaf]
 
                 indices_, scratch_, left_cnt = self._partition_leaf(
-                    st["indices"], st["scratch"], start, cnt,
+                    binned_flat, st["indices"], st["scratch"], start, cnt,
                     (col, bstart, isb, nb, dbin, mtype, thr, dl),
                     best_leaf, new_leaf)
                 right_cnt = cnt - left_cnt
+                left_cnt_g = self._psum(left_cnt)
+                right_cnt_g = cnt_g - left_cnt_g
                 l_start = start
                 r_start = start + left_cnt
 
-                # smaller child's histogram; larger by subtraction
-                small_is_left = left_cnt <= right_cnt
+                # smaller child's histogram; larger by subtraction.  The
+                # smaller/larger choice must use GLOBAL counts so every
+                # device computes (and psums) the same child's histogram.
+                small_is_left = left_cnt_g <= right_cnt_g
                 sm_start = jnp.where(small_is_left, l_start, r_start)
-                sm_cnt = jnp.minimum(left_cnt, right_cnt)
-                hist_small = self._hist_leaf(indices_, sm_start, sm_cnt,
-                                             grad, hess)
+                sm_cnt = jnp.where(small_is_left, left_cnt, right_cnt)
+                hist_small = self._psum(self._hist_leaf(
+                    binned, indices_, sm_start, sm_cnt, grad, hess))
                 parent_hist = st["hist"][best_leaf]
                 hist_large = parent_hist - hist_small
                 hist_left = jnp.where(small_is_left, hist_small, hist_large)
@@ -371,7 +436,7 @@ class SerialTreeLearner:
                         st["leaf_value"][best_leaf]),
                     "node_internal_weight": st["node_internal_weight"].at[s].set(
                         st["leaf_sum_h"][best_leaf]),
-                    "node_internal_count": st["node_internal_count"].at[s].set(cnt),
+                    "node_internal_count": st["node_internal_count"].at[s].set(cnt_g),
                     "node_col": st["node_col"].at[s].set(col),
                     "node_bin_start": st["node_bin_start"].at[s].set(bstart),
                     "node_is_bundled": st["node_is_bundled"].at[s].set(isb),
@@ -396,10 +461,10 @@ class SerialTreeLearner:
                 both = self._best_split_vmapped(
                     jnp.stack([hist_left, hist_right]),
                     jnp.stack([lsg, rsg]), jnp.stack([lsh, rsh]),
-                    jnp.stack([left_cnt, right_cnt]),
+                    jnp.stack([left_cnt_g, right_cnt_g]),
                     jnp.stack([depth_child, depth_child]), feature_mask)
-                best_l = jax.tree.map(lambda a: a[0], both)
-                best_r = jax.tree.map(lambda a: a[1], both)
+                best_l = self._sync_best(jax.tree.map(lambda a: a[0], both))
+                best_r = self._sync_best(jax.tree.map(lambda a: a[1], both))
 
                 def seta(name, vl, vr):
                     return st[name].at[best_leaf].set(vl).at[new_leaf].set(vr)
@@ -412,6 +477,7 @@ class SerialTreeLearner:
                     "hist": hist,
                     "leaf_start": seta("leaf_start", l_start, r_start),
                     "leaf_cnt": seta("leaf_cnt", left_cnt, right_cnt),
+                    "leaf_cnt_g": seta("leaf_cnt_g", left_cnt_g, right_cnt_g),
                     "leaf_sum_g": seta("leaf_sum_g", lsg, rsg),
                     "leaf_sum_h": seta("leaf_sum_h", lsh, rsh),
                     "leaf_depth": seta("leaf_depth", depth_child, depth_child),
@@ -431,7 +497,7 @@ class SerialTreeLearner:
                     "best_lout": seta("best_lout", best_l.left_output, best_r.left_output),
                     "best_rout": seta("best_rout", best_l.right_output, best_r.right_output),
                 })
-                return upd
+                return self._pvary(upd)
 
             return jax.lax.cond(gain > 0, do_split, no_split, st)
 
@@ -448,8 +514,8 @@ class SerialTreeLearner:
             feature_mask = jnp.ones((self.F,), dtype=bool)
         grad = jnp.asarray(grad, dtype=jnp.float32)
         hess = jnp.asarray(hess, dtype=jnp.float32)
-        return self._build_jit(grad, hess, indices, jnp.int32(bag_cnt),
-                               feature_mask)
+        return self._build_jit(self.binned_dev, grad, hess, indices,
+                               jnp.int32(bag_cnt), feature_mask)
 
     def node_arrays_for_predict(self, st: Dict[str, Any]) -> Dict[str, Any]:
         return {

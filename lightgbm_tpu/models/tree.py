@@ -296,7 +296,8 @@ def tree_from_device_record(record: Dict[str, np.ndarray], num_nodes: int,
     t.threshold = thresholds
     t.leaf_value = np.asarray(record["leaf_value"][:num_leaves], dtype=np.float64)
     t.leaf_weight = np.asarray(record["leaf_sum_h"][:num_leaves], dtype=np.float64)
-    t.leaf_count = np.asarray(record["leaf_cnt"][:num_leaves], dtype=np.int64)
+    cnt_key = "leaf_cnt_g" if "leaf_cnt_g" in record else "leaf_cnt"
+    t.leaf_count = np.asarray(record[cnt_key][:num_leaves], dtype=np.int64)
     if shrinkage != 1.0:
         t.apply_shrinkage(shrinkage)
     return t
