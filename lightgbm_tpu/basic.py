@@ -200,6 +200,7 @@ class Booster:
         self.best_iteration = -1
         self.best_score: Dict[str, Dict[str, float]] = {}
         self._valid_names: List[str] = []
+        self._valid_sets: List[Dataset] = []
 
         if train_set is not None:
             train_set.construct(self.params)
@@ -219,6 +220,7 @@ class Booster:
         data.construct(self.params)
         self._gbdt.add_valid_data(data._inner)
         self._valid_names.append(name)
+        self._valid_sets.append(data)
         return self
 
     def update(self, train_set: Optional[Dataset] = None, fobj=None) -> bool:
@@ -280,7 +282,7 @@ class Booster:
             dataset = self.train_set
         else:
             score = np.asarray(self._gbdt.valid_scores[valid_index])
-            dataset = None
+            dataset = self._valid_sets[valid_index]
         for f in fevals:
             res = f(score, dataset)
             if isinstance(res, tuple):

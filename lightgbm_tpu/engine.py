@@ -28,6 +28,12 @@ def train(params: Dict[str, Any], train_set: Dataset,
           callbacks: Optional[List[Callable]] = None) -> Booster:
     """Train a booster (reference: engine.py train:66)."""
     params = dict(params or {})
+    # LightGBM 4.x style: a callable objective in params drives the custom
+    # gradient path (reference: engine.py train:150-160)
+    fobj = None
+    if callable(params.get("objective")):
+        fobj = params.pop("objective")
+        params["objective"] = "none"
     cfg = Config(params)
     if "num_iterations" in {Config.canonical_name(k) for k in params}:
         num_boost_round = cfg.num_iterations
@@ -73,7 +79,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
                 model=booster, params=params, iteration=i,
                 begin_iteration=0, end_iteration=num_boost_round,
                 evaluation_result_list=None))
-        should_stop = booster.update()
+        should_stop = booster.update(fobj=fobj)
         evaluation_result_list = []
         if valid_contain_train:
             evaluation_result_list.extend(booster.eval_train(feval))
@@ -144,6 +150,10 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
        return_cvbooster: bool = False) -> Dict[str, Any]:
     """Cross validation (reference: engine.py cv:580)."""
     params = dict(params or {})
+    fobj = None
+    if callable(params.get("objective")):
+        fobj = params.pop("objective")
+        params["objective"] = "none"
     if metrics is not None:
         params["metric"] = metrics
     cfg = Config(params)
@@ -185,7 +195,7 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
     for i in range(num_boost_round):
         all_evals: Dict[str, List[float]] = {}
         for bst in cvbooster.boosters:
-            bst.update()
+            bst.update(fobj=fobj)
             for dname, mname, val, is_max in bst.eval_valid():
                 all_evals.setdefault((mname, is_max), []).append(val)
         stop_now = False

@@ -1,0 +1,544 @@
+"""scikit-learn estimator API.
+
+TPU-native re-implementation of python-package/lightgbm/sklearn.py
+(LGBMModel:482, LGBMRegressor:1169, LGBMClassifier:1215, LGBMRanker:1402)
+with the same constructor surface and fit/predict semantics, built on the
+jax engine instead of the C API.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import numpy as np
+
+from .basic import Booster, Dataset, LightGBMError
+from .callback import record_evaluation
+from .engine import train as _train
+
+try:  # sklearn is available in-image; keep a soft fallback anyway
+    from sklearn.base import BaseEstimator as _SKBaseEstimator
+    from sklearn.base import ClassifierMixin as _SKClassifierMixin
+    from sklearn.base import RegressorMixin as _SKRegressorMixin
+    _SKLEARN_INSTALLED = True
+except ImportError:  # pragma: no cover
+    _SKLEARN_INSTALLED = False
+
+    class _SKBaseEstimator:  # type: ignore
+        pass
+
+    class _SKClassifierMixin:  # type: ignore
+        pass
+
+    class _SKRegressorMixin:  # type: ignore
+        pass
+
+__all__ = ["LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker"]
+
+
+class _ObjectiveFunctionWrapper:
+    """Adapt a sklearn-style objective ``f(y_true, y_pred[, weight[, group]])
+    -> (grad, hess)`` to the engine's ``f(preds, dataset)`` signature.
+
+    reference: sklearn.py _ObjectiveFunctionWrapper:147.
+    """
+
+    def __init__(self, func: Callable):
+        self.func = func
+
+    def __call__(self, preds, dataset):
+        labels = dataset.get_label()
+        argc = self.func.__code__.co_argcount
+        if argc == 2:
+            grad, hess = self.func(labels, preds)
+        elif argc == 3:
+            grad, hess = self.func(labels, preds, dataset.get_weight())
+        elif argc == 4:
+            grad, hess = self.func(labels, preds, dataset.get_weight(),
+                                   dataset.get_group())
+        else:
+            raise TypeError(f"Self-defined objective should have 2-4 "
+                            f"arguments, got {argc}")
+        return grad, hess
+
+
+class _EvalFunctionWrapper:
+    """Adapt a sklearn-style metric ``f(y_true, y_pred, ...) -> (name, value,
+    is_higher_better)`` to the engine feval signature.
+
+    reference: sklearn.py _EvalFunctionWrapper:234.
+    """
+
+    def __init__(self, func: Callable):
+        self.func = func
+
+    def __call__(self, preds, dataset):
+        labels = dataset.get_label()
+        argc = self.func.__code__.co_argcount
+        if argc == 2:
+            return self.func(labels, preds)
+        if argc == 3:
+            return self.func(labels, preds, dataset.get_weight())
+        if argc == 4:
+            return self.func(labels, preds, dataset.get_weight(),
+                             dataset.get_group())
+        raise TypeError(f"Self-defined eval function should have 2-4 "
+                        f"arguments, got {argc}")
+
+
+def _to_2d(X) -> np.ndarray:
+    if hasattr(X, "toarray"):
+        X = X.toarray()
+    arr = np.asarray(X, dtype=np.float64)
+    if arr.ndim == 1:
+        arr = arr.reshape(1, -1)
+    return arr
+
+
+class LGBMModel(_SKBaseEstimator):
+    """Base estimator (reference: sklearn.py LGBMModel:482)."""
+
+    def __init__(self, boosting_type: str = "gbdt", num_leaves: int = 31,
+                 max_depth: int = -1, learning_rate: float = 0.1,
+                 n_estimators: int = 100, subsample_for_bin: int = 200000,
+                 objective: Optional[Union[str, Callable]] = None,
+                 class_weight: Optional[Union[Dict, str]] = None,
+                 min_split_gain: float = 0.0, min_child_weight: float = 1e-3,
+                 min_child_samples: int = 20, subsample: float = 1.0,
+                 subsample_freq: int = 0, colsample_bytree: float = 1.0,
+                 reg_alpha: float = 0.0, reg_lambda: float = 0.0,
+                 random_state=None, n_jobs: Optional[int] = None,
+                 importance_type: str = "split", **kwargs):
+        self.boosting_type = boosting_type
+        self.num_leaves = num_leaves
+        self.max_depth = max_depth
+        self.learning_rate = learning_rate
+        self.n_estimators = n_estimators
+        self.subsample_for_bin = subsample_for_bin
+        self.objective = objective
+        self.class_weight = class_weight
+        self.min_split_gain = min_split_gain
+        self.min_child_weight = min_child_weight
+        self.min_child_samples = min_child_samples
+        self.subsample = subsample
+        self.subsample_freq = subsample_freq
+        self.colsample_bytree = colsample_bytree
+        self.reg_alpha = reg_alpha
+        self.reg_lambda = reg_lambda
+        self.random_state = random_state
+        self.n_jobs = n_jobs
+        self.importance_type = importance_type
+        self._Booster: Optional[Booster] = None
+        self._evals_result: Dict = {}
+        self._best_score: Dict = {}
+        self._best_iteration: int = -1
+        self._objective = objective
+        self._class_weight = class_weight
+        self._other_params: Dict[str, Any] = {}
+        self._n_features: int = -1
+        self._n_classes: int = -1
+        self.set_params(**kwargs)
+
+    # -- param handling (mirrors reference get_params/set_params behavior) --
+    def get_params(self, deep: bool = True) -> Dict[str, Any]:
+        params = super().get_params(deep=deep) if _SKLEARN_INSTALLED else {
+            k: getattr(self, k) for k in self._param_names()}
+        params.update(self._other_params)
+        return params
+
+    def _param_names(self):
+        return ["boosting_type", "num_leaves", "max_depth", "learning_rate",
+                "n_estimators", "subsample_for_bin", "objective",
+                "class_weight", "min_split_gain", "min_child_weight",
+                "min_child_samples", "subsample", "subsample_freq",
+                "colsample_bytree", "reg_alpha", "reg_lambda", "random_state",
+                "n_jobs", "importance_type"]
+
+    def set_params(self, **params) -> "LGBMModel":
+        for key, value in params.items():
+            setattr(self, key, value)
+            if hasattr(self, f"_{key}"):
+                setattr(self, f"_{key}", value)
+            if key not in self._param_names():
+                self._other_params[key] = value
+        return self
+
+    # ------------------------------------------------------------------
+    def _process_params(self, stage: str) -> Dict[str, Any]:
+        assert stage in ("fit", "predict")
+        params = self.get_params()
+        params.pop("objective", None)
+        for alias in ("n_estimators", "class_weight", "importance_type",
+                      "n_jobs"):
+            params.pop(alias, None)
+        if isinstance(self.random_state, np.random.RandomState):
+            params["random_state"] = self.random_state.randint(
+                np.iinfo(np.int32).max)
+        elif isinstance(self.random_state, np.random.Generator):
+            params["random_state"] = int(self.random_state.integers(
+                np.iinfo(np.int32).max))
+        elif self.random_state is not None:
+            params["random_state"] = self.random_state
+        else:
+            params.pop("random_state", None)
+        if callable(self._objective):
+            if stage == "fit":
+                params["objective"] = _ObjectiveFunctionWrapper(
+                    self._objective)
+            else:
+                params["objective"] = "none"
+        elif self._objective is not None:
+            params["objective"] = self._objective
+        # rename sklearn names to lightgbm names
+        params["num_leaves"] = self.num_leaves
+        params["max_depth"] = self.max_depth
+        params["learning_rate"] = self.learning_rate
+        params["bagging_fraction"] = params.pop("subsample", self.subsample)
+        params["bagging_freq"] = params.pop("subsample_freq",
+                                            self.subsample_freq)
+        params["feature_fraction"] = params.pop("colsample_bytree",
+                                                self.colsample_bytree)
+        params["lambda_l1"] = params.pop("reg_alpha", self.reg_alpha)
+        params["lambda_l2"] = params.pop("reg_lambda", self.reg_lambda)
+        params["min_gain_to_split"] = params.pop("min_split_gain",
+                                                 self.min_split_gain)
+        params["min_sum_hessian_in_leaf"] = params.pop("min_child_weight",
+                                                       self.min_child_weight)
+        params["min_data_in_leaf"] = params.pop("min_child_samples",
+                                                self.min_child_samples)
+        params["bin_construct_sample_cnt"] = params.pop(
+            "subsample_for_bin", self.subsample_for_bin)
+        params["boosting"] = params.pop("boosting_type", self.boosting_type)
+        params.setdefault("verbosity", -1)
+        return params
+
+    def _compute_sample_weight(self, y, sample_weight, class_weight):
+        if class_weight is None:
+            return sample_weight
+        classes, y_idx = np.unique(y, return_inverse=True)
+        if class_weight == "balanced":
+            counts = np.bincount(y_idx)
+            w_per_class = len(y) / (len(classes) * counts)
+        else:
+            w_per_class = np.array([class_weight.get(c, 1.0) for c in classes],
+                                   dtype=np.float64)
+        cw = w_per_class[y_idx]
+        if sample_weight is not None:
+            cw = cw * np.asarray(sample_weight, dtype=np.float64)
+        return cw
+
+    def fit(self, X, y, sample_weight=None, init_score=None, group=None,
+            eval_set=None, eval_names=None, eval_sample_weight=None,
+            eval_class_weight=None, eval_init_score=None, eval_group=None,
+            eval_metric=None, feature_name: Union[str, List[str]] = "auto",
+            categorical_feature: Union[str, List] = "auto",
+            callbacks=None, init_model=None) -> "LGBMModel":
+        """Fit the model (reference: sklearn.py LGBMModel.fit:745)."""
+        params = self._process_params(stage="fit")
+
+        y = np.asarray(np.ravel(y), dtype=np.float64)
+        cw = self._class_weight if self._class_weight is not None \
+            else self.class_weight
+        sample_weight = self._compute_sample_weight(y, sample_weight, cw)
+
+        feval_list: List[Callable] = []
+        if eval_metric is not None:
+            metrics = eval_metric if isinstance(eval_metric, list) \
+                else [eval_metric]
+            str_metrics = [m for m in metrics if isinstance(m, str)]
+            fn_metrics = [m for m in metrics if callable(m)]
+            if str_metrics:
+                existing = params.get("metric")
+                merged = list(str_metrics)
+                if existing:
+                    if isinstance(existing, str):
+                        existing = [existing]
+                    merged = list(existing) + [m for m in str_metrics
+                                               if m not in existing]
+                params["metric"] = ",".join(merged)
+            feval_list = [_EvalFunctionWrapper(f) for f in fn_metrics]
+
+        train_set = Dataset(X, label=y, weight=sample_weight, group=group,
+                            init_score=init_score, feature_name=feature_name,
+                            categorical_feature=categorical_feature,
+                            params=params)
+        self._n_features = int(np.shape(X)[1])
+
+        valid_sets: List[Dataset] = []
+        if eval_set is not None:
+            if isinstance(eval_set, tuple):
+                eval_set = [eval_set]
+            for i, (vx, vy) in enumerate(eval_set):
+                vy = np.asarray(np.ravel(vy), dtype=np.float64)
+                if vx is X and vy.shape == y.shape and np.array_equal(vy, y):
+                    valid_sets.append(train_set)
+                    continue
+
+                def _item(collection, idx):
+                    if collection is None:
+                        return None
+                    if isinstance(collection, dict):
+                        return collection.get(idx)
+                    return collection[idx]
+
+                vw = _item(eval_sample_weight, i)
+                vcw = _item(eval_class_weight, i)
+                if vcw is not None:
+                    vw = self._compute_sample_weight(vy, vw, vcw)
+                vs = Dataset(vx, label=vy, weight=vw,
+                             group=_item(eval_group, i),
+                             init_score=_item(eval_init_score, i),
+                             reference=train_set, params=params)
+                valid_sets.append(vs)
+
+        evals_result: Dict = {}
+        callbacks = list(callbacks) if callbacks else []
+        callbacks.append(record_evaluation(evals_result))
+
+        self._Booster = _train(
+            params, train_set,
+            num_boost_round=self.n_estimators,
+            valid_sets=valid_sets or None,
+            valid_names=eval_names,
+            feval=feval_list or None,
+            init_model=init_model,
+            callbacks=callbacks,
+        )
+        self._evals_result = evals_result
+        self._best_iteration = self._Booster.best_iteration
+        self._best_score = self._Booster.best_score
+        self.fitted_ = True
+        return self
+
+    def predict(self, X, raw_score: bool = False, start_iteration: int = 0,
+                num_iteration: Optional[int] = None, pred_leaf: bool = False,
+                pred_contrib: bool = False, validate_features: bool = False,
+                **kwargs):
+        """Predict (reference: sklearn.py LGBMModel.predict:930)."""
+        if self._Booster is None:
+            raise LightGBMError("Estimator not fitted, call fit first")
+        Xm = _to_2d(X)
+        if Xm.shape[1] != self._n_features:
+            raise ValueError(
+                f"Number of features of the model must match the input. "
+                f"Model n_features_ is {self._n_features} and input "
+                f"n_features is {Xm.shape[1]}")
+        return self._Booster.predict(
+            Xm, raw_score=raw_score, start_iteration=start_iteration,
+            num_iteration=num_iteration, pred_leaf=pred_leaf,
+            pred_contrib=pred_contrib, **kwargs)
+
+    # -- fitted attributes ------------------------------------------------
+    @property
+    def n_features_(self) -> int:
+        if self._n_features < 0:
+            raise LightGBMError("No n_features found. Need to call fit first.")
+        return self._n_features
+
+    @property
+    def n_features_in_(self) -> int:
+        return self.n_features_
+
+    @property
+    def best_score_(self) -> Dict:
+        return self._best_score
+
+    @property
+    def best_iteration_(self) -> int:
+        if self._Booster is None:
+            raise LightGBMError("No best_iteration found. "
+                                "Need to call fit with early stopping first.")
+        return self._best_iteration
+
+    @property
+    def objective_(self):
+        if self._Booster is None:
+            raise LightGBMError("No objective found. Need to call fit first.")
+        return self._objective if self._objective is not None \
+            else self._Booster.params.get("objective")
+
+    @property
+    def booster_(self) -> Booster:
+        if self._Booster is None:
+            raise LightGBMError("No booster found. Need to call fit first.")
+        return self._Booster
+
+    @property
+    def evals_result_(self) -> Dict:
+        return self._evals_result
+
+    @property
+    def feature_importances_(self) -> np.ndarray:
+        if self._Booster is None:
+            raise LightGBMError("No feature_importances found. "
+                                "Need to call fit first.")
+        return self._Booster.feature_importance(
+            importance_type=self.importance_type)
+
+    @property
+    def feature_name_(self) -> List[str]:
+        if self._Booster is None:
+            raise LightGBMError("No feature_name found. "
+                                "Need to call fit first.")
+        return self._Booster.feature_name()
+
+    @property
+    def feature_names_in_(self) -> np.ndarray:
+        return np.asarray(self.feature_name_)
+
+    def __sklearn_is_fitted__(self) -> bool:
+        return getattr(self, "fitted_", False)
+
+
+class LGBMRegressor(_SKRegressorMixin, LGBMModel):
+    """reference: sklearn.py LGBMRegressor:1169."""
+
+    def fit(self, X, y, sample_weight=None, init_score=None, eval_set=None,
+            eval_names=None, eval_sample_weight=None, eval_init_score=None,
+            eval_metric=None, feature_name="auto",
+            categorical_feature="auto", callbacks=None,
+            init_model=None) -> "LGBMRegressor":
+        if self.objective is None:
+            self._objective = "regression"
+        super().fit(X, y, sample_weight=sample_weight, init_score=init_score,
+                    eval_set=eval_set, eval_names=eval_names,
+                    eval_sample_weight=eval_sample_weight,
+                    eval_init_score=eval_init_score, eval_metric=eval_metric,
+                    feature_name=feature_name,
+                    categorical_feature=categorical_feature,
+                    callbacks=callbacks, init_model=init_model)
+        return self
+
+
+class LGBMClassifier(_SKClassifierMixin, LGBMModel):
+    """reference: sklearn.py LGBMClassifier:1215."""
+
+    @property
+    def classes_(self) -> np.ndarray:
+        if self._Booster is None:
+            raise LightGBMError("No classes found. Need to call fit first.")
+        return self._classes
+
+    @property
+    def n_classes_(self) -> int:
+        if self._Booster is None:
+            raise LightGBMError("No classes found. Need to call fit first.")
+        return self._n_classes
+
+    def fit(self, X, y, sample_weight=None, init_score=None, eval_set=None,
+            eval_names=None, eval_sample_weight=None, eval_class_weight=None,
+            eval_init_score=None, eval_metric=None, feature_name="auto",
+            categorical_feature="auto", callbacks=None,
+            init_model=None) -> "LGBMClassifier":
+        y_arr = np.ravel(np.asarray(y))
+        self._classes, y_enc = np.unique(y_arr, return_inverse=True)
+        self._n_classes = len(self._classes)
+        # translate a class_weight dict keyed by ORIGINAL labels into one
+        # keyed by encoded class ids, so _compute_sample_weight (which sees
+        # encoded y) applies the intended weights
+        cw = self.class_weight
+        if isinstance(cw, dict):
+            self._class_weight = {i: cw[c] for i, c in
+                                  enumerate(self._classes) if c in cw}
+        else:
+            self._class_weight = cw
+        if self._n_classes > 2:
+            if self.objective is None or (isinstance(self.objective, str) and
+                                          self.objective == "multiclass"):
+                self._objective = "multiclass"
+            self._other_params["num_class"] = self._n_classes
+        else:
+            if self.objective is None:
+                self._objective = "binary"
+        ev_metric = eval_metric
+        if ev_metric is None and eval_set is not None:
+            ev_metric = ("multi_logloss" if self._n_classes > 2
+                         else "binary_logloss")
+        eval_set_enc = None
+        if eval_set is not None:
+            if isinstance(eval_set, tuple):
+                eval_set = [eval_set]
+            eval_set_enc = []
+            lut = {c: i for i, c in enumerate(self._classes)}
+            for vx, vy in eval_set:
+                vy_enc = np.array([lut[v] for v in np.ravel(np.asarray(vy))],
+                                  dtype=np.float64)
+                eval_set_enc.append((vx, vy_enc))
+        super().fit(X, y_enc.astype(np.float64), sample_weight=sample_weight,
+                    init_score=init_score, eval_set=eval_set_enc,
+                    eval_names=eval_names,
+                    eval_sample_weight=eval_sample_weight,
+                    eval_class_weight=eval_class_weight,
+                    eval_init_score=eval_init_score, eval_metric=ev_metric,
+                    feature_name=feature_name,
+                    categorical_feature=categorical_feature,
+                    callbacks=callbacks, init_model=init_model)
+        return self
+
+    def predict_proba(self, X, raw_score: bool = False,
+                      start_iteration: int = 0,
+                      num_iteration: Optional[int] = None,
+                      pred_leaf: bool = False, pred_contrib: bool = False,
+                      validate_features: bool = False, **kwargs):
+        result = super().predict(X, raw_score=raw_score,
+                                 start_iteration=start_iteration,
+                                 num_iteration=num_iteration,
+                                 pred_leaf=pred_leaf,
+                                 pred_contrib=pred_contrib, **kwargs)
+        if raw_score or pred_leaf or pred_contrib:
+            return result
+        if callable(self._objective):
+            # raw scores: the booster has no link function for a custom
+            # objective (reference: sklearn.py LGBMClassifier.predict_proba)
+            from .utils import log
+            log.warning("Cannot compute class probabilities or labels due to "
+                        "the usage of customized objective function; "
+                        "returning raw scores instead.")
+            return result
+        if self._n_classes > 2:
+            return result
+        result = np.asarray(result).reshape(-1)
+        return np.vstack((1.0 - result, result)).transpose()
+
+    def predict(self, X, raw_score: bool = False, start_iteration: int = 0,
+                num_iteration: Optional[int] = None, pred_leaf: bool = False,
+                pred_contrib: bool = False, validate_features: bool = False,
+                **kwargs):
+        result = self.predict_proba(X, raw_score=raw_score,
+                                    start_iteration=start_iteration,
+                                    num_iteration=num_iteration,
+                                    pred_leaf=pred_leaf,
+                                    pred_contrib=pred_contrib, **kwargs)
+        if raw_score or pred_leaf or pred_contrib or \
+                callable(self._objective):
+            return result
+        class_index = np.argmax(np.asarray(result), axis=1)
+        return self._classes[class_index]
+
+
+class LGBMRanker(LGBMModel):
+    """reference: sklearn.py LGBMRanker:1402."""
+
+    def fit(self, X, y, sample_weight=None, init_score=None, group=None,
+            eval_set=None, eval_names=None, eval_sample_weight=None,
+            eval_init_score=None, eval_group=None, eval_metric=None,
+            eval_at=(1, 2, 3, 4, 5), feature_name="auto",
+            categorical_feature="auto", callbacks=None,
+            init_model=None) -> "LGBMRanker":
+        if group is None:
+            raise ValueError("Should set group for ranking task")
+        if eval_set is not None and eval_group is None:
+            raise ValueError("Eval_group cannot be None when eval_set "
+                             "is not None")
+        if self.objective is None:
+            self._objective = "lambdarank"
+        self._other_params["eval_at"] = ",".join(str(a) for a in eval_at)
+        super().fit(X, y, sample_weight=sample_weight, init_score=init_score,
+                    group=group, eval_set=eval_set, eval_names=eval_names,
+                    eval_sample_weight=eval_sample_weight,
+                    eval_init_score=eval_init_score, eval_group=eval_group,
+                    eval_metric=eval_metric, feature_name=feature_name,
+                    categorical_feature=categorical_feature,
+                    callbacks=callbacks, init_model=init_model)
+        return self

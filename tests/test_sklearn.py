@@ -1,0 +1,198 @@
+"""sklearn estimator API tests (model: reference tests/python_package_test/test_sklearn.py)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.sklearn import LGBMClassifier, LGBMModel, LGBMRanker, LGBMRegressor
+
+
+def _make_regression(rng, n=500, f=10):
+    X = rng.normal(size=(n, f))
+    y = X[:, 0] * 3 - X[:, 1] * 2 + 0.1 * rng.normal(size=n)
+    return X, y
+
+
+def _make_binary(rng, n=500, f=10):
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + X[:, 1] > 0).astype(int)
+    return X, y
+
+
+def test_regressor_basic(rng):
+    X, y = _make_regression(rng)
+    reg = LGBMRegressor(n_estimators=30, num_leaves=15)
+    reg.fit(X, y)
+    pred = reg.predict(X)
+    assert pred.shape == (len(y),)
+    mse = np.mean((pred - y) ** 2)
+    assert mse < np.var(y) * 0.2
+    assert reg.n_features_ == 10
+    assert len(reg.feature_importances_) == 10
+    assert reg.feature_importances_.sum() > 0
+
+
+def test_classifier_binary(rng):
+    X, y = _make_binary(rng)
+    clf = LGBMClassifier(n_estimators=30, num_leaves=15)
+    clf.fit(X, y)
+    proba = clf.predict_proba(X)
+    assert proba.shape == (len(y), 2)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-6)
+    pred = clf.predict(X)
+    acc = np.mean(pred == y)
+    assert acc > 0.9
+    assert set(clf.classes_) == {0, 1}
+    assert clf.n_classes_ == 2
+
+
+def test_classifier_multiclass_string_labels(rng):
+    X = rng.normal(size=(600, 5))
+    yi = np.argmax(X[:, :3] + 0.2 * rng.normal(size=(600, 3)), axis=1)
+    y = np.array(["a", "b", "c"])[yi]
+    clf = LGBMClassifier(n_estimators=20, num_leaves=7)
+    clf.fit(X, y)
+    assert clf.n_classes_ == 3
+    proba = clf.predict_proba(X)
+    assert proba.shape == (600, 3)
+    pred = clf.predict(X)
+    assert set(pred) <= {"a", "b", "c"}
+    assert np.mean(pred == y) > 0.8
+
+
+def test_early_stopping_and_eval_set(rng):
+    X, y = _make_binary(rng, n=800)
+    Xt, yt = X[:600], y[:600]
+    Xv, yv = X[600:], y[600:]
+    clf = LGBMClassifier(n_estimators=200, num_leaves=7, learning_rate=0.3)
+    clf.fit(Xt, yt, eval_set=[(Xv, yv)],
+            callbacks=[lgb.early_stopping(5, verbose=False)])
+    assert clf.best_iteration_ > 0
+    assert "valid_0" in clf.evals_result_
+    assert "binary_logloss" in clf.evals_result_["valid_0"]
+
+
+def test_sklearn_integration(rng):
+    from sklearn.model_selection import cross_val_score
+
+    X, y = _make_binary(rng, n=300, f=5)
+    clf = LGBMClassifier(n_estimators=10, num_leaves=7)
+    scores = cross_val_score(clf, X, y, cv=3)
+    assert scores.mean() > 0.8
+
+
+def test_get_set_params():
+    clf = LGBMClassifier(n_estimators=5, max_bin=63)
+    params = clf.get_params()
+    assert params["n_estimators"] == 5
+    assert params["max_bin"] == 63
+    clf.set_params(num_leaves=9)
+    assert clf.get_params()["num_leaves"] == 9
+    import copy
+    clf2 = copy.deepcopy(clf)
+    assert clf2.get_params()["max_bin"] == 63
+
+
+def test_custom_objective_and_metric(rng):
+    X, y = _make_regression(rng)
+
+    def l2_obj(y_true, y_pred):
+        return (y_pred - y_true), np.ones_like(y_true)
+
+    def mae_metric(y_true, y_pred):
+        return "mae_custom", float(np.mean(np.abs(y_true - y_pred))), False
+
+    reg = LGBMRegressor(n_estimators=20, num_leaves=15, objective=l2_obj)
+    reg.fit(X, y, eval_set=[(X, y)], eval_metric=mae_metric)
+    pred = reg.predict(X)
+    mse = np.mean((pred - y) ** 2)
+    assert mse < np.var(y) * 0.3
+    assert "mae_custom" in str(reg.evals_result_)
+
+
+def test_ranker(rng):
+    n_q, per_q = 30, 20
+    X = rng.normal(size=(n_q * per_q, 8))
+    rel = np.clip((X[:, 0] * 2 + rng.normal(size=n_q * per_q)).astype(int) % 4,
+                  0, 3)
+    group = np.full(n_q, per_q)
+    rk = LGBMRanker(n_estimators=15, num_leaves=7)
+    rk.fit(X, rel, group=group)
+    pred = rk.predict(X)
+    assert pred.shape == (n_q * per_q,)
+    # scores should correlate with relevance
+    assert np.corrcoef(pred, rel)[0, 1] > 0.3
+
+
+def test_ranker_requires_group(rng):
+    X, y = _make_binary(rng, n=50, f=3)
+    with pytest.raises(ValueError):
+        LGBMRanker(n_estimators=2).fit(X, y)
+
+
+def test_class_weight_balanced(rng):
+    X = rng.normal(size=(600, 5))
+    y = (X[:, 0] > 1.0).astype(int)  # imbalanced
+    clf = LGBMClassifier(n_estimators=20, num_leaves=7,
+                         class_weight="balanced")
+    clf.fit(X, y)
+    pred = clf.predict(X)
+    # with balancing, the minority class must actually get predicted
+    assert pred.sum() > 0
+
+
+def test_predict_feature_mismatch(rng):
+    X, y = _make_binary(rng, n=100, f=6)
+    clf = LGBMClassifier(n_estimators=2, num_leaves=7).fit(X, y)
+    with pytest.raises(ValueError):
+        clf.predict(X[:, :4])
+
+
+def test_custom_metric_on_distinct_eval_set(rng):
+    X, y = _make_regression(rng, n=400)
+    Xv, yv = _make_regression(rng, n=100)
+
+    def mae_metric(y_true, y_pred):
+        return "mae_custom", float(np.mean(np.abs(y_true - y_pred))), False
+
+    reg = LGBMRegressor(n_estimators=10, num_leaves=7)
+    reg.fit(X, y, eval_set=[(Xv, yv)], eval_metric=mae_metric)
+    assert "mae_custom" in reg.evals_result_["valid_0"]
+
+
+def test_class_weight_dict_original_labels(rng):
+    X = rng.normal(size=(400, 4))
+    y = np.where(X[:, 0] > 1.0, "pos", "neg")
+    clf = LGBMClassifier(n_estimators=10, num_leaves=7,
+                         class_weight={"pos": 25.0})
+    clf.fit(X, y)
+    # the weight must bias the model toward the minority 'pos' class
+    assert (clf.predict(X) == "pos").sum() >= (y == "pos").sum() * 0.5
+
+
+def test_custom_objective_classifier_raw(rng):
+    X, y = _make_binary(rng)
+
+    def logloss_obj(y_true, y_pred):
+        p = 1.0 / (1.0 + np.exp(-y_pred))
+        return p - y_true, p * (1 - p)
+
+    clf = LGBMClassifier(n_estimators=20, num_leaves=7, objective=logloss_obj)
+    clf.fit(X, y)
+    raw = clf.predict(X)
+    # raw scores returned for custom objective; sign should separate classes
+    assert np.mean((raw > 0).astype(int) == y) > 0.85
+
+
+def test_cv_custom_objective(rng):
+    import lightgbm_tpu as lgb
+    X, y = _make_regression(rng, n=200, f=5)
+
+    def l2_obj(y_pred, dataset):
+        lbl = dataset.get_label()
+        return y_pred - lbl, np.ones_like(lbl)
+
+    res = lgb.cv({"objective": l2_obj, "metric": "l2", "num_leaves": 7,
+                  "verbosity": -1},
+                 lgb.Dataset(X, label=y), num_boost_round=5, nfold=2)
+    assert "valid l2-mean" in res
