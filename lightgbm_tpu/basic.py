@@ -11,6 +11,7 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, List, Optional, Sequence, Union
 
+import jax.numpy as jnp
 import numpy as np
 
 from .config import Config
@@ -385,8 +386,25 @@ class Booster:
             elif line == "average_output":
                 header["average_output"] = "1"
             i += 1
-        self.config = Config({"objective": header.get("objective", "regression").split(" ")[0],
-                              "num_class": int(header.get("num_class", 1))})
+        # restore the training parameters embedded in the model file
+        # (reference: GBDT::LoadModelFromString reads the `parameters:`
+        # section saved by SaveModelToString; Config::GetLoadedParam)
+        saved_params: Dict[str, Any] = {}
+        if "\nparameters:" in text:
+            psec = text.split("\nparameters:", 1)[1]
+            psec = psec.split("end of parameters", 1)[0]
+            for pline in psec.split("\n"):
+                pline = pline.strip()
+                if pline.startswith("[") and pline.endswith("]") \
+                        and ":" in pline:
+                    k, v = pline[1:-1].split(":", 1)
+                    saved_params[k.strip()] = v.strip()
+        saved_params.pop("task", None)
+        saved_params["objective"] = header.get(
+            "objective", saved_params.get("objective", "regression")).split(" ")[0]
+        saved_params["num_class"] = int(header.get("num_class", 1))
+        self.config = Config(saved_params)
+        self.params = dict(saved_params)
         objective = create_objective(self.config)
         self._gbdt = GBDT(self.config, None, objective)
         self._objective = objective
@@ -441,6 +459,74 @@ class Booster:
 
     def feature_name(self) -> List[str]:
         return list(self._gbdt.feature_names)
+
+    def refit(self, data, label, weight=None, group=None,
+              decay_rate: Optional[float] = None, **kwargs) -> "Booster":
+        """Refit the existing tree structures on new data: keep every split,
+        recompute leaf outputs from the new gradients
+        (reference: GBDT::RefitTree gbdt.cpp:252-290 and
+        SerialTreeLearner::FitByExistingTree; basic.py Booster.refit).
+        """
+        from .dataset import Metadata
+        from .ops.split import leaf_output as _leaf_output
+
+        g = self._gbdt
+        if not g.models:
+            raise LightGBMError("Cannot refit an empty model")
+        merged = dict(self.params)
+        merged.update(kwargs)
+        cfg = self.config.update(merged) if merged else self.config
+        decay = cfg.refit_decay_rate if decay_rate is None else decay_rate
+        mat = _to_matrix(data)
+        n = mat.shape[0]
+        K = g.num_tree_per_iteration
+
+        new_booster = Booster(model_str=self.model_to_string())
+        new_booster.config = cfg
+        ng = new_booster._gbdt
+        objective = create_objective(cfg)
+
+        meta = Metadata(n)
+        meta.set_label(label)
+        meta.set_weight(weight)
+        meta.set_group(group)
+        objective.init(meta)
+
+        leaf_preds = ng.predict_leaf_index(mat)  # (n, num_trees)
+        num_iters = len(ng.models) // K
+        scores = np.zeros((n, K) if K > 1 else n, dtype=np.float64)
+        l1, l2 = float(cfg.lambda_l1), float(cfg.lambda_l2)
+        mds = float(cfg.max_delta_step)
+        eps = 1e-15  # kEpsilon hessian floor (serial_tree_learner.cpp:251)
+        for it in range(num_iters):
+            grad, hess = objective.get_gradients(
+                np.asarray(scores, dtype=np.float64))
+            grad = np.asarray(grad, dtype=np.float64)
+            hess = np.asarray(hess, dtype=np.float64)
+            if K > 1 and grad.ndim == 1:
+                grad = grad.reshape(K, n).T
+                hess = hess.reshape(K, n).T
+            for k in range(K):
+                ti = it * K + k
+                tree = ng.models[ti]
+                gk = grad[:, k] if K > 1 else grad
+                hk = hess[:, k] if K > 1 else hess
+                leaves = leaf_preds[:, ti]
+                nl = tree.num_leaves
+                gsum = np.bincount(leaves, weights=gk, minlength=nl)
+                hsum = np.bincount(leaves, weights=hk, minlength=nl) + eps
+                out = np.asarray(
+                    _leaf_output(jnp.asarray(gsum), jnp.asarray(hsum),
+                                 l1, l2, mds),
+                    dtype=np.float64) * tree.shrinkage
+                tree.leaf_value = decay * np.asarray(tree.leaf_value) + \
+                    (1.0 - decay) * out
+                pred = tree.leaf_value[leaves]
+                if K > 1:
+                    scores[:, k] += pred
+                else:
+                    scores += pred
+        return new_booster
 
     def free_dataset(self) -> "Booster":
         return self

@@ -1,0 +1,263 @@
+"""Command-line application.
+
+TPU-native re-implementation of the reference CLI (src/main.cpp,
+src/application/application.{h,cpp}): `key=value` argv plus a `config=` file,
+tasks train | predict | convert_model | refit | save_binary.
+
+Usage:  python -m lightgbm_tpu task=train config=train.conf [key=value ...]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .basic import Booster, Dataset
+from .config import Config
+from .engine import train as _train
+from .utils import log
+from .utils.textio import load_text_file
+
+__all__ = ["Application", "main"]
+
+
+def parse_config_file(path: str) -> Dict[str, str]:
+    """Parse a reference-format config file: `key = value` lines, `#` comments
+    (reference: application.cpp Application::LoadParameters / ConfigFile)."""
+    out: Dict[str, str] = {}
+    with open(path) as fh:
+        for line in fh:
+            line = line.split("#", 1)[0].strip()
+            if not line or "=" not in line:
+                continue
+            k, v = line.split("=", 1)
+            out[k.strip()] = v.strip()
+    return out
+
+
+def parse_argv(argv: List[str]) -> Dict[str, str]:
+    """reference: application.cpp Application(argc, argv):31-86 — argv
+    `key=value` pairs override config-file values."""
+    cli: Dict[str, str] = {}
+    for arg in argv:
+        if "=" not in arg:
+            log.warning("Unknown argument (expected key=value): %s", arg)
+            continue
+        k, v = arg.split("=", 1)
+        cli[k.strip()] = v.strip()
+    params: Dict[str, str] = {}
+    if "config" in cli:
+        params.update(parse_config_file(cli["config"]))
+    params.update(cli)  # command line overrides config file
+    return params
+
+
+class Application:
+    """reference: src/application/application.h Application."""
+
+    def __init__(self, argv: List[str]):
+        self.raw_params = parse_argv(argv)
+        self.config = Config(self.raw_params)
+
+    def run(self) -> None:
+        task = self.config.task
+        if task == "train":
+            self.train()
+        elif task in ("predict", "prediction", "test"):
+            self.predict()
+        elif task == "convert_model":
+            self.convert_model()
+        elif task == "refit":
+            self.refit()
+        elif task == "save_binary":
+            self.save_binary()
+        else:
+            log.fatal("Unknown task: %s", task)
+
+    # ------------------------------------------------------------------
+    def _load_train_data(self) -> Dataset:
+        cfg = self.config
+        if not cfg.data:
+            log.fatal("No training data file specified (data=)")
+        loaded = load_text_file(
+            cfg.data, has_header=cfg.header, label_column=cfg.label_column,
+            weight_column=cfg.weight_column, group_column=cfg.group_column,
+            ignore_column=cfg.ignore_column)
+        ds = Dataset(loaded.X, label=loaded.label, weight=loaded.weight,
+                     group=loaded.group,
+                     feature_name=loaded.feature_names or "auto",
+                     params=dict(self.raw_params))
+        return ds
+
+    def train(self) -> None:
+        cfg = self.config
+        train_set = self._load_train_data()
+        valid_sets: List[Dataset] = []
+        valid_names: List[str] = []
+        if cfg.valid:
+            for i, vf in enumerate(str(cfg.valid).split(",")):
+                vf = vf.strip()
+                if not vf:
+                    continue
+                vl = load_text_file(
+                    vf, has_header=cfg.header, label_column=cfg.label_column,
+                    weight_column=cfg.weight_column,
+                    group_column=cfg.group_column,
+                    ignore_column=cfg.ignore_column)
+                valid_sets.append(Dataset(
+                    vl.X, label=vl.label, weight=vl.weight, group=vl.group,
+                    reference=train_set, params=dict(self.raw_params)))
+                valid_names.append(os.path.basename(vf))
+        init_model = cfg.input_model or None
+        booster = _train(dict(self.raw_params), train_set,
+                         num_boost_round=cfg.num_iterations,
+                         valid_sets=valid_sets or None,
+                         valid_names=valid_names or None,
+                         init_model=init_model)
+        booster.save_model(cfg.output_model)
+        log.info("Finished training; model saved to %s", cfg.output_model)
+
+    def predict(self) -> None:
+        cfg = self.config
+        if not cfg.input_model:
+            log.fatal("task=predict requires input_model=")
+        if not cfg.data:
+            log.fatal("task=predict requires data=")
+        booster = Booster(model_file=cfg.input_model)
+        loaded = load_text_file(
+            cfg.data, has_header=cfg.header, label_column=cfg.label_column,
+            ignore_column=cfg.ignore_column)
+        preds = booster.predict(
+            loaded.X, raw_score=bool(cfg.predict_raw_score),
+            pred_leaf=bool(cfg.predict_leaf_index),
+            pred_contrib=bool(cfg.predict_contrib),
+            num_iteration=cfg.num_iteration_predict)
+        preds = np.asarray(preds)
+        with open(cfg.output_result, "w") as fh:
+            if preds.ndim == 1:
+                fh.write("\n".join(repr(float(v)) for v in preds))
+            else:
+                fh.write("\n".join("\t".join(repr(float(v)) for v in row)
+                                   for row in preds))
+            fh.write("\n")
+        log.info("Finished prediction; results saved to %s", cfg.output_result)
+
+    def refit(self) -> None:
+        cfg = self.config
+        if not cfg.input_model:
+            log.fatal("task=refit requires input_model=")
+        booster = Booster(model_file=cfg.input_model)
+        loaded = load_text_file(
+            cfg.data, has_header=cfg.header, label_column=cfg.label_column,
+            weight_column=cfg.weight_column, group_column=cfg.group_column,
+            ignore_column=cfg.ignore_column)
+        extra = {k: v for k, v in self.raw_params.items()
+                 if k not in ("task", "config", "data", "input_model",
+                              "output_model", "valid")}
+        new_booster = booster.refit(loaded.X, loaded.label,
+                                    weight=loaded.weight, group=loaded.group,
+                                    decay_rate=cfg.refit_decay_rate, **extra)
+        new_booster.save_model(cfg.output_model)
+        log.info("Finished refit; model saved to %s", cfg.output_model)
+
+    def save_binary(self) -> None:
+        cfg = self.config
+        ds = self._load_train_data()
+        ds.construct(dict(self.raw_params))
+        out = cfg.data + ".bin"
+        ds.save_binary(out)
+        log.info("Saved binary dataset to %s", out)
+
+    def convert_model(self) -> None:
+        cfg = self.config
+        if not cfg.input_model:
+            log.fatal("task=convert_model requires input_model=")
+        language = cfg.convert_model_language or "cpp"
+        if language not in ("cpp", "c++"):
+            log.fatal("Only convert_model_language=cpp is supported")
+        booster = Booster(model_file=cfg.input_model)
+        code = model_to_cpp(booster)
+        with open(cfg.convert_model, "w") as fh:
+            fh.write(code)
+        log.info("Converted model written to %s", cfg.convert_model)
+
+
+def model_to_cpp(booster: Booster) -> str:
+    """Generate standalone C++ if-else prediction code from a model
+    (reference: gbdt_model_text.cpp GBDT::ModelToIfElse)."""
+    g = booster._gbdt
+    K = g.num_tree_per_iteration
+    out: List[str] = [
+        "// Generated by lightgbm_tpu task=convert_model",
+        "#include <cmath>",
+        "#include <cstring>",
+        "",
+        f"static const int kNumClass = {g.num_class};",
+        f"static const int kNumTreePerIteration = {K};",
+        f"static const int kMaxFeatureIdx = {g.max_feature_idx};",
+        "",
+    ]
+
+    def emit_node(tree, nid: int, depth: int, lines: List[str]) -> None:
+        ind = "  " * depth
+        if nid < 0:
+            leaf = ~nid
+            lines.append(f"{ind}return {float(tree.leaf_value[leaf])!r};")
+            return
+        f = int(tree.split_feature[nid])
+        cat, default_left, _missing = tree.unpack_decision_type(
+            int(tree.decision_type[nid]))
+        if cat:
+            cats = tree.cat_threshold_values(nid) \
+                if hasattr(tree, "cat_threshold_values") else []
+            cond = " || ".join(f"fval == {c}.0" for c in cats) or "false"
+            lines.append(f"{ind}{{ const double fval = arr[{f}];")
+            lines.append(f"{ind}if (!std::isnan(fval) && ({cond})) {{")
+        else:
+            thr = float(tree.threshold[nid])
+            lines.append(f"{ind}{{ const double fval = arr[{f}];")
+            if default_left:
+                lines.append(
+                    f"{ind}if (std::isnan(fval) || fval <= {thr!r}) {{")
+            else:
+                lines.append(
+                    f"{ind}if (!std::isnan(fval) && fval <= {thr!r}) {{")
+        emit_node(tree, int(tree.left_child[nid]), depth + 1, lines)
+        lines.append(f"{ind}}} else {{")
+        emit_node(tree, int(tree.right_child[nid]), depth + 1, lines)
+        lines.append(f"{ind}}} }}")
+
+    for i, tree in enumerate(g.models):
+        out.append(f"static double PredictTree{i}(const double* arr) {{")
+        body: List[str] = []
+        if tree.num_leaves <= 1:
+            body.append(f"  return {float(tree.leaf_value[0])!r};")
+        else:
+            emit_node(tree, 0, 1, body)
+        out.extend(body)
+        out.append("}")
+        out.append("")
+
+    out.append("void Predict(const double* features, double* output) {")
+    out.append(f"  for (int k = 0; k < kNumTreePerIteration; ++k) "
+               f"output[k] = 0.0;")
+    for i in range(len(g.models)):
+        out.append(f"  output[{i % K}] += PredictTree{i}(features);")
+    out.append("}")
+    out.append("")
+    return "\n".join(out)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    app = Application(argv)
+    app.run()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

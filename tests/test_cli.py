@@ -1,0 +1,158 @@
+"""CLI tests (model: reference tests/python_package_test/test_consistency.py
+and examples/*/train.conf)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.cli import Application, model_to_cpp, parse_config_file
+from lightgbm_tpu.utils.textio import load_text_file
+
+
+@pytest.fixture
+def workdir(tmp_path, rng):
+    """Write a small binary-classification dataset as TSV (reference example
+    format: label first, no header) plus a train.conf."""
+    n, f = 400, 6
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + X[:, 1] > 0).astype(int)
+    train = np.column_stack([y, X])
+    np.savetxt(tmp_path / "train.tsv", train, delimiter="\t", fmt="%.6f")
+    np.savetxt(tmp_path / "test.tsv", train[:100], delimiter="\t", fmt="%.6f")
+    conf = tmp_path / "train.conf"
+    conf.write_text(
+        "task = train\n"
+        "objective = binary  # comment here\n"
+        "data = {d}/train.tsv\n"
+        "valid = {d}/test.tsv\n"
+        "num_trees = 15\n"
+        "num_leaves = 15\n"
+        "# full-line comment\n"
+        "learning_rate = 0.2\n"
+        "output_model = {d}/model.txt\n"
+        "verbosity = -1\n".format(d=tmp_path))
+    return tmp_path
+
+
+def test_parse_config_file(workdir):
+    params = parse_config_file(str(workdir / "train.conf"))
+    assert params["objective"] == "binary"
+    assert params["num_trees"] == "15"
+    assert "learning_rate" in params
+
+
+def test_cli_train_then_predict(workdir):
+    Application([f"config={workdir}/train.conf"]).run()
+    model_file = workdir / "model.txt"
+    assert model_file.exists()
+    assert "tree" in model_file.read_text()[:10]
+
+    out = workdir / "preds.txt"
+    Application([
+        "task=predict", f"data={workdir}/test.tsv",
+        f"input_model={model_file}", f"output_result={out}",
+        "verbosity=-1",
+    ]).run()
+    preds = np.loadtxt(out)
+    assert preds.shape == (100,)
+    assert (preds >= 0).all() and (preds <= 1).all()
+    # predictions should actually classify the training subset well
+    labels = np.loadtxt(workdir / "test.tsv", delimiter="\t")[:, 0]
+    assert (((preds > 0.5) == (labels > 0.5)).mean()) > 0.9
+
+
+def test_cli_argv_overrides_config(workdir):
+    Application([f"config={workdir}/train.conf", "num_trees=3",
+                 f"output_model={workdir}/m3.txt"]).run()
+    text = (workdir / "m3.txt").read_text()
+    assert text.count("Tree=") == 3
+
+
+def test_cli_refit(workdir):
+    Application([f"config={workdir}/train.conf"]).run()
+    Application([
+        "task=refit", f"config={workdir}/train.conf",
+        f"input_model={workdir}/model.txt",
+        f"output_model={workdir}/refit.txt",
+    ]).run()
+    assert (workdir / "refit.txt").exists()
+    # refit model predicts comparably on its own training data
+    from lightgbm_tpu import Booster
+    loaded = load_text_file(str(workdir / "test.tsv"))
+    p = Booster(model_file=str(workdir / "refit.txt")).predict(loaded.X)
+    assert (((p > 0.5) == (loaded.label > 0.5)).mean()) > 0.85
+
+
+def test_cli_convert_model(workdir):
+    Application([f"config={workdir}/train.conf", "num_trees=3",
+                 f"output_model={workdir}/m.txt"]).run()
+    Application([
+        "task=convert_model", f"input_model={workdir}/m.txt",
+        f"convert_model={workdir}/pred.cpp",
+        "convert_model_language=cpp",
+    ]).run()
+    code = (workdir / "pred.cpp").read_text()
+    assert "PredictTree0" in code and "void Predict" in code
+
+
+def test_convert_model_compiles_and_matches(workdir, tmp_path):
+    """The generated C++ must compile and reproduce raw predictions
+    (reference: convert_model produces compilable gbdt_prediction.cpp)."""
+    import ctypes
+
+    Application([f"config={workdir}/train.conf", "num_trees=5",
+                 f"output_model={workdir}/m.txt"]).run()
+    from lightgbm_tpu import Booster
+    bst = Booster(model_file=str(workdir / "m.txt"))
+    code = model_to_cpp(bst)
+    src = tmp_path / "pred.cpp"
+    src.write_text(code + '\nextern "C" void PredictC(const double* f, '
+                   'double* o) { Predict(f, o); }\n')
+    lib = tmp_path / "pred.so"
+    subprocess.check_call(["g++", "-O1", "-shared", "-fPIC",
+                           str(src), "-o", str(lib)])
+    so = ctypes.CDLL(str(lib))
+    loaded = load_text_file(str(workdir / "test.tsv"))
+    expect = bst.predict(loaded.X, raw_score=True)
+    got = np.empty(1, dtype=np.float64)
+    row = np.ascontiguousarray(loaded.X[0], dtype=np.float64)
+    so.PredictC(row.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                got.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+    np.testing.assert_allclose(got[0], expect[0], rtol=1e-10)
+
+
+def test_textio_libsvm(tmp_path):
+    (tmp_path / "d.svm").write_text(
+        "1 0:1.5 3:2.0\n0 1:0.5\n1 0:3.0 2:1.0\n")
+    loaded = load_text_file(str(tmp_path / "d.svm"))
+    assert loaded.X.shape == (3, 4)
+    np.testing.assert_array_equal(loaded.label, [1, 0, 1])
+    assert loaded.X[0, 3] == 2.0 and loaded.X[1, 1] == 0.5
+
+
+def test_textio_header_and_columns(tmp_path):
+    (tmp_path / "d.csv").write_text(
+        "id,target,w,f1,f2\n"
+        "1,0.5,1.0,3.0,4.0\n"
+        "2,1.5,2.0,5.0,6.0\n")
+    loaded = load_text_file(str(tmp_path / "d.csv"), has_header=True,
+                            label_column="name:target",
+                            weight_column="name:w",
+                            ignore_column="name:id")
+    np.testing.assert_array_equal(loaded.label, [0.5, 1.5])
+    np.testing.assert_array_equal(loaded.weight, [1.0, 2.0])
+    assert loaded.X.shape == (2, 2)
+    assert loaded.feature_names == ["f1", "f2"]
+
+
+def test_cli_module_entry(workdir):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    subprocess.check_call(
+        [sys.executable, "-m", "lightgbm_tpu",
+         f"config={workdir}/train.conf", "num_trees=2",
+         f"output_model={workdir}/m2.txt"],
+        env=env, cwd="/root/repo")
+    assert (workdir / "m2.txt").exists()
