@@ -115,7 +115,8 @@ class GBDT:
         self.need_bagging = (not self.goss and cfg.bagging_freq > 0
                              and cfg.bagging_fraction < 1.0)
         self._cached_bag = None
-        self.train_binned = self.learner.binned_dev[: self.num_data]
+        self.train_binned = self.learner._part0[
+            self.learner.row0: self.learner.row0 + self.num_data]
 
         self._traverse_train = jax.jit(
             lambda nodes, binned: predict_leaf_binned(binned, nodes))
@@ -150,31 +151,27 @@ class GBDT:
         g, h = self.objective.get_gradients(self.scores)
         return g, h
 
-    def _bagging_indices(self, it: int):
-        """Row sampling (reference: bagging.hpp / goss.hpp).
-
-        Returns (indices_padded (N_pad,), bag_cnt, grad_scale fn or None).
-        """
+    def _bagging_mask(self, it: int):
+        """Row sampling (reference: bagging.hpp).  Returns (mask (N,) bool or
+        None, bag_cnt).  The learner never gathers rows: out-of-bag rows keep
+        their place with zeroed gradients (TPU row gathers are latency-bound,
+        masking is bandwidth-free)."""
         cfg = self.config
         N = self.num_data
-        if self.goss:
-            return None  # handled in _goss_sample with gradients
         if not self.need_bagging:
-            idx, cnt = self.learner.init_indices(None)
-            return idx, cnt
+            return None, None
         if it % cfg.bagging_freq == 0 or self._cached_bag is None:
             self.bag_rng, sub = jax.random.split(self.bag_rng)
             cnt = max(int(N * cfg.bagging_fraction), 1)
-            perm = jax.random.permutation(sub, N).astype(jnp.int32)
-            pad = jnp.full((self.learner.N_pad - N,), N, dtype=jnp.int32)
-            idx = jnp.concatenate([perm, pad])
-            self._cached_bag = (idx, cnt)
+            mask = jnp.zeros((N,), bool).at[
+                jax.random.permutation(sub, N)[:cnt]].set(True)
+            self._cached_bag = (mask, cnt)
         return self._cached_bag
 
     def _goss_sample(self, grad, hess, it: int):
         """GOSS (reference: goss.hpp Helper:116-165): keep the top_rate fraction
         by |g*h|, sample other_rate of the rest and up-weight by
-        (1-top_rate)/other_rate."""
+        (1-top_rate)/other_rate.  Unselected rows get zeroed gradients."""
         cfg = self.config
         N = self.num_data
         if grad.ndim == 2:
@@ -192,19 +189,16 @@ class GBDT:
         keep_other = (~is_top) & (jax.random.uniform(sub, (N,)) < prob)
         selected = is_top | keep_other
         multiply = (N - top_k) / other_k
-        scale = jnp.where(keep_other, multiply, 1.0)
+        scale = jnp.where(keep_other, multiply, 0.0)
+        scale = jnp.where(is_top, 1.0, scale)
         if grad.ndim == 2:
             grad = grad * scale[:, None]
             hess = hess * scale[:, None]
         else:
             grad = grad * scale
             hess = hess * scale
-        # pack selected rows to the front (stable)
-        order = jnp.argsort(jnp.where(selected, 0, 1), stable=True)
         cnt = jnp.sum(selected.astype(jnp.int32))
-        pad = jnp.full((self.learner.N_pad - N,), N, dtype=jnp.int32)
-        idx = jnp.concatenate([order.astype(jnp.int32), pad])
-        return grad, hess, idx, cnt
+        return grad, hess, selected, cnt
 
     def _feature_mask(self, it: int):
         frac = float(self.config.feature_fraction)
@@ -233,15 +227,22 @@ class GBDT:
                 hess = hess.reshape(self.num_tree_per_iteration, self.num_data).T
 
         use_sharded = self.sharded_builder is not None
+        bag_mask = bag_cnt = None
         if use_sharded:
-            indices = bag_cnt = None
             if self.goss or self.need_bagging:
                 log.warning("bagging/GOSS row sampling is not yet supported by "
                             "the distributed tree learners; using all rows")
         elif self.goss:
-            grad, hess, indices, bag_cnt = self._goss_sample(grad, hess, self.iter)
+            grad, hess, bag_mask, bag_cnt = self._goss_sample(
+                grad, hess, self.iter)
         else:
-            indices, bag_cnt = self._bagging_indices(self.iter)
+            bag_mask, bag_cnt = self._bagging_mask(self.iter)
+            if bag_mask is not None:
+                m = bag_mask if grad.ndim == 1 else bag_mask[:, None]
+                grad = jnp.where(m, grad, 0.0)
+                hess = jnp.where(m, hess, 0.0)
+        self._bag_mask_host = (np.asarray(bag_mask)
+                               if bag_mask is not None else None)
 
         feature_mask = self._feature_mask(self.iter)
         K = self.num_tree_per_iteration
@@ -252,8 +253,7 @@ class GBDT:
             if use_sharded:
                 record = self.sharded_builder.build_tree(gk, hk, feature_mask)
             else:
-                record = self.learner.build_tree(gk, hk, indices, bag_cnt,
-                                                 feature_mask)
+                record = self.learner.build_tree(gk, hk, bag_cnt, feature_mask)
             num_nodes = int(record["s"])
             if num_nodes > 0:
                 should_stop = False
@@ -330,6 +330,11 @@ class GBDT:
                 continue
             rows = indices[s:s + c]
             rows = rows[rows < self.num_data]
+            bm = getattr(self, "_bag_mask_host", None)
+            if bm is not None:
+                rows = rows[bm[rows]]
+                if len(rows) == 0:
+                    continue
             resid = label[rows] - score[rows]
             new_values[leaf] = _weighted_percentile_host(
                 resid, None if w is None else w[rows], alpha)

@@ -8,16 +8,24 @@ search, leaf partition, tree-structure update — runs inside one jitted
 ``lax.while_loop``; no per-split host round-trips.
 
 Key TPU adaptations vs. the CUDA design:
-  * Histograms are MXU one-hot matmuls (ops/histogram.py), not shared-memory
+  * Rows are **physically partitioned by leaf**: the binned matrix, the
+    grad/hess pair and the original row ids are reordered together on every
+    split, so each leaf occupies one contiguous row range.  Histograms then
+    read straight HBM slices — the random-index gathers that a literal port
+    of the CUDA learner (leaf index lists + gather) would need are absent,
+    because TPU gathers are latency-bound while contiguous DMA runs at full
+    HBM bandwidth.  This mirrors the effect of CUDADataPartition's
+    SplitInnerKernel (cuda_data_partition.cu:907) which also moves payload.
+  * Histograms are MXU one-hot matmuls over the leaf slice (ops/histogram.py:
+    Pallas kernel on TPU, chunked einsum elsewhere), not shared-memory
     atomics.
-  * The leaf partition is a chunked stable two-pass prefix-sum scatter
-    (CUDA uses a bitvector + block prefix sums, cuda_data_partition.cu:679;
-    here per-chunk left-counts + exclusive scan give every row its
-    destination, written through a scratch buffer).
-  * Variable leaf sizes inside the static-shape jit are handled by
-    fixed-size row chunks with a *dynamic* trip count (``lax.fori_loop``),
-    so one compiled program serves every leaf size with at most one
-    chunk of padding overhead.
+  * The leaf partition is a single sequential pass over fixed-size chunks
+    with a running (left, right) offset carry: lefts are packed forward from
+    the range start, rights backward from the range end (stability across
+    chunks is not required — histogram sums and future partitions are
+    order-invariant), then the scratch range is copied back.
+  * Variable leaf sizes inside the static-shape jit are handled by fixed-size
+    row chunks with a *dynamic* trip count (``lax.fori_loop``).
   * The smaller child's histogram is computed, the larger one obtained by
     subtraction from the parent (reference: serial_tree_learner.cpp:334-374,
     FeatureHistogram::Subtract), with per-leaf histogram slots in HBM
@@ -27,7 +35,6 @@ Key TPU adaptations vs. the CUDA design:
 from __future__ import annotations
 
 import functools
-import math
 from typing import Any, Dict, Optional
 
 import jax
@@ -37,11 +44,18 @@ import numpy as np
 from ..config import Config
 from ..dataset import BinnedDataset
 from ..ops import split as split_ops
-from ..ops.histogram import histogram_leaf
+from ..ops.histogram import leaf_hist_pallas, leaf_hist_slice
 from ..ops.partition import split_decision
 from ..utils import log
 
 NEG_INF = float("-inf")
+
+
+def _pow2ceil(x: int) -> int:
+    p = 1
+    while p < x:
+        p *= 2
+    return p
 
 
 class SerialTreeLearner:
@@ -115,23 +129,47 @@ class SerialTreeLearner:
         self.fix_mask = jnp.asarray(fix_mask)
         self.default_pos = jnp.asarray(default_pos)
 
-        # ---- binned matrix with sentinel row ----
+        # ---- row geometry ----
         if local_num_data is None:
-            binned = dataset.binned
-            if binned is None:
+            if dataset.binned is None:
                 raise ValueError("dataset has no binned data")
-            sentinel = np.zeros((1, binned.shape[1]), dtype=binned.dtype)
-            self.binned_dev = jnp.asarray(np.concatenate([binned, sentinel], axis=0))
             self.N = dataset.num_data
         else:
-            # SPMD: the (local_rows+1, G) shard arrives as an argument
-            self.binned_dev = None
             self.N = local_num_data
+        self.row_chunk = min(int(config.tpu_row_chunk),
+                             max(_pow2ceil(self.N), 256))
+        C = self.row_chunk
+        # layout: [C front-pad rows][N data rows][>=C tail-pad rows]; the
+        # front pad keeps the right-aligned partition windows non-negative,
+        # the tail pad keeps chunk windows in bounds.  Root range starts at C.
+        self.row0 = C
+        self.N_pad = C + ((self.N + C - 1) // C + 1) * C
+        self._use_pallas = (jax.default_backend() == "tpu"
+                            and config.tpu_hist_kernel == "pallas")
 
-        # ---- chunked processing geometry ----
-        self.row_chunk = min(int(config.tpu_row_chunk), max(self.N, 8))
-        self.max_chunks = (self.N + self.row_chunk - 1) // self.row_chunk + 1
-        self.N_pad = self.N + self.row_chunk + 1
+        # Packed row layout: every row's full payload lives in one uint8
+        # matrix [bins bytes | grad f32 | hess f32 | rowid i32] so that the
+        # partition moves rows with ONE vectorized row-gather + contiguous
+        # window writes (1-D gathers/scatters serialize on TPU; 2-D row
+        # gathers vectorize).  Rows are never gathered by bag index:
+        # bagging/GOSS zero the out-of-bag gradients instead.
+        self.bin_dtype = (dataset.binned.dtype if dataset.binned is not None
+                          else np.uint8)
+        self.bin_itemsize = int(np.dtype(self.bin_dtype).itemsize)
+        self.Gb = self.G * self.bin_itemsize
+        self.W = self.Gb + 12
+        self._bins_bytes = None
+        if local_num_data is None:
+            binned = dataset.binned
+            raw = np.ascontiguousarray(binned).view(np.uint8).reshape(
+                self.N, self.Gb)
+            front = np.zeros((C, self.Gb), np.uint8)
+            tail = np.zeros((self.N_pad - C - self.N, self.Gb), np.uint8)
+            self._bins_bytes = jnp.asarray(np.concatenate([front, raw, tail]))
+        iota = np.arange(self.N_pad, dtype=np.int32)
+        rid = np.where((iota >= C) & (iota < C + self.N), iota - C, self.N)
+        self._id_bytes = jnp.asarray(
+            np.ascontiguousarray(rid).view(np.uint8).reshape(self.N_pad, 4))
 
         # ---- scalars ----
         self.l1 = float(config.lambda_l1)
@@ -144,114 +182,100 @@ class SerialTreeLearner:
 
         self._best_split_vmapped = jax.vmap(
             self._leaf_best_split, in_axes=(0, 0, 0, 0, 0, None))
-        self._build_jit = jax.jit(self._build_tree_impl)
+        self._build = jax.jit(self._build_impl)
 
     # ------------------------------------------------------------------
-    def init_indices(self, bag_indices: Optional[np.ndarray] = None):
-        """Build the padded partition index array (host helper)."""
-        idx = np.full(self.N_pad, self.N, dtype=np.int32)
-        if bag_indices is None:
-            idx[: self.N] = np.arange(self.N, dtype=np.int32)
-            cnt = self.N
-        else:
-            cnt = len(bag_indices)
-            idx[:cnt] = bag_indices
-        return jnp.asarray(idx), cnt
+    def _hist_leaf(self, part, start, cnt):
+        return leaf_hist_slice(part, start, cnt, num_features=self.G,
+                               bin_itemsize=self.bin_itemsize,
+                               num_bins=self.B, row_chunk=self.row_chunk,
+                               vary=self._pvary)
 
-    # ------------------------------------------------------------------
-    def _hist_leaf(self, binned, indices, start, cnt, grad, hess):
-        """Histogram of one leaf's rows via dynamically-counted fixed chunks.
+    def _goes_left(self, colv, scalars):
+        """Per-row decision from raw group-column values.
 
-        One compiled program serves every leaf size: ``n_chunks`` is a traced
-        value, so ``fori_loop`` lowers to a while loop with a fixed-shape body
-        (the MXU one-hot matmul over one chunk).
+        Bundled features decode bin b (≠ default) at offset ``bstart + b``
+        (reference: FeatureGroup bin offsets, include/LightGBM/feature_group.h).
         """
-        C = self.row_chunk
-        G, B = self.G, self.B
-        n_chunks = (cnt + C - 1) // C
-        iota_b = jax.lax.broadcasted_iota(jnp.int32, (1, B, 1), 1)
-
-        def body(ci, acc):
-            idx = jax.lax.dynamic_slice(indices, (start + ci * C,), (C,))
-            gpos = ci * C + jax.lax.iota(jnp.int32, C)
-            valid = (gpos < cnt).astype(jnp.float32)
-            bins = jnp.take(binned, idx, axis=0)               # (C, G)
-            g = jnp.take(grad, idx, mode="clip") * valid
-            h = jnp.take(hess, idx, mode="clip") * valid
-            gh = jnp.stack([g, h], axis=1)
-            onehot = (bins.T[:, None, :].astype(jnp.int32) == iota_b)
-            return acc + jnp.einsum("gbc,cj->gbj", onehot.astype(jnp.float32),
-                                    gh, preferred_element_type=jnp.float32)
-
-        acc0 = self._pvary(jnp.zeros((G, B, 2), dtype=jnp.float32))
-        return jax.lax.fori_loop(0, n_chunks, body, acc0)
-
-    def _goes_left(self, binned_flat, idx, scalars):
-        col, bstart, isb, nb, dbin, mtype, thr, dl = scalars
-        gb = jnp.take(binned_flat, idx * self.G + col, mode="clip")
+        bstart, isb, nb, dbin, mtype, thr, dl = scalars
+        gb = colv.astype(jnp.int32)
         fb_raw = gb - bstart
         in_r = (fb_raw >= 1) & (fb_raw <= nb - 1)
         fb = jnp.where(isb == 1, jnp.where(in_r, fb_raw, dbin), gb)
         return split_decision(fb, thr, dl, mtype, dbin, nb - 1)
 
-    def _partition_leaf(self, binned_flat, indices, scratch, start, cnt,
-                        decision_scalars, leaf, new_leaf):
-        """Stable two-way partition of the leaf's index range, chunked.
+    def _partition_leaf(self, st, start, cnt, col, decision_scalars):
+        """Two-way partition of the contiguous leaf range [start, start+cnt).
 
-        Pass 1 counts left-goers per chunk; an exclusive scan turns those into
-        per-chunk base offsets; pass 2 scatters every row to its final
-        position in a scratch buffer (stable within chunk via prefix sums);
-        pass 3 copies the range back.  This is the TPU analog of the CUDA
+        TPUs scatter into HBM one element at a time (scalar-core DMA), so the
+        global scatter a literal CUDA port would use is off the table.
+        Instead each fixed-size chunk is compacted LOCALLY (VMEM-sized
+        argsort/permute into [lefts | pad | rights]) and the compacted block
+        is written with two contiguous read-blend-write window updates —
+        lefts packed forward from ``start`` at running offset ``nl``, rights
+        packed backward from ``start + cnt``.  All HBM traffic is bulk DMA.
+        A second pass copies the scratch range back.  This replaces the CUDA
         bitvector + AggregateBlockOffset + SplitInner kernels
-        (cuda_data_partition.cu:288-907) without atomics.
+        (cuda_data_partition.cu:288-907).
         """
         C = self.row_chunk
+        W = self.W
+        isz = self.bin_itemsize
         n_chunks = (cnt + C - 1) // C
-        big = jnp.int32(self.N_pad + C)  # out-of-bounds => dropped by scatter
+        part = st["part"]
 
-        def chunk_view(ci):
-            idx = jax.lax.dynamic_slice(indices, (start + ci * C,), (C,))
-            gpos = ci * C + jax.lax.iota(jnp.int32, C)
-            valid = gpos < cnt
-            gl = self._goes_left(binned_flat, idx, decision_scalars) & valid
-            return idx, valid, gl
-
-        def pass1(ci, counts):
-            _, _, gl = chunk_view(ci)
-            return counts.at[ci].set(jnp.sum(gl.astype(jnp.int32)))
-
-        counts = jax.lax.fori_loop(
-            0, n_chunks, pass1,
-            self._pvary(jnp.zeros((self.max_chunks,), jnp.int32)))
-        left_bases = jnp.cumsum(counts) - counts
-        total_left = jnp.sum(counts)
-
-        def pass2(ci, scratch_):
-            idx, valid, gl = chunk_view(ci)
-            gr = valid & ~gl
-            lb = left_bases[ci]
-            valid_before = jnp.minimum(ci * C, cnt)
-            rb = valid_before - lb
-            lrank = jnp.cumsum(gl.astype(jnp.int32)) - gl.astype(jnp.int32)
-            rrank = jnp.cumsum(gr.astype(jnp.int32)) - gr.astype(jnp.int32)
-            dest = jnp.where(gl, start + lb + lrank,
-                             start + total_left + rb + rrank)
-            dest = jnp.where(valid, dest, big)
-            return scratch_.at[dest].set(idx, mode="drop")
-
-        scratch = jax.lax.fori_loop(0, n_chunks, pass2, scratch)
-
-        def pass3(ci, indices_):
-            off = start + ci * C
-            sl = jax.lax.dynamic_slice(scratch, (off,), (C,))
-            cur = jax.lax.dynamic_slice(indices_, (off,), (C,))
-            gpos = ci * C + jax.lax.iota(jnp.int32, C)
-            valid = gpos < cnt
+        def blend(dst, val, off, mask):
+            win = jax.lax.dynamic_slice(dst, (off, 0), val.shape)
             return jax.lax.dynamic_update_slice(
-                indices_, jnp.where(valid, sl, cur), (off,))
+                dst, jnp.where(mask[:, None], val, win), (off, 0))
 
-        indices = jax.lax.fori_loop(0, n_chunks, pass3, indices)
-        return indices, scratch, total_left
+        def col_values(chunk):
+            raw = jax.lax.dynamic_slice(chunk, (0, col * isz), (C, isz))
+            if isz == 1:
+                return raw[:, 0].astype(jnp.int32)
+            return jax.lax.bitcast_convert_type(raw, jnp.uint16).astype(
+                jnp.int32)[:, 0]
+
+        def scatter_pass(ci, carry):
+            nl, nr, sc = carry
+            row0 = start + ci * C
+            chunk = jax.lax.dynamic_slice(part, (row0, 0), (C, W))
+            colv = col_values(chunk)
+            valid = (ci * C + jax.lax.iota(jnp.int32, C)) < cnt
+            gl = self._goes_left(colv, decision_scalars) & valid
+            gr = valid & ~gl
+            gli = gl.astype(jnp.int32)
+            gri = gr.astype(jnp.int32)
+            inv = (~valid).astype(jnp.int32)
+            nlc = jnp.sum(gli)
+            nrc = jnp.sum(gri)
+            lrank = jnp.cumsum(gli) - gli
+            rrank = jnp.cumsum(gri) - gri
+            irank = jnp.cumsum(inv) - inv
+            # local destination: [lefts | padding | rights(right-aligned)]
+            dloc = jnp.where(gl, lrank,
+                             jnp.where(gr, C - nrc + rrank, nlc + irank))
+            order = jnp.argsort(dloc)
+            compacted = jnp.take(chunk, order, axis=0)   # one ROW gather
+            iot = jax.lax.iota(jnp.int32, C)
+            # lefts window [start+nl, +C), mask first nlc rows
+            sc = blend(sc, compacted, start + nl, iot < nlc)
+            # rights window [start+cnt-nr-C, +C), mask last nrc rows; the
+            # front pad rows of the arrays keep this offset non-negative
+            sc = blend(sc, compacted, start + cnt - nr - C, iot >= C - nrc)
+            return nl + nlc, nr + nrc, sc
+
+        carry0 = self._pvary((jnp.int32(0), jnp.int32(0), st["scratch"]))
+        nl, nr, sc = jax.lax.fori_loop(0, n_chunks, scatter_pass, carry0)
+
+        def copyback(ci, p):
+            row0 = start + ci * C
+            valid = (ci * C + jax.lax.iota(jnp.int32, C)) < cnt
+            return blend(p, jax.lax.dynamic_slice(sc, (row0, 0), (C, W)),
+                         row0, valid)
+
+        part = jax.lax.fori_loop(0, n_chunks, copyback, self._pvary(part))
+        return {"part": part, "scratch": sc}, nl
 
     # ------------------------------------------------------------------
     def _leaf_best_split(self, hist_group, sum_g, sum_h, cnt, depth, feature_mask):
@@ -301,13 +325,13 @@ class SerialTreeLearner:
         winner = jnp.argmax(gathered.gain)
         return jax.tree.map(lambda a: a[winner], gathered)
 
-    def _build_tree_impl(self, binned, grad, hess, indices, bag_cnt, feature_mask):
+    def _build_tree_impl(self, part_bins, grad_p, hess_p, rowid, bag_cnt,
+                         feature_mask):
         L, G, B, F = self.L, self.G, self.B, self.F
         nodes = self.max_splits
-        binned_flat = binned.reshape(-1).astype(jnp.int32)
 
-        root_hist = self._psum(
-            self._hist_leaf(binned, indices, jnp.int32(0), bag_cnt, grad, hess))
+        root_hist = self._psum(self._hist_leaf(
+            part_bins, grad_p, hess_p, jnp.int32(self.row0), jnp.int32(self.N)))
         bag_cnt_g = self._psum(bag_cnt)
         sum_g = root_hist[0, :, 0].sum()
         sum_h = root_hist[0, :, 1].sum()
@@ -320,11 +344,17 @@ class SerialTreeLearner:
         state = {
             "s": jnp.int32(0),
             "done": jnp.bool_(False),
-            "indices": indices,
-            "scratch": jnp.zeros_like(indices),
+            "indices": rowid,
+            "part_bins": part_bins,
+            "part_grad": grad_p,
+            "part_hess": hess_p,
+            "sc_bins": jnp.zeros_like(part_bins),
+            "sc_grad": jnp.zeros_like(grad_p),
+            "sc_hess": jnp.zeros_like(hess_p),
+            "sc_idx": jnp.zeros_like(rowid),
             "hist": jnp.zeros((L, G, B, 2), dtype=jnp.float32).at[0].set(root_hist),
-            "leaf_start": arr(0, jnp.int32).at[0].set(0),
-            "leaf_cnt": arr(0, jnp.int32).at[0].set(bag_cnt),
+            "leaf_start": arr(0, jnp.int32).at[0].set(self.row0),
+            "leaf_cnt": arr(0, jnp.int32).at[0].set(self.N),
             "leaf_cnt_g": arr(0, jnp.int32).at[0].set(bag_cnt_g),
             "leaf_sum_g": arr(0.0).at[0].set(sum_g),
             "leaf_sum_h": arr(0.0).at[0].set(sum_h),
@@ -337,6 +367,8 @@ class SerialTreeLearner:
             "best_feature": arr(0, jnp.int32).at[0].set(best0.feature),
             "best_threshold": arr(0, jnp.int32).at[0].set(best0.threshold),
             "best_dl": arr(False, jnp.bool_).at[0].set(best0.default_left),
+            "best_lcnt": arr(0, jnp.int32).at[0].set(best0.left_count),
+            "best_rcnt": arr(0, jnp.int32).at[0].set(best0.right_count),
             "best_lsg": arr(0.0).at[0].set(best0.left_sum_g),
             "best_lsh": arr(0.0).at[0].set(best0.left_sum_h),
             "best_rsg": arr(0.0).at[0].set(best0.right_sum_g),
@@ -392,13 +424,14 @@ class SerialTreeLearner:
                 cnt = st["leaf_cnt"][best_leaf]
                 cnt_g = st["leaf_cnt_g"][best_leaf]
 
-                indices_, scratch_, left_cnt = self._partition_leaf(
-                    binned_flat, st["indices"], st["scratch"], start, cnt,
-                    (col, bstart, isb, nb, dbin, mtype, thr, dl),
-                    best_leaf, new_leaf)
+                moved, left_cnt = self._partition_leaf(
+                    st, start, cnt, col, (bstart, isb, nb, dbin, mtype, thr, dl))
                 right_cnt = cnt - left_cnt
-                left_cnt_g = self._psum(left_cnt)
-                right_cnt_g = cnt_g - left_cnt_g
+                # bag-aware counts come from the (global) histogram estimate
+                # cached with the best split, not from physical range sizes:
+                # out-of-bag rows live in the ranges with zeroed gradients
+                left_cnt_g = st["best_lcnt"][best_leaf]
+                right_cnt_g = st["best_rcnt"][best_leaf]
                 l_start = start
                 r_start = start + left_cnt
 
@@ -409,7 +442,8 @@ class SerialTreeLearner:
                 sm_start = jnp.where(small_is_left, l_start, r_start)
                 sm_cnt = jnp.where(small_is_left, left_cnt, right_cnt)
                 hist_small = self._psum(self._hist_leaf(
-                    binned, indices_, sm_start, sm_cnt, grad, hess))
+                    moved["part_bins"], moved["part_grad"], moved["part_hess"],
+                    sm_start, sm_cnt))
                 parent_hist = st["hist"][best_leaf]
                 hist_large = parent_hist - hist_small
                 hist_left = jnp.where(small_is_left, hist_small, hist_large)
@@ -425,7 +459,8 @@ class SerialTreeLearner:
                 depth_child = st["leaf_depth"][best_leaf] + 1
 
                 # record the internal node (reference: Tree::Split, tree.cpp)
-                upd = {
+                upd = dict(moved)
+                upd.update({
                     "node_feature": st["node_feature"].at[s].set(
                         self.ctx.feature_index[f_enum]),
                     "node_feature_enum": st["node_feature_enum"].at[s].set(f_enum),
@@ -443,7 +478,7 @@ class SerialTreeLearner:
                     "node_num_bin": st["node_num_bin"].at[s].set(nb),
                     "node_default_bin": st["node_default_bin"].at[s].set(dbin),
                     "node_missing_type": st["node_missing_type"].at[s].set(mtype),
-                }
+                })
                 node_left = st["node_left"].at[s].set(-(best_leaf + 1))
                 node_right = st["node_right"].at[s].set(-(new_leaf + 1))
                 p = st["leaf_parent_node"][best_leaf]
@@ -472,8 +507,6 @@ class SerialTreeLearner:
                 upd.update({
                     "s": s + 1,
                     "done": st["done"],
-                    "indices": indices_,
-                    "scratch": scratch_,
                     "hist": hist,
                     "leaf_start": seta("leaf_start", l_start, r_start),
                     "leaf_cnt": seta("leaf_cnt", left_cnt, right_cnt),
@@ -490,6 +523,10 @@ class SerialTreeLearner:
                                            best_r.threshold),
                     "best_dl": seta("best_dl", best_l.default_left,
                                     best_r.default_left),
+                    "best_lcnt": seta("best_lcnt", best_l.left_count,
+                                      best_r.left_count),
+                    "best_rcnt": seta("best_rcnt", best_l.right_count,
+                                      best_r.right_count),
                     "best_lsg": seta("best_lsg", best_l.left_sum_g, best_r.left_sum_g),
                     "best_lsh": seta("best_lsh", best_l.left_sum_h, best_r.left_sum_h),
                     "best_rsg": seta("best_rsg", best_l.right_sum_g, best_r.right_sum_g),
@@ -505,17 +542,34 @@ class SerialTreeLearner:
         return final
 
     # ------------------------------------------------------------------
-    def build_tree(self, grad, hess, indices=None, bag_cnt=None,
+    def _build_impl(self, part_bins0, grad, hess, bag_cnt, feature_mask):
+        """Front/tail-pad the per-row arrays and run the tree loop.
+
+        ``grad``/``hess`` are (N,) in ORIGINAL row order with out-of-bag rows
+        already zeroed by the caller (bagging/GOSS never gather rows — TPU
+        row gathers are latency-bound); ``bag_cnt`` is the in-bag row count
+        used for count estimation.
+        """
+        C = self.row0
+        tail = self.N_pad - C - self.N
+        grad_p = jnp.pad(grad, (C, tail))
+        hess_p = jnp.pad(hess, (C, tail))
+        iota = jax.lax.iota(jnp.int32, self.N_pad)
+        rowid = jnp.where((iota >= C) & (iota < C + self.N), iota - C, self.N)
+        return self._build_tree_impl(part_bins0, grad_p, hess_p, rowid,
+                                     bag_cnt, feature_mask)
+
+    def build_tree(self, grad, hess, bag_cnt=None,
                    feature_mask=None) -> Dict[str, Any]:
         """Train one tree; returns the device state record."""
-        if indices is None:
-            indices, bag_cnt = self.init_indices(None)
         if feature_mask is None:
             feature_mask = jnp.ones((self.F,), dtype=bool)
         grad = jnp.asarray(grad, dtype=jnp.float32)
         hess = jnp.asarray(hess, dtype=jnp.float32)
-        return self._build_jit(self.binned_dev, grad, hess, indices,
-                               jnp.int32(bag_cnt), feature_mask)
+        if bag_cnt is None:
+            bag_cnt = self.N
+        return self._build(self._part0, grad, hess, jnp.int32(bag_cnt),
+                           feature_mask)
 
     def node_arrays_for_predict(self, st: Dict[str, Any]) -> Dict[str, Any]:
         return {

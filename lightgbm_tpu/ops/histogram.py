@@ -2,11 +2,24 @@
 
 TPU-native replacement for the reference histogram kernels
 (src/io/dense_bin.hpp ConstructHistogram, src/treelearner/cuda/
-cuda_histogram_constructor.cu): TPUs have no fast scatter-add, so the
+cuda_histogram_constructor.cu).  TPUs have no fast scatter-add, so the
 (rows x groups) -> (groups x bins) accumulation is reformulated as a one-hot
-MXU matmul: for each row chunk, hist[g, b, c] += sum_r (bin[r, g] == b) * gh[r, c].
-The one-hot factor is exact in bfloat16/float32 and the contraction runs on the
-systolic array; per-chunk partials accumulate in float32.
+MXU matmul.  Rows are kept *physically partitioned by leaf* (see
+models/learner.py), so a leaf's histogram reads one contiguous row slice —
+no gathers touch HBM on the hot path.
+
+Two implementations with identical semantics:
+  * ``leaf_hist_slice``  — pure-XLA chunked einsum (runs everywhere; the
+    oracle for tests and the CPU path).
+  * ``leaf_hist_pallas`` — Pallas TPU kernel that DMAs (chunk, G) tiles
+    straight from HBM with a dynamic trip count and accumulates per-feature
+    (2, B) partial histograms in VMEM.
+
+The contraction layout batches ``gblock`` feature groups into the matmul N
+dimension — out[(j),(g,b)] = sum_c gh[j,c] * (bins[c,g]==b) — because the
+left operand (grad/hess) is shared across features.  This keeps the MXU's
+N dimension wide instead of the naive per-feature (C,B)@(B,2) shape whose
+N=2 wastes 126/128 lanes.
 """
 
 from __future__ import annotations
@@ -15,68 +28,180 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
-def histogram_leaf(bins_slice: jnp.ndarray, gh_slice: jnp.ndarray,
-                   num_bins: int, row_chunk: int = 2048) -> jnp.ndarray:
-    """Build the (G, B, 2) grad/hess histogram for one leaf's row slice.
+def unpack_rows(chunk, G: int, bin_itemsize: int):
+    """Split a packed (C, W) uint8 row chunk into (bins (C,G) int32,
+    grad (C,), hess (C,), rowid (C,)).
 
-    Args:
-      bins_slice: (S, G) integer bins for the leaf's rows (padding rows must
-        have their gh zeroed by the caller).
-      gh_slice: (S, 2) float32 gradient/hessian pairs (zeros on padding).
-      num_bins: padded bin count B (static).
-      row_chunk: rows per MXU matmul chunk (static).
-
-    Returns:
-      (G, B, 2) float32 histogram.
+    Packed row layout (see models/learner.py): [bins bytes | grad f32 |
+    hess f32 | rowid i32].
     """
-    S, G = bins_slice.shape
+    Gb = G * bin_itemsize
+    raw = chunk[:, :Gb]
+    if bin_itemsize == 1:
+        bins = raw.astype(jnp.int32)
+    else:
+        C = chunk.shape[0]
+        bins = jax.lax.bitcast_convert_type(
+            raw.reshape(C, G, 2), jnp.uint16).astype(jnp.int32)
+    g = jax.lax.bitcast_convert_type(chunk[:, Gb:Gb + 4], jnp.float32)
+    h = jax.lax.bitcast_convert_type(chunk[:, Gb + 4:Gb + 8], jnp.float32)
+    rid = jax.lax.bitcast_convert_type(chunk[:, Gb + 8:Gb + 12], jnp.int32)
+    return bins, g, h, rid
+
+
+def leaf_hist_slice(part, start, cnt, *, num_features: int,
+                    bin_itemsize: int, num_bins: int, row_chunk: int,
+                    gblock: int = 0, dtype=jnp.float32, vary=lambda x: x):
+    """(G, B, 2) histogram of the contiguous partitioned rows
+    [start, start+cnt) of the packed (N_pad, W) uint8 row matrix; rows
+    beyond ``cnt`` inside the last chunk are masked via zeroed grad/hess.
+
+    The chunk body is a python-unrolled loop over static feature blocks with
+    (C, gblock*B) one-hots sized to stay in VMEM; the only dynamic ops are
+    the row slices.  Layout-changing reshapes happen once, outside the loop.
+    """
+    Np, W = part.shape
+    G = num_features
+    C = row_chunk
     B = num_bins
-    C = min(S, row_chunk)
-    n_chunks = (S + C - 1) // C
-    pad = n_chunks * C - S
-    if pad:
-        bins_slice = jnp.pad(bins_slice, ((0, pad), (0, 0)))
-        gh_slice = jnp.pad(gh_slice, ((0, pad), (0, 0)))
+    if gblock <= 0:
+        gblock = max(1, 256 // B)  # keep one-hot ~<=8MB: C * gblock*B * 4
+    nblk = (G + gblock - 1) // gblock
+    Gp = nblk * gblock
+    n_chunks = (cnt + C - 1) // C
+    iota_b = jax.lax.broadcasted_iota(jnp.int32, (1, 1, B), 2)
 
-    bins_c = bins_slice.reshape(n_chunks, C, G)
-    gh_c = gh_slice.reshape(n_chunks, C, 2)
-    iota_b = jax.lax.broadcasted_iota(jnp.int32, (1, B, 1), 1)
+    def body(ci, accs):
+        row0 = start + ci * C
+        chunk = jax.lax.dynamic_slice(part, (row0, 0), (C, W))
+        bins, g, h, _ = unpack_rows(chunk, G, bin_itemsize)
+        if Gp > G:
+            bins = jnp.pad(bins, ((0, 0), (0, Gp - G)), constant_values=-1)
+        valid = (ci * C + jax.lax.iota(jnp.int32, C)) < cnt
+        gh = jnp.stack([g * valid, h * valid], axis=0).astype(dtype)  # (2, C)
+        out = []
+        for i in range(nblk):
+            blk = bins[:, i * gblock:(i + 1) * gblock]       # (C, gblock)
+            oh = (blk[:, :, None] == iota_b).astype(dtype)
+            part_h = jax.lax.dot_general(
+                gh, oh.reshape(C, gblock * B),
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)           # (2, gblock*B)
+            out.append(accs[i] + part_h)
+        return tuple(out)
 
-    def body(acc, chunk):
-        bins_chunk, gh_chunk = chunk
-        # (G, B, C) one-hot: exact in f32; contraction over rows on the MXU
-        onehot = (bins_chunk.T[:, None, :].astype(jnp.int32) == iota_b)
-        partial = jnp.einsum(
-            "gbc,cj->gbj", onehot.astype(jnp.float32), gh_chunk,
-            preferred_element_type=jnp.float32)
-        return acc + partial, None
-
-    if n_chunks == 1:
-        onehot = (bins_c[0].T[:, None, :].astype(jnp.int32) == iota_b)
-        return jnp.einsum("gbc,cj->gbj", onehot.astype(jnp.float32), gh_c[0],
-                          preferred_element_type=jnp.float32)
-    acc0 = jnp.zeros((G, B, 2), dtype=jnp.float32)
-    acc, _ = jax.lax.scan(body, acc0, (bins_c, gh_c))
-    return acc
+    accs = vary(tuple(jnp.zeros((2, gblock * B), jnp.float32)
+                      for _ in range(nblk)))
+    accs = jax.lax.fori_loop(0, n_chunks, body, accs)
+    per = jnp.stack(accs)                                    # (nblk, 2, gblock*B)
+    out = jnp.moveaxis(per, 1, 0).reshape(2, Gp, B)
+    return jnp.moveaxis(out[:, :G], 0, 2)                    # (G, B, 2)
 
 
-def gather_leaf_rows(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
-                     indices: jnp.ndarray, start: jnp.ndarray, size: int,
-                     count: jnp.ndarray):
-    """Slice a leaf's row ids out of the partition array and gather its data.
+# ----------------------------------------------------------------------
+# Pallas TPU kernel
+# ----------------------------------------------------------------------
 
-    ``indices`` is padded so that ``start + size`` never exceeds its length;
-    padding entries point at the sentinel row (all-zero gh).  Rows beyond
-    ``count`` inside the slice belong to *other* leaves, so their gh is zeroed.
+@functools.partial(jax.jit, static_argnames=("num_bins", "row_chunk",
+                                             "use_bf16"))
+def leaf_hist_pallas(part_bins, grad_p, hess_p, start, cnt, *,
+                     num_bins: int, row_chunk: int, use_bf16: bool = False):
+    """Same contract as ``leaf_hist_slice``, as one Pallas kernel.
 
-    Returns (bins (size, G), gh (size, 2)).
+    A single program (grid=(1,)) walks the leaf's chunks with a dynamic trip
+    count, double-buffered DMA from HBM, and per-feature one-hot matmuls
+    (the bin axis is padded to a lane multiple so the MXU N dimension stays
+    wide) accumulated into a VMEM scratch histogram — the TPU analog of the
+    CUDA shared-memory per-block histograms
+    (cuda_histogram_constructor.cu:18-460).
     """
-    idx = jax.lax.dynamic_slice(indices, (start,), (size,))
-    pos = jax.lax.iota(jnp.int32, size)
-    valid = pos < count
-    bins = jnp.take(binned, idx, axis=0)
-    g = jnp.take(grad, idx) * valid
-    h = jnp.take(hess, idx) * valid
-    return bins, jnp.stack([g, h], axis=1)
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    Np, G = part_bins.shape
+    C = row_chunk
+    B = num_bins
+    B128 = ((B + 127) // 128) * 128
+    dtype = jnp.bfloat16 if use_bf16 else jnp.float32
+
+    def kernel(start_ref, cnt_ref, bins_hbm, grad_hbm, hess_hbm, out_ref,
+               bins_buf, grad_buf, hess_buf, acc_ref, sems):
+        s0 = start_ref[0]
+        total = cnt_ref[0]
+        # chunk-ALIGNED windows covering [s0, s0+total): DMA starts must be
+        # tile-aligned, leaf starts are arbitrary -> mask the partial edges
+        c0 = jax.lax.div(s0, C)
+        n_chunks = pl.cdiv(s0 + total, C) - c0
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+        def get_copies(ci, slot):
+            blk = c0 + ci
+            return (
+                pltpu.make_async_copy(
+                    bins_hbm.at[blk], bins_buf.at[slot], sems.at[slot, 0]),
+                pltpu.make_async_copy(
+                    grad_hbm.at[blk], grad_buf.at[slot], sems.at[slot, 1]),
+                pltpu.make_async_copy(
+                    hess_hbm.at[blk], hess_buf.at[slot], sems.at[slot, 2]),
+            )
+
+        for c in get_copies(0, 0):
+            c.start()
+
+        def body(ci, _):
+            slot = jax.lax.rem(ci, 2)
+
+            @pl.when(ci + 1 < n_chunks)
+            def _():
+                for c in get_copies(ci + 1, 1 - slot):
+                    c.start()
+
+            for c in get_copies(ci, slot):
+                c.wait()
+
+            gpos = ((c0 + ci) * C +
+                    jax.lax.broadcasted_iota(jnp.int32, (1, C), 1))
+            valid = (gpos >= s0) & (gpos < s0 + total)
+            g = jnp.where(valid, grad_buf[slot][None, :], 0.0)
+            h = jnp.where(valid, hess_buf[slot][None, :], 0.0)
+            gh = jnp.concatenate([g, h], axis=0).astype(dtype)    # (2, C)
+            bins = bins_buf[slot].astype(jnp.int32)               # (C, G)
+            iota_b = jax.lax.broadcasted_iota(jnp.int32, (C, B128), 1)
+            for f in range(G):
+                oh = (bins[:, f:f + 1] == iota_b).astype(dtype)   # (C, B128)
+                part = jax.lax.dot_general(
+                    gh, oh, dimension_numbers=(((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)            # (2, B128)
+                acc_ref[:, f, :] = acc_ref[:, f, :] + part
+            return 0
+
+        jax.lax.fori_loop(0, n_chunks, body, 0)
+        out_ref[:] = acc_ref[:]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(1,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.HBM)] * 3,
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((2, C, G), part_bins.dtype),
+            pltpu.VMEM((2, C), jnp.float32),
+            pltpu.VMEM((2, C), jnp.float32),
+            pltpu.VMEM((2, G, B128), jnp.float32),
+            pltpu.SemaphoreType.DMA((2, 3)),
+        ],
+    )
+    if Np % C:
+        raise ValueError(f"N_pad={Np} must be a multiple of row_chunk={C}")
+    nblocks = Np // C
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((2, G, B128), jnp.float32),
+        grid_spec=grid_spec,
+    )(jnp.asarray([start], jnp.int32), jnp.asarray([cnt], jnp.int32),
+      part_bins.reshape(nblocks, C, G), grad_p.reshape(nblocks, C),
+      hess_p.reshape(nblocks, C))
+    return jnp.moveaxis(out[:, :, :B], 0, 2)

@@ -100,8 +100,11 @@ class ShardedTreeBuilder:
 
         def build_shard(binned, grad, hess, cnt, feature_mask):
             # binned: (local_n+1, G); grad/hess: (local_n,); cnt: (1,)
-            idx = jnp.where(jax.lax.iota(jnp.int32, lr.N_pad) < cnt[0],
-                            jax.lax.iota(jnp.int32, lr.N_pad), lr.N)
+            C = lr.row0
+            part_bins = jnp.pad(
+                binned, ((C, lr.N_pad - C - binned.shape[0]), (0, 0)))
+            grad_l = grad[: lr.N]
+            hess_l = hess[: lr.N]
             if self.mode == "feature":
                 # shard the split search: contiguous feature blocks per device
                 d = jax.lax.axis_index(AXIS)
@@ -110,8 +113,8 @@ class ShardedTreeBuilder:
                 fidx = jnp.arange(F)
                 mine = (fidx >= d * per) & (fidx < (d + 1) * per)
                 feature_mask = feature_mask & mine
-            return lr._build_tree_impl(binned, grad, hess, idx,
-                                       cnt[0], feature_mask)
+            return lr._build_impl(part_bins, grad_l, hess_l,
+                                  cnt[0], feature_mask)
 
         row_spec = P() if self.mode == "feature" else P(AXIS)
         in_specs = (row_spec, row_spec, row_spec, P(AXIS), P())
@@ -122,7 +125,9 @@ class ShardedTreeBuilder:
             # offsets/counts) — only globally-identical values may be
             # replicated out; consumers must use leaf_cnt_g
             rec = {k: v for k, v in rec.items()
-                   if k not in ("indices", "scratch", "leaf_start", "leaf_cnt")}
+                   if k not in ("indices", "part_bins", "part_grad",
+                                "part_hess", "sc_bins", "sc_grad", "sc_hess",
+                                "sc_idx", "leaf_start", "leaf_cnt")}
 
             def replicate(x):
                 # values are identical on every device; pmax proves
