@@ -147,29 +147,19 @@ class SerialTreeLearner:
         self._use_pallas = (jax.default_backend() == "tpu"
                             and config.tpu_hist_kernel == "pallas")
 
-        # Packed row layout: every row's full payload lives in one uint8
-        # matrix [bins bytes | grad f32 | hess f32 | rowid i32] so that the
-        # partition moves rows with ONE vectorized row-gather + contiguous
-        # window writes (1-D gathers/scatters serialize on TPU; 2-D row
-        # gathers vectorize).  Rows are never gathered by bag index:
-        # bagging/GOSS zero the out-of-bag gradients instead.
-        self.bin_dtype = (dataset.binned.dtype if dataset.binned is not None
-                          else np.uint8)
-        self.bin_itemsize = int(np.dtype(self.bin_dtype).itemsize)
-        self.Gb = self.G * self.bin_itemsize
-        self.W = self.Gb + 12
-        self._bins_bytes = None
+        # Row layout: the binned matrix (N_pad, G) in its native bin dtype,
+        # plus separate (N_pad,) grad/hess/rowid arrays.  The partition moves
+        # rows with vectorized 2-D row-gathers + contiguous window writes
+        # (1-D gathers/scatters serialize on TPU; 2-D row gathers vectorize —
+        # grad/hess/rowid are therefore moved as one stacked (C, 3) matrix).
+        # Rows are never gathered by bag index: bagging/GOSS zero the
+        # out-of-bag gradients instead.
+        self._part0 = None
         if local_num_data is None:
-            binned = dataset.binned
-            raw = np.ascontiguousarray(binned).view(np.uint8).reshape(
-                self.N, self.Gb)
-            front = np.zeros((C, self.Gb), np.uint8)
-            tail = np.zeros((self.N_pad - C - self.N, self.Gb), np.uint8)
-            self._bins_bytes = jnp.asarray(np.concatenate([front, raw, tail]))
-        iota = np.arange(self.N_pad, dtype=np.int32)
-        rid = np.where((iota >= C) & (iota < C + self.N), iota - C, self.N)
-        self._id_bytes = jnp.asarray(
-            np.ascontiguousarray(rid).view(np.uint8).reshape(self.N_pad, 4))
+            binned = np.ascontiguousarray(dataset.binned)
+            front = np.zeros((C, self.G), binned.dtype)
+            tail = np.zeros((self.N_pad - C - self.N, self.G), binned.dtype)
+            self._part0 = jnp.asarray(np.concatenate([front, binned, tail]))
 
         # ---- scalars ----
         self.l1 = float(config.lambda_l1)
@@ -185,9 +175,12 @@ class SerialTreeLearner:
         self._build = jax.jit(self._build_impl)
 
     # ------------------------------------------------------------------
-    def _hist_leaf(self, part, start, cnt):
-        return leaf_hist_slice(part, start, cnt, num_features=self.G,
-                               bin_itemsize=self.bin_itemsize,
+    def _hist_leaf(self, part_bins, grad_p, hess_p, start, cnt):
+        if self._use_pallas:
+            return leaf_hist_pallas(part_bins, grad_p, hess_p, start, cnt,
+                                    num_bins=self.B,
+                                    row_chunk=self.row_chunk)
+        return leaf_hist_slice(part_bins, grad_p, hess_p, start, cnt,
                                num_bins=self.B, row_chunk=self.row_chunk,
                                vary=self._pvary)
 
@@ -219,28 +212,30 @@ class SerialTreeLearner:
         (cuda_data_partition.cu:288-907).
         """
         C = self.row_chunk
-        W = self.W
-        isz = self.bin_itemsize
+        G = self.G
         n_chunks = (cnt + C - 1) // C
-        part = st["part"]
+        part_bins = st["part_bins"]
+        # grad/hess/rowid travel as one (N_pad, 3) f32 matrix so the per-chunk
+        # permute is a 2-D row gather (1-D gathers serialize on TPU); rowid is
+        # bitcast to f32 — no arithmetic ever touches it, so bits survive.
+        def pack3(g, h, i):
+            return jnp.stack(
+                [g, h, jax.lax.bitcast_convert_type(i, jnp.float32)], axis=1)
+
+        part_ghi = pack3(st["part_grad"], st["part_hess"], st["indices"])
 
         def blend(dst, val, off, mask):
             win = jax.lax.dynamic_slice(dst, (off, 0), val.shape)
             return jax.lax.dynamic_update_slice(
                 dst, jnp.where(mask[:, None], val, win), (off, 0))
 
-        def col_values(chunk):
-            raw = jax.lax.dynamic_slice(chunk, (0, col * isz), (C, isz))
-            if isz == 1:
-                return raw[:, 0].astype(jnp.int32)
-            return jax.lax.bitcast_convert_type(raw, jnp.uint16).astype(
-                jnp.int32)[:, 0]
-
         def scatter_pass(ci, carry):
-            nl, nr, sc = carry
+            nl, nr, sb, sg = carry
             row0 = start + ci * C
-            chunk = jax.lax.dynamic_slice(part, (row0, 0), (C, W))
-            colv = col_values(chunk)
+            bch = jax.lax.dynamic_slice(part_bins, (row0, 0), (C, G))
+            gch = jax.lax.dynamic_slice(part_ghi, (row0, 0), (C, 3))
+            colv = jax.lax.dynamic_slice(
+                bch, (jnp.int32(0), col), (C, 1))[:, 0].astype(jnp.int32)
             valid = (ci * C + jax.lax.iota(jnp.int32, C)) < cnt
             gl = self._goes_left(colv, decision_scalars) & valid
             gr = valid & ~gl
@@ -256,26 +251,43 @@ class SerialTreeLearner:
             dloc = jnp.where(gl, lrank,
                              jnp.where(gr, C - nrc + rrank, nlc + irank))
             order = jnp.argsort(dloc)
-            compacted = jnp.take(chunk, order, axis=0)   # one ROW gather
+            bcomp = jnp.take(bch, order, axis=0)         # ROW gathers
+            gcomp = jnp.take(gch, order, axis=0)
             iot = jax.lax.iota(jnp.int32, C)
-            # lefts window [start+nl, +C), mask first nlc rows
-            sc = blend(sc, compacted, start + nl, iot < nlc)
+            lmask = iot < nlc
             # rights window [start+cnt-nr-C, +C), mask last nrc rows; the
             # front pad rows of the arrays keep this offset non-negative
-            sc = blend(sc, compacted, start + cnt - nr - C, iot >= C - nrc)
-            return nl + nlc, nr + nrc, sc
+            rmask = iot >= C - nrc
+            roff = start + cnt - nr - C
+            sb = blend(blend(sb, bcomp, start + nl, lmask), bcomp, roff, rmask)
+            sg = blend(blend(sg, gcomp, start + nl, lmask), gcomp, roff, rmask)
+            return nl + nlc, nr + nrc, sb, sg
 
-        carry0 = self._pvary((jnp.int32(0), jnp.int32(0), st["scratch"]))
-        nl, nr, sc = jax.lax.fori_loop(0, n_chunks, scatter_pass, carry0)
+        carry0 = self._pvary((jnp.int32(0), jnp.int32(0), st["sc_bins"],
+                              st["sc_ghi"]))
+        nl, nr, sb, sg = jax.lax.fori_loop(0, n_chunks, scatter_pass, carry0)
 
-        def copyback(ci, p):
+        def copyback(ci, carry):
+            pb, pg = carry
             row0 = start + ci * C
             valid = (ci * C + jax.lax.iota(jnp.int32, C)) < cnt
-            return blend(p, jax.lax.dynamic_slice(sc, (row0, 0), (C, W)),
-                         row0, valid)
+            pb = blend(pb, jax.lax.dynamic_slice(sb, (row0, 0), (C, G)),
+                       row0, valid)
+            pg = blend(pg, jax.lax.dynamic_slice(sg, (row0, 0), (C, 3)),
+                       row0, valid)
+            return pb, pg
 
-        part = jax.lax.fori_loop(0, n_chunks, copyback, self._pvary(part))
-        return {"part": part, "scratch": sc}, nl
+        part_bins, part_ghi = jax.lax.fori_loop(
+            0, n_chunks, copyback, self._pvary((part_bins, part_ghi)))
+        moved = {
+            "part_bins": part_bins,
+            "part_grad": part_ghi[:, 0],
+            "part_hess": part_ghi[:, 1],
+            "indices": jax.lax.bitcast_convert_type(part_ghi[:, 2], jnp.int32),
+            "sc_bins": sb,
+            "sc_ghi": sg,
+        }
+        return moved, nl
 
     # ------------------------------------------------------------------
     def _leaf_best_split(self, hist_group, sum_g, sum_h, cnt, depth, feature_mask):
@@ -349,9 +361,7 @@ class SerialTreeLearner:
             "part_grad": grad_p,
             "part_hess": hess_p,
             "sc_bins": jnp.zeros_like(part_bins),
-            "sc_grad": jnp.zeros_like(grad_p),
-            "sc_hess": jnp.zeros_like(hess_p),
-            "sc_idx": jnp.zeros_like(rowid),
+            "sc_ghi": jnp.zeros((part_bins.shape[0], 3), jnp.float32),
             "hist": jnp.zeros((L, G, B, 2), dtype=jnp.float32).at[0].set(root_hist),
             "leaf_start": arr(0, jnp.int32).at[0].set(self.row0),
             "leaf_cnt": arr(0, jnp.int32).at[0].set(self.N),

@@ -31,40 +31,19 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def unpack_rows(chunk, G: int, bin_itemsize: int):
-    """Split a packed (C, W) uint8 row chunk into (bins (C,G) int32,
-    grad (C,), hess (C,), rowid (C,)).
-
-    Packed row layout (see models/learner.py): [bins bytes | grad f32 |
-    hess f32 | rowid i32].
-    """
-    Gb = G * bin_itemsize
-    raw = chunk[:, :Gb]
-    if bin_itemsize == 1:
-        bins = raw.astype(jnp.int32)
-    else:
-        C = chunk.shape[0]
-        bins = jax.lax.bitcast_convert_type(
-            raw.reshape(C, G, 2), jnp.uint16).astype(jnp.int32)
-    g = jax.lax.bitcast_convert_type(chunk[:, Gb:Gb + 4], jnp.float32)
-    h = jax.lax.bitcast_convert_type(chunk[:, Gb + 4:Gb + 8], jnp.float32)
-    rid = jax.lax.bitcast_convert_type(chunk[:, Gb + 8:Gb + 12], jnp.int32)
-    return bins, g, h, rid
-
-
-def leaf_hist_slice(part, start, cnt, *, num_features: int,
-                    bin_itemsize: int, num_bins: int, row_chunk: int,
+def leaf_hist_slice(part_bins, grad_p, hess_p, start, cnt, *,
+                    num_bins: int, row_chunk: int,
                     gblock: int = 0, dtype=jnp.float32, vary=lambda x: x):
     """(G, B, 2) histogram of the contiguous partitioned rows
-    [start, start+cnt) of the packed (N_pad, W) uint8 row matrix; rows
-    beyond ``cnt`` inside the last chunk are masked via zeroed grad/hess.
+    [start, start+cnt) of the (N_pad, G) binned matrix with matching
+    (N_pad,) grad/hess; rows beyond ``cnt`` inside the last chunk are
+    masked via zeroed grad/hess.
 
     The chunk body is a python-unrolled loop over static feature blocks with
     (C, gblock*B) one-hots sized to stay in VMEM; the only dynamic ops are
     the row slices.  Layout-changing reshapes happen once, outside the loop.
     """
-    Np, W = part.shape
-    G = num_features
+    Np, G = part_bins.shape
     C = row_chunk
     B = num_bins
     if gblock <= 0:
@@ -76,8 +55,10 @@ def leaf_hist_slice(part, start, cnt, *, num_features: int,
 
     def body(ci, accs):
         row0 = start + ci * C
-        chunk = jax.lax.dynamic_slice(part, (row0, 0), (C, W))
-        bins, g, h, _ = unpack_rows(chunk, G, bin_itemsize)
+        bins = jax.lax.dynamic_slice(
+            part_bins, (row0, 0), (C, G)).astype(jnp.int32)
+        g = jax.lax.dynamic_slice(grad_p, (row0,), (C,))
+        h = jax.lax.dynamic_slice(hess_p, (row0,), (C,))
         if Gp > G:
             bins = jnp.pad(bins, ((0, 0), (0, Gp - G)), constant_values=-1)
         valid = (ci * C + jax.lax.iota(jnp.int32, C)) < cnt

@@ -126,8 +126,8 @@ class ShardedTreeBuilder:
             # replicated out; consumers must use leaf_cnt_g
             rec = {k: v for k, v in rec.items()
                    if k not in ("indices", "part_bins", "part_grad",
-                                "part_hess", "sc_bins", "sc_grad", "sc_hess",
-                                "sc_idx", "leaf_start", "leaf_cnt")}
+                                "part_hess", "sc_bins", "sc_ghi",
+                                "leaf_start", "leaf_cnt")}
 
             def replicate(x):
                 # values are identical on every device; pmax proves
