@@ -39,7 +39,8 @@ __all__ = [
     "early_stopping", "log_evaluation", "record_evaluation", "reset_parameter",
     "EarlyStopException",
     "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
-    "plot_importance", "plot_metric", "plot_tree",
+    "plot_importance", "plot_metric", "plot_tree", "create_tree_digraph",
+    "plot_split_value_histogram",
 ]
 
 
@@ -48,7 +49,8 @@ def __getattr__(name):
     if name in ("LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker"):
         from . import sklearn as _sk
         return getattr(_sk, name)
-    if name in ("plot_importance", "plot_metric", "plot_tree", "create_tree_digraph"):
+    if name in ("plot_importance", "plot_metric", "plot_tree",
+                "create_tree_digraph", "plot_split_value_histogram"):
         from . import plotting as _pl
         return getattr(_pl, name)
     raise AttributeError(f"module 'lightgbm_tpu' has no attribute {name!r}")

@@ -107,6 +107,16 @@ class SerialTreeLearner:
         self.f_group = jnp.asarray(grp)
         self.f_bin_start = jnp.asarray(meta["bin_start"])
         self.f_is_bundled = jnp.asarray(is_bundled)
+        self.has_categorical = bool(np.any(meta["is_categorical"]))
+        self.cat_params = None
+        if self.has_categorical:
+            self.cat_params = {
+                "max_cat_threshold": int(config.max_cat_threshold),
+                "cat_l2": float(config.cat_l2),
+                "cat_smooth": float(config.cat_smooth),
+                "max_cat_to_onehot": int(config.max_cat_to_onehot),
+                "min_data_per_group": int(config.min_data_per_group),
+            }
 
         # feature-view gather: (F, BF) flat indices into (G*B [+1 pad slot])
         gather = np.full((self.F, self.BF), self.G * self.B, dtype=np.int32)
@@ -189,13 +199,22 @@ class SerialTreeLearner:
 
         Bundled features decode bin b (≠ default) at offset ``bstart + b``
         (reference: FeatureGroup bin offsets, include/LightGBM/feature_group.h).
+        Categorical nodes test bin membership in the split's category set
+        (reference: DenseBin::Split categorical arm, src/io/dense_bin.hpp).
         """
-        bstart, isb, nb, dbin, mtype, thr, dl = scalars
+        bstart, isb, nb, dbin, mtype, thr, dl, is_cat, cat_set = scalars
         gb = colv.astype(jnp.int32)
         fb_raw = gb - bstart
         in_r = (fb_raw >= 1) & (fb_raw <= nb - 1)
         fb = jnp.where(isb == 1, jnp.where(in_r, fb_raw, dbin), gb)
-        return split_decision(fb, thr, dl, mtype, dbin, nb - 1)
+        num_left = split_decision(fb, thr, dl, mtype, dbin, nb - 1)
+        if not self.has_categorical:   # keep the all-numerical hot path lean
+            return num_left
+        # membership via one-hot AND (C-length 1-D gathers serialize on TPU)
+        oh = fb[:, None] == jax.lax.broadcasted_iota(
+            jnp.int32, (1, cat_set.shape[0]), 1)
+        cat_left = jnp.any(oh & cat_set[None, :], axis=1)
+        return jnp.where(is_cat, cat_left, num_left)
 
     def _partition_leaf(self, st, start, cnt, col, decision_scalars):
         """Two-way partition of the contiguous leaf range [start, start+cnt).
@@ -302,7 +321,8 @@ class SerialTreeLearner:
         best = split_ops.find_best_split(
             feat_hist, self.ctx, sum_g, sum_h, cnt,
             self.l1, self.l2, self.max_delta_step, self.min_gain_to_split,
-            self.min_data_in_leaf, self.min_sum_hessian, feature_mask)
+            self.min_data_in_leaf, self.min_sum_hessian, feature_mask,
+            cat_params=self.cat_params)
         depth_ok = (self.max_depth <= 0) | (depth < self.max_depth)
         gain = jnp.where(depth_ok, best.gain, -jnp.inf)
         return best._replace(gain=gain)
@@ -385,6 +405,9 @@ class SerialTreeLearner:
             "best_rsh": arr(0.0).at[0].set(best0.right_sum_h),
             "best_lout": arr(0.0).at[0].set(best0.left_output),
             "best_rout": arr(0.0).at[0].set(best0.right_output),
+            "best_is_cat": arr(False, jnp.bool_).at[0].set(best0.is_cat),
+            "best_cat_set": jnp.zeros((L, self.BF), jnp.bool_).at[0].set(
+                best0.cat_set),
             # node (internal) arrays
             "node_feature": jnp.zeros((nodes,), jnp.int32),
             "node_feature_enum": jnp.zeros((nodes,), jnp.int32),
@@ -403,6 +426,8 @@ class SerialTreeLearner:
             "node_num_bin": jnp.zeros((nodes,), jnp.int32),
             "node_default_bin": jnp.zeros((nodes,), jnp.int32),
             "node_missing_type": jnp.zeros((nodes,), jnp.int32),
+            "node_is_cat": jnp.zeros((nodes,), jnp.bool_),
+            "node_cat_set": jnp.zeros((nodes, self.BF), jnp.bool_),
         }
 
         # uniform vma typing under shard_map: mark the whole state varying
@@ -424,6 +449,8 @@ class SerialTreeLearner:
                 f_enum = st["best_feature"][best_leaf]
                 thr = st["best_threshold"][best_leaf]
                 dl = st["best_dl"][best_leaf]
+                is_cat = st["best_is_cat"][best_leaf]
+                cat_set = st["best_cat_set"][best_leaf]
                 col = self.f_group[f_enum]
                 bstart = self.f_bin_start[f_enum]
                 isb = self.f_is_bundled[f_enum]
@@ -435,7 +462,8 @@ class SerialTreeLearner:
                 cnt_g = st["leaf_cnt_g"][best_leaf]
 
                 moved, left_cnt = self._partition_leaf(
-                    st, start, cnt, col, (bstart, isb, nb, dbin, mtype, thr, dl))
+                    st, start, cnt, col,
+                    (bstart, isb, nb, dbin, mtype, thr, dl, is_cat, cat_set))
                 right_cnt = cnt - left_cnt
                 # bag-aware counts come from the (global) histogram estimate
                 # cached with the best split, not from physical range sizes:
@@ -488,6 +516,8 @@ class SerialTreeLearner:
                     "node_num_bin": st["node_num_bin"].at[s].set(nb),
                     "node_default_bin": st["node_default_bin"].at[s].set(dbin),
                     "node_missing_type": st["node_missing_type"].at[s].set(mtype),
+                    "node_is_cat": st["node_is_cat"].at[s].set(is_cat),
+                    "node_cat_set": st["node_cat_set"].at[s].set(cat_set),
                 })
                 node_left = st["node_left"].at[s].set(-(best_leaf + 1))
                 node_right = st["node_right"].at[s].set(-(new_leaf + 1))
@@ -543,6 +573,10 @@ class SerialTreeLearner:
                     "best_rsh": seta("best_rsh", best_l.right_sum_h, best_r.right_sum_h),
                     "best_lout": seta("best_lout", best_l.left_output, best_r.left_output),
                     "best_rout": seta("best_rout", best_l.right_output, best_r.right_output),
+                    "best_is_cat": seta("best_is_cat", best_l.is_cat,
+                                        best_r.is_cat),
+                    "best_cat_set": seta("best_cat_set", best_l.cat_set,
+                                         best_r.cat_set),
                 })
                 return self._pvary(upd)
 
@@ -582,7 +616,7 @@ class SerialTreeLearner:
                            feature_mask)
 
     def node_arrays_for_predict(self, st: Dict[str, Any]) -> Dict[str, Any]:
-        return {
+        node = {
             "col": st["node_col"],
             "bin_start": st["node_bin_start"],
             "is_bundled": st["node_is_bundled"],
@@ -595,3 +629,7 @@ class SerialTreeLearner:
             "right": st["node_right"],
             "num_nodes": st["s"],
         }
+        if self.has_categorical:   # keys gate the cat arm in predict_leaf_binned
+            node["is_cat"] = st["node_is_cat"]
+            node["cat_set"] = st["node_cat_set"]
+        return node

@@ -111,23 +111,26 @@ class Tree:
         return result
 
     def _categorical_decision(self, nid, fval):
-        """reference: tree.h CategoricalDecision:400 (bitset membership)."""
-        goes_left = np.zeros(len(nid), dtype=bool)
-        for i in range(len(nid)):
-            node = int(nid[i])
-            v = fval[i]
-            if math.isnan(v) or int(v) < 0:
-                goes_left[i] = False
-                continue
-            iv = int(v)
-            cat_idx = int(self.threshold[node])
-            lo = self.cat_boundaries[cat_idx]
-            hi = self.cat_boundaries[cat_idx + 1]
-            word = iv // 32
-            if word < hi - lo:
-                goes_left[i] = bool(
-                    (self.cat_threshold[lo + word] >> (iv % 32)) & 1)
-        return goes_left
+        """reference: tree.h CategoricalDecision:400 (bitset membership).
+
+        Vectorized: the vector is evaluated for every active row and
+        non-categorical nodes are masked out by the caller.
+        """
+        nid = np.asarray(nid)
+        is_cat = (self.decision_type[nid] & K_CATEGORICAL_MASK) != 0
+        ok = is_cat & np.isfinite(fval) & (fval >= 0)
+        iv = np.where(ok, fval, 0).astype(np.int64)
+        cat_idx = np.where(is_cat, self.threshold[nid], 0).astype(np.int64)
+        bounds = np.asarray(self.cat_boundaries, dtype=np.int64)
+        words = np.asarray(self.cat_threshold, dtype=np.uint32) \
+            if self.cat_threshold else np.zeros(1, dtype=np.uint32)
+        lo = bounds[cat_idx]
+        hi = bounds[np.minimum(cat_idx + 1, len(bounds) - 1)]
+        word = iv // 32
+        in_set = word < (hi - lo)
+        widx = np.minimum(lo + word, len(words) - 1)
+        bit = (words[widx] >> (iv % 32).astype(np.uint32)) & 1
+        return ok & in_set & (bit != 0)
 
     # -- serialization ---------------------------------------------------
     def to_string(self, tree_index: int) -> str:
@@ -217,14 +220,32 @@ class Tree:
             out["tree_structure"] = self._node_to_json(0)
         return out
 
+    def _cats_of_node(self, node: int) -> List[int]:
+        """Decode a categorical node's bitset into category values."""
+        cat_idx = int(self.threshold[node])
+        lo = self.cat_boundaries[cat_idx]
+        hi = self.cat_boundaries[cat_idx + 1]
+        cats = []
+        for w in range(lo, hi):
+            word = self.cat_threshold[w]
+            base = (w - lo) * 32
+            for b in range(32):
+                if (word >> b) & 1:
+                    cats.append(base + b)
+        return cats
+
     def _node_to_json(self, node: int) -> dict:
         if node >= 0:
             cat, dleft, mtype = self.unpack_decision_type(int(self.decision_type[node]))
+            # categorical nodes dump the category list 'a||b||c'
+            # (reference: Tree::NodeToJSON categorical arm)
+            thr = "||".join(str(c) for c in self._cats_of_node(node)) \
+                if cat else float(self.threshold[node])
             return {
                 "split_index": int(node),
                 "split_feature": int(self.split_feature[node]),
                 "split_gain": float(self.split_gain[node]),
-                "threshold": float(self.threshold[node]),
+                "threshold": thr,
                 "decision_type": "==" if cat else "<=",
                 "default_left": bool(dleft),
                 "missing_type": ["None", "Zero", "NaN"][mtype],
@@ -277,14 +298,36 @@ def tree_from_device_record(record: Dict[str, np.ndarray], num_nodes: int,
     t.internal_count = np.asarray(record["node_internal_count"][nslice], dtype=np.int64)
     default_left = np.asarray(record["node_default_left"][nslice])
     missing = np.asarray(record["node_missing_type"][nslice], dtype=np.int32)
+    node_is_cat = np.asarray(
+        record.get("node_is_cat", np.zeros(num_nodes, bool))[nslice])
+    node_cat_set = np.asarray(
+        record["node_cat_set"][nslice]) if "node_cat_set" in record else None
     t.decision_type = np.asarray(
-        [Tree.pack_decision_type(False, bool(dl), int(mt))
-         for dl, mt in zip(default_left, missing)], dtype=np.int8)
-    # real-valued thresholds from bin upper bounds
+        [Tree.pack_decision_type(bool(ic), bool(dl) and not ic, int(mt))
+         for ic, dl, mt in zip(node_is_cat, default_left, missing)],
+        dtype=np.int8)
+    # real-valued thresholds from bin upper bounds; categorical nodes store an
+    # index into cat_boundaries/cat_threshold bitsets of CATEGORY VALUES
+    # (reference: Tree::SplitCategorical, src/io/tree.cpp; bitset layout
+    # Common::ConstructBitset)
     thresholds = np.zeros(num_nodes, dtype=np.float64)
     for i in range(num_nodes):
         f = int(t.split_feature[i])
         bm = bin_mappers[f]
+        if node_is_cat[i]:
+            cats = [bm.bin_2_categorical[b]
+                    for b in np.nonzero(node_cat_set[i])[0]
+                    if b < len(bm.bin_2_categorical)
+                    and bm.bin_2_categorical[b] >= 0]
+            n_words = (max(cats) // 32 + 1) if cats else 1
+            words = [0] * n_words
+            for c in cats:
+                words[c // 32] |= (1 << (c % 32))
+            thresholds[i] = t.num_cat
+            t.num_cat += 1
+            t.cat_threshold.extend(words)
+            t.cat_boundaries.append(len(t.cat_threshold))
+            continue
         b = int(t.threshold_bin[i])
         ub = bm.bin_upper_bound
         b = min(b, len(ub) - 1)

@@ -56,6 +56,12 @@ def predict_leaf_binned(binned: jnp.ndarray, node: dict,
             goes_left = split_decision(
                 fb, node["threshold"][nid], node["default_left"][nid],
                 node["missing_type"][nid], node["default_bin"][nid], nb - 1)
+            if "is_cat" in node:
+                # categorical: membership of fb in the node's category set
+                cat_rows = node["cat_set"][nid]            # (n, BF) row gather
+                member = jnp.take_along_axis(
+                    cat_rows, fb[:, None], axis=1)[:, 0]
+                goes_left = jnp.where(node["is_cat"][nid], member, goes_left)
             nxt = jnp.where(goes_left, node["left"][nid], node["right"][nid])
             return jnp.where(active, nxt, c)
 

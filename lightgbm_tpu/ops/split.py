@@ -59,6 +59,8 @@ class BestSplit(NamedTuple):
     right_count: jnp.ndarray
     left_output: jnp.ndarray
     right_output: jnp.ndarray
+    is_cat: jnp.ndarray         # bool — categorical split
+    cat_set: jnp.ndarray        # (BF,) bool — feature-local bins going LEFT
 
 
 def _threshold_l1(s, l1):
@@ -87,12 +89,171 @@ def leaf_gain(sum_g, sum_h, l1, l2, max_delta_step):
     return sg * sg / (sum_h + l2)
 
 
+def find_best_split_categorical(feat_hist: jnp.ndarray, ctx: SplitContext,
+                                sum_g, sum_h_tot, num_data,
+                                l1: float, l2: float, max_delta_step: float,
+                                min_gain_shift, min_data_in_leaf: int,
+                                min_sum_hessian: float,
+                                max_cat_threshold: int, cat_l2: float,
+                                cat_smooth: float, max_cat_to_onehot: int,
+                                min_data_per_group: int):
+    """Per-feature best categorical split, vectorized over (feature, bin).
+
+    Mirrors FindBestThresholdCategoricalInner
+    (src/treelearner/feature_histogram.cpp:144-340):
+      * one-vs-rest when ``num_bin <= max_cat_to_onehot`` (plain lambda_l2);
+      * otherwise bins with estimated count >= cat_smooth are sorted ascending
+        by ``sum_g / (sum_h + cat_smooth)`` and prefix sets are scanned from
+        both ends (at most ``min(max_cat_threshold, (used+1)/2)`` categories),
+        with ``lambda_l2 + cat_l2`` regularization and candidate evaluation
+        gated on ``min_data_per_group`` rows accumulated since the previous
+        candidate;
+      * bin 0 (the NaN/other bin) is never part of the left set, so missing
+        and unseen categories always go right (default_left=false).
+
+    The sequential C++ scan becomes masked cumulative sums along the sorted
+    bin axis plus one short `lax.scan` carrying the per-feature
+    ``cnt_cur_group`` counter; break conditions (monotone in the scan
+    position) become cumulative-max masks.
+
+    Returns per-feature arrays: (gain (F,), member (F, BF) bool,
+    left_g, left_h_incl_eps, left_count, l2_eff (F,)).
+    """
+    F, BF, _ = feat_hist.shape
+    G = feat_hist[..., 0]
+    H = feat_hist[..., 1]
+    cnt_factor = num_data / sum_h_tot
+    l2c = l2 + cat_l2
+
+    bins = jax.lax.broadcasted_iota(jnp.int32, (F, BF), 1)
+    nb = ctx.num_bin[:, None]
+    in_range = (bins >= 1) & (bins < nb)
+    cnt_bin = jnp.floor(H * cnt_factor + 0.5).astype(jnp.int32) * in_range
+    num_data_i = num_data.astype(jnp.int32) if hasattr(num_data, "astype") \
+        else jnp.int32(num_data)
+
+    use_onehot = ctx.num_bin <= max_cat_to_onehot        # (F,)
+
+    # ---- one-vs-rest (feature_histogram.cpp:184-239) ----
+    hess_t = H + K_EPSILON
+    other_g = sum_g - G
+    other_h = sum_h_tot - H - K_EPSILON
+    other_cnt = num_data_i - cnt_bin
+    gain_oh = (leaf_gain(G, hess_t, l1, l2, max_delta_step) +
+               leaf_gain(other_g, other_h, l1, l2, max_delta_step))
+    valid_oh = (in_range & (cnt_bin >= min_data_in_leaf) &
+                (H >= min_sum_hessian) & (other_cnt >= min_data_in_leaf) &
+                (other_h >= min_sum_hessian) & (gain_oh > min_gain_shift))
+    gain_oh = jnp.where(valid_oh, gain_oh, K_MIN_SCORE)
+    best_oh = jnp.argmax(gain_oh, axis=1)                 # (F,)
+    best_oh_gain = jnp.take_along_axis(gain_oh, best_oh[:, None], 1)[:, 0]
+    member_oh = bins == best_oh[:, None]
+
+    # ---- sorted prefix sets (feature_histogram.cpp:240-339) ----
+    valid_s = in_range & (cnt_bin.astype(jnp.float32) >= cat_smooth)
+    ratio = jnp.where(valid_s, G / (H + cat_smooth), jnp.inf)
+    order = jnp.argsort(ratio, axis=1, stable=True)       # ascending
+    inv_rank = jnp.argsort(order, axis=1, stable=True)    # bin -> sorted pos
+    used = valid_s.sum(axis=1).astype(jnp.int32)          # (F,)
+    max_num_cat = jnp.minimum(jnp.int32(max_cat_threshold), (used + 1) // 2)
+
+    sG = jnp.take_along_axis(jnp.where(valid_s, G, 0.0), order, axis=1)
+    sH = jnp.take_along_axis(jnp.where(valid_s, H, 0.0), order, axis=1)
+    sC = jnp.take_along_axis(jnp.where(valid_s, cnt_bin, 0), order, axis=1)
+    pg = jnp.cumsum(sG, axis=1)
+    ph = jnp.cumsum(sH, axis=1)
+    pc = jnp.cumsum(sC, axis=1)
+    tvg = pg[:, -1:]
+    tvh = ph[:, -1:]
+    tvc = pc[:, -1:]
+
+    pos = jax.lax.broadcasted_iota(jnp.int32, (F, BF), 1)
+
+    def prefix_at(p, idx):
+        """p[:, idx] with idx == -1 -> 0 (idx is (F, BF) int32)."""
+        v = jnp.take_along_axis(p, jnp.maximum(idx, 0), axis=1)
+        return jnp.where(idx >= 0, v, jnp.zeros_like(v))
+
+    # forward (dir=+1): left set = sorted[0..i]
+    lg_f = pg
+    lh_f = ph + K_EPSILON
+    lc_f = pc
+    # reverse (dir=-1): left set = sorted[used-1-i .. used-1]
+    rev_idx = used[:, None] - 2 - pos
+    lg_r = tvg - prefix_at(pg, rev_idx)
+    lh_r = tvh - prefix_at(ph, rev_idx) + K_EPSILON
+    lc_r = tvc - prefix_at(pc, rev_idx)
+
+    in_loop = (pos < used[:, None]) & (pos < max_num_cat[:, None])
+    # per-step counts in each direction's visit order: forward visits sorted
+    # position i at step i, reverse visits sorted position used-1-i
+    step_cnt_fwd = sC
+    step_cnt_rev = prefix_at(pc, used[:, None] - 1 - pos) - \
+        prefix_at(pc, used[:, None] - 2 - pos)
+
+    def candidates(lg, lh, lc, step_cnt):
+        rg = sum_g - lg
+        rh = sum_h_tot - lh
+        rc = num_data_i - lc
+        left_ok = (lc >= min_data_in_leaf) & (lh >= min_sum_hessian)
+        broken = ((rc < min_data_in_leaf) | (rc < min_data_per_group) |
+                  (rh < min_sum_hessian))
+        not_broken = jnp.cumsum(broken.astype(jnp.int32), axis=1) == 0
+
+        # cnt_cur_group gate: scan along the sorted axis, carry (F,) counter
+        def step(c, xs):
+            cnt_i, ok_i = xs
+            c = c + cnt_i
+            ev = ok_i & (c >= min_data_per_group)
+            return jnp.where(ev, 0, c), ev
+
+        _, ev = jax.lax.scan(
+            step, jnp.zeros((F,), jnp.int32),
+            (step_cnt.T, (left_ok & not_broken & in_loop).T))
+        evaluated = ev.T
+        gain = (leaf_gain(lg, lh, l1, l2c, max_delta_step) +
+                leaf_gain(rg, rh, l1, l2c, max_delta_step))
+        gain = jnp.where(evaluated & (gain > min_gain_shift),
+                         gain, K_MIN_SCORE)
+        return gain
+
+    gain_fwd = candidates(lg_f, lh_f, lc_f, step_cnt_fwd)
+    gain_rev = candidates(lg_r, lh_r, lc_r, step_cnt_rev)
+    best_i_f = jnp.argmax(gain_fwd, axis=1)               # first wins ties
+    best_g_f = jnp.take_along_axis(gain_fwd, best_i_f[:, None], 1)[:, 0]
+    best_i_r = jnp.argmax(gain_rev, axis=1)
+    best_g_r = jnp.take_along_axis(gain_rev, best_i_r[:, None], 1)[:, 0]
+    use_rev = best_g_r > best_g_f                         # dir=+1 wins ties
+    best_sorted_gain = jnp.where(use_rev, best_g_r, best_g_f)
+    k = jnp.where(use_rev, best_i_r, best_i_f) + 1        # num cats in set
+    member_fwd = inv_rank < k[:, None]
+    member_rev = (inv_rank >= used[:, None] - k[:, None]) & \
+                 (inv_rank < used[:, None])
+    member_sorted = jnp.where(use_rev[:, None], member_rev, member_fwd) & valid_s
+
+    # ---- merge the two modes (exclusive per feature) ----
+    gain_c = jnp.where(use_onehot, best_oh_gain, best_sorted_gain)
+    member = jnp.where(use_onehot[:, None], member_oh, member_sorted)
+    oh_g = jnp.take_along_axis(G, best_oh[:, None], 1)[:, 0]
+    oh_h = jnp.take_along_axis(H, best_oh[:, None], 1)[:, 0] + K_EPSILON
+    oh_c = jnp.take_along_axis(cnt_bin, best_oh[:, None], 1)[:, 0]
+    sel = lambda a_f, a_r: jnp.where(  # noqa: E731
+        use_rev, jnp.take_along_axis(a_r, best_i_r[:, None], 1)[:, 0],
+        jnp.take_along_axis(a_f, best_i_f[:, None], 1)[:, 0])
+    lg_c = jnp.where(use_onehot, oh_g, sel(lg_f, lg_r))
+    lh_c = jnp.where(use_onehot, oh_h, sel(lh_f, lh_r))
+    lc_c = jnp.where(use_onehot, oh_c, sel(lc_f, lc_r).astype(jnp.int32))
+    l2_eff = jnp.where(use_onehot, l2, l2c)
+    return gain_c, member, lg_c, lh_c, lc_c, l2_eff
+
+
 def find_best_split(feat_hist: jnp.ndarray, ctx: SplitContext,
                     sum_g, sum_h, num_data,
                     l1: float, l2: float, max_delta_step: float,
                     min_gain_to_split: float, min_data_in_leaf: int,
                     min_sum_hessian: float,
-                    feature_mask: jnp.ndarray | None = None) -> BestSplit:
+                    feature_mask: jnp.ndarray | None = None,
+                    cat_params: dict | None = None) -> BestSplit:
     """Find the best numerical split for one leaf.
 
     Args:
@@ -210,14 +371,40 @@ def find_best_split(feat_hist: jnp.ndarray, ctx: SplitContext,
     single_nan = (~two_scan & is_nan_miss)[:, 0]
     feat_default_left = jnp.where(use_fwd, False, True) & ~single_nan
 
+    # ---- categorical features (exclusive with the numerical scans) ----
+    cat_mask = ctx.is_categorical != 0
+    if cat_params is not None:
+        (gain_c, member_c, lg_c, lh_c, lc_c, l2_eff_c) = \
+            find_best_split_categorical(
+                feat_hist, ctx, sum_g, sum_h_tot, num_data,
+                l1, l2, max_delta_step, min_gain_shift,
+                min_data_in_leaf, min_sum_hessian,
+                cat_params["max_cat_threshold"], cat_params["cat_l2"],
+                cat_params["cat_smooth"], cat_params["max_cat_to_onehot"],
+                cat_params["min_data_per_group"])
+        if feature_mask is not None:
+            gain_c = jnp.where(feature_mask, gain_c, neg)
+        feat_gain = jnp.where(cat_mask, gain_c, feat_gain)
+    else:
+        member_c = jnp.zeros((F, BF), jnp.bool_)
+        lg_c = jnp.zeros((F,))
+        lh_c = jnp.zeros((F,))
+        lc_c = jnp.zeros((F,), jnp.int32)
+        l2_eff_c = jnp.full((F,), l2)
+
     best_f = jnp.argmax(feat_gain)                   # smallest feature wins ties
     best_gain = feat_gain[best_f]
     best_t = feat_thresh[best_f]
     fwd_sel = use_fwd[best_f]
+    is_cat = cat_mask[best_f]
 
-    lg = jnp.where(fwd_sel, left_g_f[best_f, best_t], left_g_r[best_f, best_t])
-    lh = jnp.where(fwd_sel, left_h_f[best_f, best_t], left_h_r[best_f, best_t])
-    lc = jnp.where(fwd_sel, left_c_f[best_f, best_t], left_c_r[best_f, best_t])
+    lg_n = jnp.where(fwd_sel, left_g_f[best_f, best_t], left_g_r[best_f, best_t])
+    lh_n = jnp.where(fwd_sel, left_h_f[best_f, best_t], left_h_r[best_f, best_t])
+    lc_n = jnp.where(fwd_sel, left_c_f[best_f, best_t], left_c_r[best_f, best_t])
+    lg = jnp.where(is_cat, lg_c[best_f], lg_n)
+    lh = jnp.where(is_cat, lh_c[best_f], lh_n)
+    lc = jnp.where(is_cat, lc_c[best_f], lc_n)
+    l2_out = jnp.where(is_cat, l2_eff_c[best_f], l2)
     rg = sum_g - lg
     rh = sum_h_tot - lh
     rc = num_data.astype(jnp.int32) - lc
@@ -225,11 +412,13 @@ def find_best_split(feat_hist: jnp.ndarray, ctx: SplitContext,
     return BestSplit(
         gain=jnp.where(best_gain > neg, best_gain - min_gain_shift, neg),
         feature=best_f.astype(jnp.int32),
-        threshold=best_t.astype(jnp.int32),
-        default_left=feat_default_left[best_f],
+        threshold=jnp.where(is_cat, 0, best_t).astype(jnp.int32),
+        default_left=jnp.where(is_cat, False, feat_default_left[best_f]),
         left_sum_g=lg, left_sum_h=lh - K_EPSILON,
         right_sum_g=rg, right_sum_h=rh - K_EPSILON,
         left_count=lc.astype(jnp.int32), right_count=rc.astype(jnp.int32),
-        left_output=leaf_output(lg, lh, l1, l2, max_delta_step),
-        right_output=leaf_output(rg, rh, l1, l2, max_delta_step),
+        left_output=leaf_output(lg, lh, l1, l2_out, max_delta_step),
+        right_output=leaf_output(rg, rh, l1, l2_out, max_delta_step),
+        is_cat=is_cat,
+        cat_set=member_c[best_f],
     )

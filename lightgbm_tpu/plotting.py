@@ -1,0 +1,416 @@
+"""Plotting utilities.
+
+API-parity module for the reference's python-package/lightgbm/plotting.py
+(plot_importance:37, plot_split_value_histogram:171, plot_metric:287,
+create_tree_digraph:614, plot_tree:740), re-implemented from scratch:
+
+  * importance / metric / split-value plots use matplotlib directly;
+  * ``plot_tree`` draws the tree natively with matplotlib (no graphviz
+    binary required — unlike the reference, which shells out to dot);
+  * ``create_tree_digraph`` returns a ``graphviz.Digraph`` when the optional
+    ``graphviz`` package is importable, else raises ImportError.
+"""
+
+from __future__ import annotations
+
+from copy import deepcopy
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+import numpy as np
+
+from .basic import Booster, LightGBMError
+
+
+def _check_not_tuple_of_2_elements(obj, obj_name: str) -> None:
+    if not isinstance(obj, tuple) or len(obj) != 2:
+        raise TypeError(f"{obj_name} must be a tuple of 2 elements.")
+
+
+def _to_booster(model) -> Booster:
+    if isinstance(model, Booster):
+        return model
+    if hasattr(model, "booster_"):
+        return model.booster_
+    raise TypeError("model must be a Booster or a fitted LGBMModel")
+
+
+def _import_matplotlib():
+    try:
+        import matplotlib.pyplot as plt
+        return plt
+    except ImportError as e:  # pragma: no cover
+        raise ImportError("You must install matplotlib to use plotting") from e
+
+
+def plot_importance(booster, ax=None, height: float = 0.2,
+                    xlim: Optional[Tuple[float, float]] = None,
+                    ylim: Optional[Tuple[float, float]] = None,
+                    title: Optional[str] = "Feature importance",
+                    xlabel: Optional[str] = "Feature importance",
+                    ylabel: Optional[str] = "Features",
+                    importance_type: str = "auto",
+                    max_num_features: Optional[int] = None,
+                    ignore_zero: bool = True, figsize=None, dpi=None,
+                    grid: bool = True, precision: Optional[int] = 3,
+                    **kwargs):
+    """Horizontal bar chart of feature importances
+    (reference: plotting.py:37-168)."""
+    plt = _import_matplotlib()
+    booster = _to_booster(booster)
+    if importance_type == "auto":
+        importance_type = "split"
+    importance = booster.feature_importance(importance_type=importance_type)
+    feature_name = booster.feature_name()
+    if not len(importance):
+        raise ValueError("Booster's feature_importance is empty.")
+
+    tuples = sorted(zip(feature_name, importance), key=lambda x: x[1])
+    if ignore_zero:
+        tuples = [x for x in tuples if x[1] > 0]
+    if max_num_features is not None and max_num_features > 0:
+        tuples = tuples[-max_num_features:]
+    labels, values = zip(*tuples) if tuples else ((), ())
+
+    if ax is None:
+        if figsize is not None:
+            _check_not_tuple_of_2_elements(figsize, "figsize")
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    ylocs = np.arange(len(values))
+    ax.barh(ylocs, values, align="center", height=height, **kwargs)
+    for x, y in zip(values, ylocs):
+        is_int = importance_type == "split" or float(x).is_integer()
+        txt = f"{int(x)}" if is_int else (
+            f"{x:.{precision}f}" if precision is not None else f"{x}")
+        ax.text(x + 1 if is_int else x, y, txt, va="center")
+    ax.set_yticks(ylocs)
+    ax.set_yticklabels(labels)
+    if xlim is not None:
+        _check_not_tuple_of_2_elements(xlim, "xlim")
+    else:
+        xlim = (0, max(values) * 1.1 if values else 1)
+    ax.set_xlim(xlim)
+    if ylim is not None:
+        _check_not_tuple_of_2_elements(ylim, "ylim")
+    else:
+        ylim = (-1, len(values))
+    ax.set_ylim(ylim)
+    if title is not None:
+        ax.set_title(title)
+    if xlabel is not None:
+        ax.set_xlabel(xlabel)
+    if ylabel is not None:
+        ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
+
+
+def plot_split_value_histogram(booster, feature, bins=None, ax=None,
+                               width_coef: float = 0.8,
+                               xlim=None, ylim=None,
+                               title="Split value histogram for "
+                                     "feature with @index/name@ @feature@",
+                               xlabel="Feature split value",
+                               ylabel="Count", figsize=None, dpi=None,
+                               grid: bool = True, **kwargs):
+    """Histogram of a feature's split threshold values
+    (reference: plotting.py:171-284)."""
+    plt = _import_matplotlib()
+    booster = _to_booster(booster)
+    model = booster.dump_model()
+    feature_names = model.get("feature_names", [])
+    if isinstance(feature, str):
+        if feature not in feature_names:
+            raise ValueError(f"feature {feature} not found")
+        fidx = feature_names.index(feature)
+        ftype = "name"
+    else:
+        fidx = int(feature)
+        ftype = "index"
+
+    values: List[float] = []
+
+    def walk(node):
+        if "split_feature" in node:
+            if node["split_feature"] == fidx and \
+                    node.get("decision_type") == "<=":
+                values.append(node["threshold"])
+            walk(node["left_child"])
+            walk(node["right_child"])
+
+    for tree in model["tree_info"]:
+        walk(tree["tree_structure"])
+    if not values:
+        raise ValueError(
+            "Cannot plot split value histogram, "
+            f"because feature {feature} was not used in splitting")
+    hist, bin_edges = np.histogram(values, bins=bins or "auto")
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    centers = (bin_edges[:-1] + bin_edges[1:]) / 2.0
+    width = width_coef * (bin_edges[1] - bin_edges[0]) \
+        if len(bin_edges) > 1 else width_coef
+    ax.bar(centers, hist, width=width, **kwargs)
+    if xlim is not None:
+        _check_not_tuple_of_2_elements(xlim, "xlim")
+        ax.set_xlim(xlim)
+    if ylim is not None:
+        _check_not_tuple_of_2_elements(ylim, "ylim")
+    else:
+        ylim = (0, max(hist) * 1.1)
+    ax.set_ylim(ylim)
+    if title is not None:
+        title = title.replace("@feature@", str(feature)) \
+                     .replace("@index/name@", ftype)
+        ax.set_title(title)
+    if xlabel is not None:
+        ax.set_xlabel(xlabel)
+    if ylabel is not None:
+        ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
+
+
+def plot_metric(booster, metric: Optional[str] = None,
+                dataset_names: Optional[List[str]] = None,
+                ax=None, xlim=None, ylim=None,
+                title: Optional[str] = "Metric during training",
+                xlabel: Optional[str] = "Iterations",
+                ylabel: Optional[str] = "@metric@",
+                figsize=None, dpi=None, grid: bool = True):
+    """Plot a metric recorded by ``record_evaluation``
+    (reference: plotting.py:287-425)."""
+    plt = _import_matplotlib()
+    if isinstance(booster, dict):
+        eval_results = deepcopy(booster)
+    elif isinstance(booster, Booster) or hasattr(booster, "evals_result_"):
+        if hasattr(booster, "evals_result_"):
+            eval_results = deepcopy(booster.evals_result_)
+        else:
+            raise TypeError(
+                "booster must be a dict from record_evaluation or a fitted "
+                "LGBMModel with evals_result_")
+    else:
+        raise TypeError("booster must be dict or LGBMModel")
+    if not eval_results:
+        raise ValueError("eval results cannot be empty.")
+
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+
+    if dataset_names is None:
+        dataset_names_iter = iter(eval_results.keys())
+    else:
+        dataset_names_iter = iter(dataset_names)
+
+    name = next(dataset_names_iter)
+    metrics_for_one = eval_results[name]
+    num_metric = len(metrics_for_one)
+    if metric is None:
+        if num_metric > 1:
+            raise ValueError("more than one metric available, pick one")
+        metric, results = dict(metrics_for_one).popitem()
+    else:
+        if metric not in metrics_for_one:
+            raise ValueError("No given metric in eval results.")
+        results = metrics_for_one[metric]
+    num_iteration = len(results)
+    max_result = max(results)
+    min_result = min(results)
+    x_ = range(num_iteration)
+    ax.plot(x_, results, label=name)
+
+    for name in dataset_names_iter:
+        metrics_for_one = eval_results[name]
+        results = metrics_for_one[metric]
+        max_result = max(*results, max_result)
+        min_result = min(*results, min_result)
+        ax.plot(x_, results, label=name)
+
+    ax.legend(loc="best")
+    if xlim is not None:
+        _check_not_tuple_of_2_elements(xlim, "xlim")
+    else:
+        xlim = (0, num_iteration)
+    ax.set_xlim(xlim)
+    if ylim is not None:
+        _check_not_tuple_of_2_elements(ylim, "ylim")
+    else:
+        range_result = max_result - min_result
+        ylim = (min_result - range_result * 0.2,
+                max_result + range_result * 0.2)
+    ax.set_ylim(ylim)
+    if title is not None:
+        ax.set_title(title)
+    if xlabel is not None:
+        ax.set_xlabel(xlabel)
+    if ylabel is not None:
+        ax.set_ylabel(ylabel.replace("@metric@", metric))
+    ax.grid(grid)
+    return ax
+
+
+# ----------------------------------------------------------------------
+# tree rendering
+# ----------------------------------------------------------------------
+
+def _tree_nodes(tree_structure: dict):
+    """Flatten a dumped tree into (node_dict, depth, is_leaf) rows plus
+    parent-child edges; assigns x positions by leaf order."""
+    nodes = []
+    edges = []
+    next_x = [0.0]
+
+    def walk(node, depth):
+        my_id = len(nodes)
+        nodes.append([node, depth, "left_child" not in node, 0.0])
+        if "left_child" in node:
+            lid = walk(node["left_child"], depth + 1)
+            rid = walk(node["right_child"], depth + 1)
+            edges.append((my_id, lid, True))
+            edges.append((my_id, rid, False))
+            nodes[my_id][3] = (nodes[lid][3] + nodes[rid][3]) / 2.0
+        else:
+            nodes[my_id][3] = next_x[0]
+            next_x[0] += 1.0
+        return my_id
+
+    walk(tree_structure, 0)
+    return nodes, edges
+
+
+def _node_label(node: dict, feature_names, precision: int,
+                show_info: List[str]) -> str:
+    if "split_feature" in node:
+        f = node["split_feature"]
+        name = feature_names[f] if feature_names and f < len(feature_names) \
+            else f"f{f}"
+        op = node.get("decision_type", "<=")
+        thr = node["threshold"]
+        thr_s = thr if isinstance(thr, str) else f"{thr:.{precision}g}"
+        label = f"{name} {op} {thr_s}"
+        extra = []
+        if "split_gain" in show_info:
+            extra.append(f"gain: {node['split_gain']:.{precision}g}")
+        if "internal_value" in show_info:
+            extra.append(f"value: {node['internal_value']:.{precision}g}")
+        if "internal_count" in show_info:
+            extra.append(f"count: {node['internal_count']}")
+        return "\n".join([label] + extra)
+    extra = []
+    if "leaf_count" in show_info:
+        extra.append(f"count: {node['leaf_count']}")
+    if "leaf_weight" in show_info:
+        extra.append(f"weight: {node['leaf_weight']:.{precision}g}")
+    return "\n".join(
+        [f"leaf {node['leaf_index']}: {node['leaf_value']:.{precision}g}"]
+        + extra)
+
+
+def plot_tree(booster, ax=None, tree_index: int = 0, figsize=None, dpi=None,
+              show_info: Optional[List[str]] = None, precision: int = 3,
+              orientation: str = "horizontal", **kwargs):
+    """Draw one tree natively with matplotlib (reference plot_tree:740
+    renders through graphviz; this implementation has no external binary
+    dependency)."""
+    plt = _import_matplotlib()
+    booster = _to_booster(booster)
+    model = booster.dump_model()
+    if tree_index >= len(model["tree_info"]):
+        raise IndexError(f"tree_index {tree_index} out of range")
+    tree = model["tree_info"][tree_index]
+    feature_names = model.get("feature_names")
+    show_info = show_info or []
+
+    nodes, edges = _tree_nodes(tree["tree_structure"])
+    max_depth = max(d for _, d, _, _ in nodes) if nodes else 0
+    n_leaves = sum(1 for _, _, is_leaf, _ in nodes if is_leaf)
+
+    if ax is None:
+        if figsize is None:
+            figsize = (max(6, n_leaves * 1.8), max(4, (max_depth + 1) * 1.6))
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+
+    horizontal = orientation == "horizontal"
+
+    def xy(node_row):
+        _, depth, _, x = node_row
+        return (depth, -x) if horizontal else (x, -depth)
+
+    for pid, cid, is_left in edges:
+        x0, y0 = xy(nodes[pid])
+        x1, y1 = xy(nodes[cid])
+        ax.plot([x0, x1], [y0, y1], "-", color="0.6", zorder=1)
+        ax.annotate("yes" if is_left else "no",
+                    ((x0 + x1) / 2, (y0 + y1) / 2),
+                    fontsize=7, color="0.4", ha="center", zorder=2)
+
+    for row in nodes:
+        node, depth, is_leaf, _ = row
+        x, y = xy(row)
+        label = _node_label(node, feature_names, precision, show_info)
+        ax.annotate(
+            label, (x, y), ha="center", va="center", fontsize=8, zorder=3,
+            bbox=dict(boxstyle="round,pad=0.4",
+                      fc="#e8f4e8" if is_leaf else "#e8eef8",
+                      ec="0.5"))
+    ax.axis("off")
+    ax.set_title(f"Tree {tree_index}")
+    return ax
+
+
+def create_tree_digraph(booster, tree_index: int = 0,
+                        show_info: Optional[List[str]] = None,
+                        precision: int = 3,
+                        orientation: str = "horizontal",
+                        name: Optional[str] = None, comment: Optional[str] = None,
+                        filename: Optional[str] = None,
+                        directory: Optional[str] = None,
+                        format: Optional[str] = None,  # noqa: A002
+                        engine: Optional[str] = None,
+                        encoding: Optional[str] = None,
+                        graph_attr: Optional[Dict[str, str]] = None,
+                        node_attr: Optional[Dict[str, str]] = None,
+                        edge_attr: Optional[Dict[str, str]] = None):
+    """Build a graphviz Digraph of one tree (reference: plotting.py:614).
+
+    Requires the optional ``graphviz`` python package.
+    """
+    try:
+        import graphviz
+    except ImportError as e:
+        raise ImportError(
+            "You must install graphviz to use create_tree_digraph; "
+            "plot_tree renders natively with matplotlib instead") from e
+    booster = _to_booster(booster)
+    model = booster.dump_model()
+    if tree_index >= len(model["tree_info"]):
+        raise IndexError(f"tree_index {tree_index} out of range")
+    tree = model["tree_info"][tree_index]
+    feature_names = model.get("feature_names")
+    show_info = show_info or []
+
+    graph = graphviz.Digraph(
+        name=name, comment=comment, filename=filename, directory=directory,
+        format=format, engine=engine, encoding=encoding,
+        graph_attr=graph_attr, node_attr=node_attr, edge_attr=edge_attr)
+    if orientation == "horizontal":
+        graph.attr(rankdir="LR")
+
+    counter = [0]
+
+    def walk(node) -> str:
+        nid = f"n{counter[0]}"
+        counter[0] += 1
+        label = _node_label(node, feature_names, precision, show_info) \
+            .replace("\n", "\\n")
+        is_leaf = "split_feature" not in node
+        graph.node(nid, label=label, shape="box" if not is_leaf else "ellipse")
+        if not is_leaf:
+            lid = walk(node["left_child"])
+            rid = walk(node["right_child"])
+            graph.edge(nid, lid, label="yes")
+            graph.edge(nid, rid, label="no")
+        return nid
+
+    walk(tree["tree_structure"])
+    return graph

@@ -1,0 +1,80 @@
+"""Categorical split tests.
+
+Mirrors the reference's categorical coverage in
+tests/python_package_test/test_engine.py (test_categorical_handling et al.):
+one-hot mode (few categories), sorted-subset mode (many categories),
+missing/unseen categories routed right, and model text round-trips.
+"""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _cat_data(n=4000, k=12, seed=0, in_set=(2, 5, 7, 11)):
+    rng = np.random.RandomState(seed)
+    cat = rng.randint(0, k, size=n)
+    x1 = rng.normal(size=n)
+    y = (np.isin(cat, list(in_set)).astype(float) * 2.0 + 0.3 * x1 +
+         0.1 * rng.normal(size=n))
+    X = np.column_stack([cat.astype(float), x1])
+    return X, y
+
+
+def test_sorted_mode_recovers_category_set():
+    # 12 categories > max_cat_to_onehot=4 -> sorted-subset scan
+    X, y = _cat_data()
+    ds = lgb.Dataset(X, label=y, categorical_feature=[0])
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "learning_rate": 0.2, "verbosity": -1,
+                     "min_data_in_leaf": 20}, ds, num_boost_round=30)
+    pred = bst.predict(X)
+    mse = float(np.mean((pred - y) ** 2))
+    assert mse < 0.05
+    ncat = sum(t["num_cat"] for t in bst.dump_model()["tree_info"])
+    assert ncat > 0
+
+
+def test_onehot_mode():
+    # 3 categories <= max_cat_to_onehot -> one-vs-rest
+    X, y = _cat_data(k=3, in_set=(1,))
+    ds = lgb.Dataset(X, label=y, categorical_feature=[0])
+    bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                     "learning_rate": 0.3, "verbosity": -1,
+                     "min_data_in_leaf": 20}, ds, num_boost_round=20)
+    mse = float(np.mean((bst.predict(X) - y) ** 2))
+    assert mse < 0.05
+    assert sum(t["num_cat"] for t in bst.dump_model()["tree_info"]) > 0
+
+
+def test_text_roundtrip_and_unseen_category():
+    X, y = _cat_data()
+    ds = lgb.Dataset(X, label=y, categorical_feature=[0])
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "verbosity": -1, "min_data_in_leaf": 20},
+                    ds, num_boost_round=10)
+    bst2 = lgb.Booster(model_str=bst.model_to_string())
+    Xq = X.copy()
+    Xq[:5, 0] = 99.0          # unseen category -> not in any left set
+    Xq[5:10, 0] = np.nan      # missing -> right
+    p1 = bst.predict(Xq)
+    p2 = bst2.predict(Xq)
+    np.testing.assert_allclose(p1, p2, rtol=1e-12)
+    assert np.all(np.isfinite(p1))
+
+
+def test_categorical_binary_classification():
+    rng = np.random.RandomState(7)
+    n = 3000
+    cat = rng.randint(0, 20, size=n)
+    logit = np.where(np.isin(cat, [1, 3, 8, 13, 17]), 1.5, -1.5)
+    yb = (logit + rng.logistic(size=n) > 0).astype(float)
+    X = np.column_stack([cat.astype(float), rng.normal(size=n)])
+    ds = lgb.Dataset(X, label=yb, categorical_feature=[0])
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1, "min_data_in_leaf": 20},
+                    ds, num_boost_round=30)
+    pred = bst.predict(X)
+    acc = float(np.mean((pred > 0.5) == yb))
+    assert acc > 0.7

@@ -1,0 +1,64 @@
+"""Plotting tests (reference: tests/python_package_test/test_plotting.py)."""
+
+import matplotlib
+matplotlib.use("Agg")
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+@pytest.fixture(scope="module")
+def trained():
+    rng = np.random.RandomState(0)
+    X = rng.normal(size=(500, 5))
+    y = X[:, 0] * 2 + X[:, 1] - X[:, 2] + 0.1 * rng.normal(size=500)
+    evals = {}
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                     "verbosity": -1},
+                    ds, num_boost_round=10,
+                    valid_sets=[ds], valid_names=["train"],
+                    callbacks=[lgb.record_evaluation(evals)])
+    return bst, evals
+
+
+def test_plot_importance(trained):
+    bst, _ = trained
+    ax = lgb.plot_importance(bst)
+    assert len(ax.patches) > 0
+    ax2 = lgb.plot_importance(bst, importance_type="gain",
+                              max_num_features=2)
+    assert len(ax2.patches) <= 2
+
+
+def test_plot_metric(trained):
+    _, evals = trained
+    ax = lgb.plot_metric(evals)
+    assert len(ax.lines) == 1
+
+
+def test_plot_tree(trained):
+    bst, _ = trained
+    ax = lgb.plot_tree(bst, tree_index=0)
+    assert len(ax.texts) > 0
+    with pytest.raises(IndexError):
+        lgb.plot_tree(bst, tree_index=999)
+
+
+def test_plot_split_value_histogram(trained):
+    bst, _ = trained
+    ax = lgb.plot_split_value_histogram(bst, feature=0)
+    assert len(ax.patches) > 0
+
+
+def test_create_tree_digraph_gate(trained):
+    bst, _ = trained
+    try:
+        import graphviz  # noqa: F401
+        g = lgb.create_tree_digraph(bst, tree_index=0)
+        assert "yes" in g.source
+    except ImportError:
+        with pytest.raises(ImportError):
+            lgb.create_tree_digraph(bst, tree_index=0)
