@@ -51,6 +51,31 @@ from ..utils import log
 NEG_INF = float("-inf")
 
 
+def parse_monotone_constraints(spec, num_total_features: int) -> np.ndarray:
+    """Parse the `monotone_constraints` param ("1,-1,0" / list) into a
+    per-original-feature int8 array (reference: config parsing of
+    monotone_constraints, config_auto.cpp)."""
+    out = np.zeros(num_total_features, dtype=np.int32)
+    if spec is None:
+        return out
+    if isinstance(spec, str):
+        spec = spec.strip().strip("()[]")
+        if not spec:
+            return out
+        items = [s for s in spec.replace(" ", "").split(",") if s]
+    else:
+        items = list(spec)
+    vals = [int(v) for v in items]
+    if len(vals) > num_total_features:
+        raise ValueError(
+            f"monotone_constraints has {len(vals)} entries but the dataset "
+            f"has {num_total_features} features")
+    out[:len(vals)] = vals
+    if np.any((out < -1) | (out > 1)):
+        raise ValueError("monotone_constraints entries must be -1, 0 or 1")
+    return out
+
+
 def _pow2ceil(x: int) -> int:
     p = 1
     while p < x:
@@ -108,6 +133,21 @@ class SerialTreeLearner:
         self.f_bin_start = jnp.asarray(meta["bin_start"])
         self.f_is_bundled = jnp.asarray(is_bundled)
         self.has_categorical = bool(np.any(meta["is_categorical"]))
+
+        # ---- monotone constraints (basic mode) ----
+        mono_all = parse_monotone_constraints(
+            config.monotone_constraints, dataset.num_total_features)
+        mono_used = mono_all[meta["feature"]].astype(np.int32)
+        mono_used[meta["is_categorical"] != 0] = 0  # numerical only
+        self.use_mc = bool(np.any(mono_used != 0))
+        self.monotone = jnp.asarray(mono_used) if self.use_mc else None
+        self.monotone_penalty = float(config.monotone_penalty)
+        if self.use_mc and config.monotone_constraints_method not in (
+                "basic",):
+            log.warning(
+                f"monotone_constraints_method="
+                f"{config.monotone_constraints_method} is not implemented; "
+                f"falling back to 'basic'")
         self.cat_params = None
         if self.has_categorical:
             self.cat_params = {
@@ -167,6 +207,8 @@ class SerialTreeLearner:
         self._part0 = None
         if local_num_data is None:
             binned = np.ascontiguousarray(dataset.binned)
+            if binned.shape[1] < self.G:   # zero usable features
+                binned = np.zeros((binned.shape[0], self.G), binned.dtype)
             front = np.zeros((C, self.G), binned.dtype)
             tail = np.zeros((self.N_pad - C - self.N, self.G), binned.dtype)
             self._part0 = jnp.asarray(np.concatenate([front, binned, tail]))
@@ -181,7 +223,7 @@ class SerialTreeLearner:
         self.max_depth = int(config.max_depth)
 
         self._best_split_vmapped = jax.vmap(
-            self._leaf_best_split, in_axes=(0, 0, 0, 0, 0, None))
+            self._leaf_best_split, in_axes=(0, 0, 0, 0, 0, 0, 0, None))
         self._build = jax.jit(self._build_impl)
 
     # ------------------------------------------------------------------
@@ -309,7 +351,18 @@ class SerialTreeLearner:
         return moved, nl
 
     # ------------------------------------------------------------------
-    def _leaf_best_split(self, hist_group, sum_g, sum_h, cnt, depth, feature_mask):
+    def _leaf_best_split(self, hist_group, sum_g, sum_h, cnt, depth,
+                         cmin, cmax, feature_mask):
+        if self.F == 0:   # no usable features: every tree is a stub
+            z = jnp.float32(0.0)
+            zi = jnp.int32(0)
+            return split_ops.BestSplit(
+                gain=jnp.float32(-jnp.inf), feature=zi, threshold=zi,
+                default_left=jnp.bool_(False),
+                left_sum_g=z, left_sum_h=z, right_sum_g=z, right_sum_h=z,
+                left_count=zi, right_count=zi, left_output=z, right_output=z,
+                is_cat=jnp.bool_(False),
+                cat_set=jnp.zeros((self.BF,), jnp.bool_))
         flat = hist_group.reshape(self.G * self.B, 2)
         flat = jnp.concatenate([flat, jnp.zeros((1, 2), dtype=flat.dtype)], axis=0)
         feat_hist = jnp.take(flat, self.feat_gather, axis=0)  # (F, BF, 2)
@@ -322,7 +375,10 @@ class SerialTreeLearner:
             feat_hist, self.ctx, sum_g, sum_h, cnt,
             self.l1, self.l2, self.max_delta_step, self.min_gain_to_split,
             self.min_data_in_leaf, self.min_sum_hessian, feature_mask,
-            cat_params=self.cat_params)
+            cat_params=self.cat_params,
+            monotone=self.monotone if self.use_mc else None,
+            cmin=cmin, cmax=cmax, depth=depth,
+            monotone_penalty=self.monotone_penalty)
         depth_ok = (self.max_depth <= 0) | (depth < self.max_depth)
         gain = jnp.where(depth_ok, best.gain, -jnp.inf)
         return best._replace(gain=gain)
@@ -367,8 +423,11 @@ class SerialTreeLearner:
         bag_cnt_g = self._psum(bag_cnt)
         sum_g = root_hist[0, :, 0].sum()
         sum_h = root_hist[0, :, 1].sum()
+        neg_inf = jnp.float32(-jnp.inf)
+        pos_inf = jnp.float32(jnp.inf)
         best0 = self._sync_best(self._leaf_best_split(
-            root_hist, sum_g, sum_h, bag_cnt_g, jnp.int32(0), feature_mask))
+            root_hist, sum_g, sum_h, bag_cnt_g, jnp.int32(0),
+            neg_inf, pos_inf, feature_mask))
 
         def arr(val, dtype=jnp.float32):
             return jnp.full((L,), val, dtype=dtype)
@@ -389,6 +448,8 @@ class SerialTreeLearner:
             "leaf_sum_g": arr(0.0).at[0].set(sum_g),
             "leaf_sum_h": arr(0.0).at[0].set(sum_h),
             "leaf_depth": arr(0, jnp.int32),
+            "leaf_cmin": arr(-jnp.inf),
+            "leaf_cmax": arr(jnp.inf),
             "leaf_value": arr(0.0),
             "leaf_parent_node": arr(-1, jnp.int32),
             "leaf_parent_side": arr(0, jnp.int32),
@@ -496,6 +557,26 @@ class SerialTreeLearner:
                 rout = st["best_rout"][best_leaf]
                 depth_child = st["leaf_depth"][best_leaf] + 1
 
+                # basic-mode monotone bounds for the children (reference:
+                # BasicLeafConstraints::Update, monotone_constraints.hpp:488)
+                p_cmin = st["leaf_cmin"][best_leaf]
+                p_cmax = st["leaf_cmax"][best_leaf]
+                if self.use_mc:
+                    mono_f = self.monotone[f_enum]
+                    mid = (lout + rout) * 0.5
+                    num_split = ~is_cat
+                    l_cmin = jnp.where(num_split & (mono_f < 0),
+                                       jnp.maximum(p_cmin, mid), p_cmin)
+                    l_cmax = jnp.where(num_split & (mono_f > 0),
+                                       jnp.minimum(p_cmax, mid), p_cmax)
+                    r_cmin = jnp.where(num_split & (mono_f > 0),
+                                       jnp.maximum(p_cmin, mid), p_cmin)
+                    r_cmax = jnp.where(num_split & (mono_f < 0),
+                                       jnp.minimum(p_cmax, mid), p_cmax)
+                else:
+                    l_cmin = r_cmin = p_cmin
+                    l_cmax = r_cmax = p_cmax
+
                 # record the internal node (reference: Tree::Split, tree.cpp)
                 upd = dict(moved)
                 upd.update({
@@ -537,7 +618,9 @@ class SerialTreeLearner:
                     jnp.stack([hist_left, hist_right]),
                     jnp.stack([lsg, rsg]), jnp.stack([lsh, rsh]),
                     jnp.stack([left_cnt_g, right_cnt_g]),
-                    jnp.stack([depth_child, depth_child]), feature_mask)
+                    jnp.stack([depth_child, depth_child]),
+                    jnp.stack([l_cmin, r_cmin]),
+                    jnp.stack([l_cmax, r_cmax]), feature_mask)
                 best_l = self._sync_best(jax.tree.map(lambda a: a[0], both))
                 best_r = self._sync_best(jax.tree.map(lambda a: a[1], both))
 
@@ -554,6 +637,8 @@ class SerialTreeLearner:
                     "leaf_sum_g": seta("leaf_sum_g", lsg, rsg),
                     "leaf_sum_h": seta("leaf_sum_h", lsh, rsh),
                     "leaf_depth": seta("leaf_depth", depth_child, depth_child),
+                    "leaf_cmin": seta("leaf_cmin", l_cmin, r_cmin),
+                    "leaf_cmax": seta("leaf_cmax", l_cmax, r_cmax),
                     "leaf_value": seta("leaf_value", lout, rout),
                     "leaf_parent_node": seta("leaf_parent_node", s, s),
                     "leaf_parent_side": seta("leaf_parent_side", 0, 1),
@@ -582,6 +667,8 @@ class SerialTreeLearner:
 
             return jax.lax.cond(gain > 0, do_split, no_split, st)
 
+        if self.F == 0:   # no splittable features: the root is the only leaf
+            return state
         final = jax.lax.while_loop(cond, body, state)
         return final
 

@@ -110,6 +110,18 @@ class Tree:
             active = still
         return result
 
+    def _cat_np(self):
+        """Cached ndarray views of the category bitsets (rebuilt only when
+        the underlying lists grow)."""
+        cached = getattr(self, "_cat_cache", None)
+        if cached is None or cached[2] != len(self.cat_threshold):
+            bounds = np.asarray(self.cat_boundaries, dtype=np.int64)
+            words = np.asarray(self.cat_threshold, dtype=np.uint32) \
+                if self.cat_threshold else np.zeros(1, dtype=np.uint32)
+            cached = (bounds, words, len(self.cat_threshold))
+            self._cat_cache = cached
+        return cached[0], cached[1]
+
     def _categorical_decision(self, nid, fval):
         """reference: tree.h CategoricalDecision:400 (bitset membership).
 
@@ -118,12 +130,13 @@ class Tree:
         """
         nid = np.asarray(nid)
         is_cat = (self.decision_type[nid] & K_CATEGORICAL_MASK) != 0
-        ok = is_cat & np.isfinite(fval) & (fval >= 0)
-        iv = np.where(ok, fval, 0).astype(np.int64)
+        # the reference truncates toward zero (static_cast<int>) and sends
+        # negative ints right; values beyond int32 cannot be categories
+        tv = np.trunc(fval)
+        ok = is_cat & np.isfinite(fval) & (tv >= 0) & (tv < 2.0 ** 31)
+        iv = np.where(ok, tv, 0).astype(np.int64)
         cat_idx = np.where(is_cat, self.threshold[nid], 0).astype(np.int64)
-        bounds = np.asarray(self.cat_boundaries, dtype=np.int64)
-        words = np.asarray(self.cat_threshold, dtype=np.uint32) \
-            if self.cat_threshold else np.zeros(1, dtype=np.uint32)
+        bounds, words = self._cat_np()
         lo = bounds[cat_idx]
         hi = bounds[np.minimum(cat_idx + 1, len(bounds) - 1)]
         word = iv // 32

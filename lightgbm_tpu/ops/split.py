@@ -96,7 +96,8 @@ def find_best_split_categorical(feat_hist: jnp.ndarray, ctx: SplitContext,
                                 min_sum_hessian: float,
                                 max_cat_threshold: int, cat_l2: float,
                                 cat_smooth: float, max_cat_to_onehot: int,
-                                min_data_per_group: int):
+                                min_data_per_group: int,
+                                cmin=None, cmax=None):
     """Per-feature best categorical split, vectorized over (feature, bin).
 
     Mirrors FindBestThresholdCategoricalInner
@@ -125,6 +126,20 @@ def find_best_split_categorical(feat_hist: jnp.ndarray, ctx: SplitContext,
     cnt_factor = num_data / sum_h_tot
     l2c = l2 + cat_l2
 
+    def pair_gain(lg, lh, rg, rh, l2_eff):
+        """Two-sided gain; with monotone bounds active the child outputs are
+        clipped to [cmin, cmax] first (reference: constrained
+        CalculateSplittedLeafOutput + GetLeafGainGivenOutput)."""
+        if cmin is None:
+            return (leaf_gain(lg, lh, l1, l2_eff, max_delta_step) +
+                    leaf_gain(rg, rh, l1, l2_eff, max_delta_step))
+        lo = jnp.clip(leaf_output(lg, lh, l1, l2_eff, max_delta_step),
+                      cmin, cmax)
+        ro = jnp.clip(leaf_output(rg, rh, l1, l2_eff, max_delta_step),
+                      cmin, cmax)
+        return (_leaf_gain_given_output(lg, lh, l1, l2_eff, lo) +
+                _leaf_gain_given_output(rg, rh, l1, l2_eff, ro))
+
     bins = jax.lax.broadcasted_iota(jnp.int32, (F, BF), 1)
     nb = ctx.num_bin[:, None]
     in_range = (bins >= 1) & (bins < nb)
@@ -139,8 +154,7 @@ def find_best_split_categorical(feat_hist: jnp.ndarray, ctx: SplitContext,
     other_g = sum_g - G
     other_h = sum_h_tot - H - K_EPSILON
     other_cnt = num_data_i - cnt_bin
-    gain_oh = (leaf_gain(G, hess_t, l1, l2, max_delta_step) +
-               leaf_gain(other_g, other_h, l1, l2, max_delta_step))
+    gain_oh = pair_gain(G, hess_t, other_g, other_h, l2)
     valid_oh = (in_range & (cnt_bin >= min_data_in_leaf) &
                 (H >= min_sum_hessian) & (other_cnt >= min_data_in_leaf) &
                 (other_h >= min_sum_hessian) & (gain_oh > min_gain_shift))
@@ -211,8 +225,7 @@ def find_best_split_categorical(feat_hist: jnp.ndarray, ctx: SplitContext,
             step, jnp.zeros((F,), jnp.int32),
             (step_cnt.T, (left_ok & not_broken & in_loop).T))
         evaluated = ev.T
-        gain = (leaf_gain(lg, lh, l1, l2c, max_delta_step) +
-                leaf_gain(rg, rh, l1, l2c, max_delta_step))
+        gain = pair_gain(lg, lh, rg, rh, l2c)
         gain = jnp.where(evaluated & (gain > min_gain_shift),
                          gain, K_MIN_SCORE)
         return gain
@@ -253,7 +266,10 @@ def find_best_split(feat_hist: jnp.ndarray, ctx: SplitContext,
                     min_gain_to_split: float, min_data_in_leaf: int,
                     min_sum_hessian: float,
                     feature_mask: jnp.ndarray | None = None,
-                    cat_params: dict | None = None) -> BestSplit:
+                    cat_params: dict | None = None,
+                    monotone: jnp.ndarray | None = None,
+                    cmin=None, cmax=None, depth=None,
+                    monotone_penalty: float = 0.0) -> BestSplit:
     """Find the best numerical split for one leaf.
 
     Args:
@@ -264,6 +280,13 @@ def find_best_split(feat_hist: jnp.ndarray, ctx: SplitContext,
         pad is applied here like FindBestThreshold, feature_histogram.hpp:165).
       feature_mask: optional (F,) bool — features allowed at this node
         (feature_fraction / interaction constraints).
+      monotone: optional (F,) int32 per-feature monotone direction (+1/-1/0);
+        when given, basic-mode monotone constraints are active (reference:
+        monotone_constraints.hpp BasicLeafConstraints + the USE_MC arms of
+        feature_histogram.hpp GetSplitGains): child outputs are clipped to
+        the leaf's [cmin, cmax] bounds, candidates violating the direction
+        are rejected, and `monotone_penalty` shrinks gains of splits on
+        monotone features by depth (serial_tree_learner.cpp:988).
     """
     F, BF, _ = feat_hist.shape
     G = feat_hist[..., 0]
@@ -320,12 +343,27 @@ def find_best_split(feat_hist: jnp.ndarray, ctx: SplitContext,
     left_h_r = sum_h_tot - right_h_r
     left_c_r = num_data.astype(jnp.int32) - right_c_r
 
-    gain_shift = leaf_gain(sum_g, sum_h_tot, l1, l2, max_delta_step)
+    use_mc = monotone is not None
+    if use_mc:
+        parent_out = jnp.clip(
+            leaf_output(sum_g, sum_h_tot, l1, l2, max_delta_step), cmin, cmax)
+        gain_shift = _leaf_gain_given_output(sum_g, sum_h_tot, l1, l2,
+                                             parent_out)
+    else:
+        gain_shift = leaf_gain(sum_g, sum_h_tot, l1, l2, max_delta_step)
     min_gain_shift = gain_shift + min_gain_to_split
 
     def side_gain(gl, hl, gr, hr):
-        return (leaf_gain(gl, hl, l1, l2, max_delta_step) +
-                leaf_gain(gr, hr, l1, l2, max_delta_step))
+        if not use_mc:
+            return (leaf_gain(gl, hl, l1, l2, max_delta_step) +
+                    leaf_gain(gr, hr, l1, l2, max_delta_step))
+        lo = jnp.clip(leaf_output(gl, hl, l1, l2, max_delta_step), cmin, cmax)
+        ro = jnp.clip(leaf_output(gr, hr, l1, l2, max_delta_step), cmin, cmax)
+        g = (_leaf_gain_given_output(gl, hl, l1, l2, lo) +
+             _leaf_gain_given_output(gr, hr, l1, l2, ro))
+        mono = monotone[:, None]
+        bad = ((mono > 0) & (lo > ro)) | ((mono < 0) & (lo < ro))
+        return jnp.where(bad, K_MIN_SCORE, g)
 
     gain_f = side_gain(left_g_f, left_h_f, right_g_f, right_h_f)
     gain_r = side_gain(left_g_r, left_h_r, right_g_r, right_h_r)
@@ -381,7 +419,9 @@ def find_best_split(feat_hist: jnp.ndarray, ctx: SplitContext,
                 min_data_in_leaf, min_sum_hessian,
                 cat_params["max_cat_threshold"], cat_params["cat_l2"],
                 cat_params["cat_smooth"], cat_params["max_cat_to_onehot"],
-                cat_params["min_data_per_group"])
+                cat_params["min_data_per_group"],
+                cmin=cmin if use_mc else None,
+                cmax=cmax if use_mc else None)
         if feature_mask is not None:
             gain_c = jnp.where(feature_mask, gain_c, neg)
         feat_gain = jnp.where(cat_mask, gain_c, feat_gain)
@@ -391,6 +431,20 @@ def find_best_split(feat_hist: jnp.ndarray, ctx: SplitContext,
         lh_c = jnp.zeros((F,))
         lc_c = jnp.zeros((F,), jnp.int32)
         l2_eff_c = jnp.full((F,), l2)
+
+    if use_mc and monotone_penalty > 0:
+        # gain *= penalty for splits on monotone features
+        # (serial_tree_learner.cpp:987-991; penalty from
+        # monotone_constraints.hpp:357 as a function of leaf depth)
+        d = depth.astype(jnp.float32)
+        pen = jnp.where(
+            monotone_penalty >= d + 1.0, K_EPSILON,
+            jnp.where(jnp.float32(monotone_penalty) <= 1.0,
+                      1.0 - monotone_penalty / jnp.exp2(d) + K_EPSILON,
+                      1.0 - jnp.exp2(monotone_penalty - 1.0 - d) + K_EPSILON))
+        rel = feat_gain - min_gain_shift
+        rel = jnp.where(monotone != 0, rel * pen, rel)
+        feat_gain = jnp.where(feat_gain > neg, min_gain_shift + rel, neg)
 
     best_f = jnp.argmax(feat_gain)                   # smallest feature wins ties
     best_gain = feat_gain[best_f]
@@ -409,6 +463,12 @@ def find_best_split(feat_hist: jnp.ndarray, ctx: SplitContext,
     rh = sum_h_tot - lh
     rc = num_data.astype(jnp.int32) - lc
 
+    lout_best = leaf_output(lg, lh, l1, l2_out, max_delta_step)
+    rout_best = leaf_output(rg, rh, l1, l2_out, max_delta_step)
+    if use_mc:
+        lout_best = jnp.clip(lout_best, cmin, cmax)
+        rout_best = jnp.clip(rout_best, cmin, cmax)
+
     return BestSplit(
         gain=jnp.where(best_gain > neg, best_gain - min_gain_shift, neg),
         feature=best_f.astype(jnp.int32),
@@ -417,8 +477,8 @@ def find_best_split(feat_hist: jnp.ndarray, ctx: SplitContext,
         left_sum_g=lg, left_sum_h=lh - K_EPSILON,
         right_sum_g=rg, right_sum_h=rh - K_EPSILON,
         left_count=lc.astype(jnp.int32), right_count=rc.astype(jnp.int32),
-        left_output=leaf_output(lg, lh, l1, l2_out, max_delta_step),
-        right_output=leaf_output(rg, rh, l1, l2_out, max_delta_step),
+        left_output=lout_best,
+        right_output=rout_best,
         is_cat=is_cat,
         cat_set=member_c[best_f],
     )

@@ -297,12 +297,14 @@ def _node_label(node: dict, feature_names, precision: int,
             extra.append(f"count: {node['internal_count']}")
         return "\n".join([label] + extra)
     extra = []
-    if "leaf_count" in show_info:
+    if "leaf_count" in show_info and "leaf_count" in node:
         extra.append(f"count: {node['leaf_count']}")
-    if "leaf_weight" in show_info:
+    if "leaf_weight" in show_info and "leaf_weight" in node:
         extra.append(f"weight: {node['leaf_weight']:.{precision}g}")
+    # single-leaf (constant) trees dump as {'leaf_value': v} with no index
+    leaf_idx = node.get("leaf_index", 0)
     return "\n".join(
-        [f"leaf {node['leaf_index']}: {node['leaf_value']:.{precision}g}"]
+        [f"leaf {leaf_idx}: {node['leaf_value']:.{precision}g}"]
         + extra)
 
 

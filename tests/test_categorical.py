@@ -78,3 +78,21 @@ def test_categorical_binary_classification():
     pred = bst.predict(X)
     acc = float(np.mean((pred > 0.5) == yb))
     assert acc > 0.7
+
+
+def test_categorical_predict_edge_values():
+    """Huge, fractional-negative and NaN values must not crash and must
+    follow the reference's int-truncation semantics (tree.h:400)."""
+    rng = np.random.RandomState(0)
+    X = rng.randint(0, 5, size=(400, 1)).astype(float)
+    y = (X[:, 0] % 2).astype(float)
+    bst = lgb.train({"objective": "regression", "num_leaves": 8,
+                     "min_data_in_leaf": 5, "verbosity": -1},
+                    lgb.Dataset(X, label=y, categorical_feature=[0]),
+                    num_boost_round=5)
+    for v in (1e19, -1e19, -0.5, np.nan, np.inf, -np.inf):
+        p = bst.predict(np.array([[v]]))      # must not raise
+        assert np.isfinite(p).all()
+    # truncation toward zero: -0.5 behaves like category 0
+    assert np.allclose(bst.predict(np.array([[-0.5]])),
+                       bst.predict(np.array([[0.0]])))

@@ -132,3 +132,14 @@ def test_l1_objective_renewal():
     pred = bst.predict(X)
     mae0 = np.mean(np.abs(y - np.median(y)))
     assert np.mean(np.abs(y - pred)) < 0.7 * mae0
+
+
+def test_constant_dataset_trains_stub_trees():
+    """Zero usable features: training must produce constant predictions
+    (reference: BoostFromAverage with no splittable features)."""
+    X = np.zeros((50, 2))
+    y = np.full(50, 3.0)
+    bst = lgb.train({"objective": "regression", "num_leaves": 4,
+                     "verbosity": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=2)
+    assert np.allclose(bst.predict(X), 3.0)
