@@ -221,9 +221,10 @@ class SerialTreeLearner:
         self.min_data_in_leaf = int(config.min_data_in_leaf)
         self.min_sum_hessian = float(config.min_sum_hessian_in_leaf)
         self.max_depth = int(config.max_depth)
+        self.top_k = int(config.top_k)
 
         self._best_split_vmapped = jax.vmap(
-            self._leaf_best_split, in_axes=(0, 0, 0, 0, 0, 0, 0, None))
+            self._leaf_best_split, in_axes=(0, 0, 0, 0, 0, 0, 0, 0, None))
         self._build = jax.jit(self._build_impl)
 
     # ------------------------------------------------------------------
@@ -351,8 +352,8 @@ class SerialTreeLearner:
         return moved, nl
 
     # ------------------------------------------------------------------
-    def _leaf_best_split(self, hist_group, sum_g, sum_h, cnt, depth,
-                         cmin, cmax, feature_mask):
+    def _leaf_best_split(self, hist_group, sum_g, sum_h, cnt, local_cnt,
+                         depth, cmin, cmax, feature_mask):
         if self.F == 0:   # no usable features: every tree is a stub
             z = jnp.float32(0.0)
             zi = jnp.int32(0)
@@ -363,25 +364,82 @@ class SerialTreeLearner:
                 left_count=zi, right_count=zi, left_output=z, right_output=z,
                 is_cat=jnp.bool_(False),
                 cat_set=jnp.zeros((self.BF,), jnp.bool_))
+        if self.parallel_mode == "voting" and self.axis_name is not None:
+            return self._leaf_best_split_voting(
+                hist_group, sum_g, sum_h, cnt, local_cnt, depth, cmin, cmax,
+                feature_mask)
+        feat_hist = self._feat_view(hist_group, sum_g, sum_h)
+        best = self._find_best(feat_hist, sum_g, sum_h, cnt, depth,
+                               cmin, cmax, feature_mask)
+        return self._depth_guard(best, depth)
+
+    def _feat_view(self, hist_group, sum_g, sum_h):
+        """(G, B, 2) group histogram -> (F, BF, 2) per-feature view with the
+        default-bin stats of bundled features reconstructed from the leaf
+        totals (reference: FixHistogram, cuda_histogram_constructor.cu:738)."""
         flat = hist_group.reshape(self.G * self.B, 2)
         flat = jnp.concatenate([flat, jnp.zeros((1, 2), dtype=flat.dtype)], axis=0)
         feat_hist = jnp.take(flat, self.feat_gather, axis=0)  # (F, BF, 2)
-        # reconstruct the default-bin stats of bundled features from the leaf
-        # totals (reference: FixHistogram, cuda_histogram_constructor.cu:738)
         known = feat_hist.sum(axis=1)
         fix = (jnp.stack([sum_g, sum_h]) - known) * self.fix_mask[:, None]
-        feat_hist = feat_hist.at[jnp.arange(self.F), self.default_pos].add(fix)
-        best = split_ops.find_best_split(
+        return feat_hist.at[jnp.arange(self.F), self.default_pos].add(fix)
+
+    def _find_best(self, feat_hist, sum_g, sum_h, cnt, depth, cmin, cmax,
+                   feature_mask, with_feature_gains=False):
+        return split_ops.find_best_split(
             feat_hist, self.ctx, sum_g, sum_h, cnt,
             self.l1, self.l2, self.max_delta_step, self.min_gain_to_split,
             self.min_data_in_leaf, self.min_sum_hessian, feature_mask,
             cat_params=self.cat_params,
             monotone=self.monotone if self.use_mc else None,
             cmin=cmin, cmax=cmax, depth=depth,
-            monotone_penalty=self.monotone_penalty)
+            monotone_penalty=self.monotone_penalty,
+            with_feature_gains=with_feature_gains)
+
+    def _depth_guard(self, best, depth):
         depth_ok = (self.max_depth <= 0) | (depth < self.max_depth)
         gain = jnp.where(depth_ok, best.gain, -jnp.inf)
         return best._replace(gain=gain)
+
+    def _leaf_best_split_voting(self, hist_local, sum_g, sum_h, cnt,
+                                local_cnt, depth, cmin, cmax, feature_mask):
+        """PV-Tree voting split search (reference:
+        voting_parallel_tree_learner.cpp): each device votes its top-k
+        features by LOCAL gain, the global top-2k features are elected by
+        vote count (psum replaces the Allgather of LightSplitInfo votes,
+        :364), and only the elected features' group histograms cross ICI —
+        a fixed-size (<= 2*top_k, B, 2) gather-psum-scatter standing in for
+        the sparse ReduceScatter (:387) — before the final, globally
+        identical split evaluation (best-split sync, :465)."""
+        ax = self.axis_name
+        # local leaf totals: every feature group covers all rows, so group 0
+        # sums to the local (grad, hess) totals of the leaf
+        local_sum_g = hist_local[0, :, 0].sum()
+        local_sum_h = hist_local[0, :, 1].sum()
+        feat_hist_loc = self._feat_view(hist_local, local_sum_g, local_sum_h)
+        _, gains_loc = self._find_best(
+            feat_hist_loc, local_sum_g, local_sum_h, local_cnt, depth,
+            cmin, cmax, feature_mask, with_feature_gains=True)
+        k = min(self.top_k, self.F)
+        topv, topi = jax.lax.top_k(gains_loc, k)
+        votes = jnp.zeros((self.F,), jnp.int32).at[topi].add(
+            jnp.isfinite(topv).astype(jnp.int32))
+        votes_g = jax.lax.psum(votes, ax)
+        # elect 2k features by vote count; smaller feature index breaks ties
+        ek = min(2 * self.top_k, self.F)
+        fiota = jnp.arange(self.F, dtype=jnp.int32)
+        score = votes_g * jnp.int32(self.F) + (jnp.int32(self.F) - 1 - fiota)
+        _, elected = jax.lax.top_k(score, ek)
+        elected_mask = jnp.zeros((self.F,), jnp.bool_).at[elected].set(True)
+        # sync ONLY the elected features' groups: ek is static, so the
+        # collective payload is (ek, B, 2) regardless of G
+        eg = self.f_group[elected]                      # (ek,) group ids
+        sub_glob = jax.lax.psum(jnp.take(hist_local, eg, axis=0), ax)
+        hist_glob = jnp.zeros_like(hist_local).at[eg].set(sub_glob)
+        feat_hist = self._feat_view(hist_glob, sum_g, sum_h)
+        best = self._find_best(feat_hist, sum_g, sum_h, cnt, depth,
+                               cmin, cmax, feature_mask & elected_mask)
+        return self._depth_guard(best, depth)
 
     # ------------------------------------------------------------------
     def _pvary(self, x):
@@ -399,7 +457,18 @@ class SerialTreeLearner:
         return jax.tree.map(mark, x)
 
     def _psum(self, x):
+        """Histogram sync: global sums only in data-parallel mode (voting
+        keeps leaf histograms LOCAL and syncs only elected features at
+        split-evaluation time)."""
         if self.axis_name is not None and self.parallel_mode == "data":
+            return jax.lax.psum(x, self.axis_name)
+        return x
+
+    def _psum_scalar(self, x):
+        """Row-statistic sync (counts, grad/hess totals): rows are sharded
+        in both data- and voting-parallel modes."""
+        if self.axis_name is not None and self.parallel_mode in ("data",
+                                                                 "voting"):
             return jax.lax.psum(x, self.axis_name)
         return x
 
@@ -420,13 +489,16 @@ class SerialTreeLearner:
 
         root_hist = self._psum(self._hist_leaf(
             part_bins, grad_p, hess_p, jnp.int32(self.row0), jnp.int32(self.N)))
-        bag_cnt_g = self._psum(bag_cnt)
-        sum_g = root_hist[0, :, 0].sum()
-        sum_h = root_hist[0, :, 1].sum()
+        bag_cnt_g = self._psum_scalar(bag_cnt)
+        # in voting mode root_hist stays LOCAL; the leaf totals are global
+        sum_g = self._psum_scalar(root_hist[0, :, 0].sum()) \
+            if self.parallel_mode == "voting" else root_hist[0, :, 0].sum()
+        sum_h = self._psum_scalar(root_hist[0, :, 1].sum()) \
+            if self.parallel_mode == "voting" else root_hist[0, :, 1].sum()
         neg_inf = jnp.float32(-jnp.inf)
         pos_inf = jnp.float32(jnp.inf)
         best0 = self._sync_best(self._leaf_best_split(
-            root_hist, sum_g, sum_h, bag_cnt_g, jnp.int32(0),
+            root_hist, sum_g, sum_h, bag_cnt_g, bag_cnt, jnp.int32(0),
             neg_inf, pos_inf, feature_mask))
 
         def arr(val, dtype=jnp.float32):
@@ -618,6 +690,7 @@ class SerialTreeLearner:
                     jnp.stack([hist_left, hist_right]),
                     jnp.stack([lsg, rsg]), jnp.stack([lsh, rsh]),
                     jnp.stack([left_cnt_g, right_cnt_g]),
+                    jnp.stack([left_cnt, right_cnt]),
                     jnp.stack([depth_child, depth_child]),
                     jnp.stack([l_cmin, r_cmin]),
                     jnp.stack([l_cmax, r_cmax]), feature_mask)

@@ -269,7 +269,8 @@ def find_best_split(feat_hist: jnp.ndarray, ctx: SplitContext,
                     cat_params: dict | None = None,
                     monotone: jnp.ndarray | None = None,
                     cmin=None, cmax=None, depth=None,
-                    monotone_penalty: float = 0.0) -> BestSplit:
+                    monotone_penalty: float = 0.0,
+                    with_feature_gains: bool = False):
     """Find the best numerical split for one leaf.
 
     Args:
@@ -287,6 +288,9 @@ def find_best_split(feat_hist: jnp.ndarray, ctx: SplitContext,
         the leaf's [cmin, cmax] bounds, candidates violating the direction
         are rejected, and `monotone_penalty` shrinks gains of splits on
         monotone features by depth (serial_tree_learner.cpp:988).
+      with_feature_gains: also return the (F,) per-feature best gains
+        (absolute, K_MIN_SCORE where invalid) — used by the voting-parallel
+        learner's local vote (voting_parallel_tree_learner.cpp).
     """
     F, BF, _ = feat_hist.shape
     G = feat_hist[..., 0]
@@ -469,7 +473,7 @@ def find_best_split(feat_hist: jnp.ndarray, ctx: SplitContext,
         lout_best = jnp.clip(lout_best, cmin, cmax)
         rout_best = jnp.clip(rout_best, cmin, cmax)
 
-    return BestSplit(
+    best = BestSplit(
         gain=jnp.where(best_gain > neg, best_gain - min_gain_shift, neg),
         feature=best_f.astype(jnp.int32),
         threshold=jnp.where(is_cat, 0, best_t).astype(jnp.int32),
@@ -482,3 +486,6 @@ def find_best_split(feat_hist: jnp.ndarray, ctx: SplitContext,
         is_cat=is_cat,
         cat_set=member_c[best_f],
     )
+    if with_feature_gains:
+        return best, feat_gain
+    return best

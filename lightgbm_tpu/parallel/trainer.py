@@ -12,9 +12,11 @@ Bruck/recursive-halving collectives become XLA collectives over ICI inside
   * ``tree_learner=feature`` — rows replicated; per-device feature masks shard
     the split search; the winner is agreed with an all-gather + arg-max
     (feature_parallel_tree_learner.cpp:71).
-  * ``tree_learner=voting``  — data-parallel with top-k vote compression
-    (voting_parallel_tree_learner.cpp): planned; currently falls back to
-    ``data``, which is numerically identical (only more ICI traffic).
+  * ``tree_learner=voting``  — PV-Tree (voting_parallel_tree_learner.cpp):
+    rows sharded, leaf histograms stay device-local; each device votes its
+    top-k features by local gain, the global top-2k are elected via a
+    ``psum`` of votes, and only the elected features' histograms cross ICI
+    before the (globally identical) split evaluation.
 
 World size is fixed for the life of the trainer, matching the reference's
 static `Network::Init` posture; recovery is checkpoint/restart.
@@ -54,10 +56,7 @@ class ShardedTreeBuilder:
         self.mesh = mesh
         self.ndev = mesh.devices.size
         mode = mode or {"data": "data", "feature": "feature",
-                        "voting": "data"}.get(config.tree_learner, "data")
-        if config.tree_learner == "voting":
-            log.warning("tree_learner=voting currently runs the data-parallel "
-                        "histogram sync (numerically identical)")
+                        "voting": "voting"}.get(config.tree_learner, "data")
         self.mode = mode
 
         if dataset.binned is None:
@@ -124,10 +123,13 @@ class ShardedTreeBuilder:
             # drop per-shard-varying state (partition arrays and LOCAL leaf
             # offsets/counts) — only globally-identical values may be
             # replicated out; consumers must use leaf_cnt_g
+            # ("hist" is also dropped: per-leaf histograms are device-local
+            # in voting mode and no consumer reads them — replicating the
+            # (L, G, B, 2) tensor would cost a full all-reduce per tree)
             rec = {k: v for k, v in rec.items()
                    if k not in ("indices", "part_bins", "part_grad",
                                 "part_hess", "sc_bins", "sc_ghi",
-                                "leaf_start", "leaf_cnt")}
+                                "leaf_start", "leaf_cnt", "hist")}
 
             def replicate(x):
                 # values are identical on every device; pmax proves

@@ -32,7 +32,7 @@ def _serial_record(X, y, cfg):
     return ds, lr.build_tree(g, h)
 
 
-@pytest.mark.parametrize("mode", ["data", "feature"])
+@pytest.mark.parametrize("mode", ["data", "feature", "voting"])
 def test_parallel_matches_serial(mode):
     assert len(jax.devices()) == 8, "conftest must provide 8 virtual devices"
     X, y = _make_data()
@@ -87,6 +87,21 @@ def test_train_api_with_data_parallel():
                      "tree_learner": "data", "min_data_in_leaf": 5,
                      "verbosity": -1}, ds, num_boost_round=10)
     assert bst._gbdt.sharded_builder is not None
+    pred = bst.predict(X)
+    mse0 = np.mean((y - y.mean()) ** 2)
+    assert np.mean((y - pred) ** 2) < 0.4 * mse0
+
+
+def test_voting_parallel_low_top_k_still_learns():
+    """With top_k < num_features the vote compresses the histogram sync;
+    training quality must hold (reference: PV-Tree accuracy claim)."""
+    import lightgbm_tpu as lgb
+    X, y = _make_data(1200, 16, seed=11)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "tree_learner": "voting", "top_k": 3,
+                     "min_data_in_leaf": 5, "verbosity": -1},
+                    ds, num_boost_round=15)
     pred = bst.predict(X)
     mse0 = np.mean((y - y.mean()) ** 2)
     assert np.mean((y - pred) ** 2) < 0.4 * mse0
