@@ -108,6 +108,17 @@ class GBDT:
                         self.scores = self.scores.at[:, k].add(s)
                     log.info("Start training from score %f", s)
 
+        # quantized-gradient training state
+        # (reference: gradient_discretizer.{hpp,cpp})
+        self.use_quant = bool(cfg.use_quantized_grad)
+        if self.use_quant:
+            self.quant_rng = jax.random.PRNGKey(
+                cfg.seed if cfg.seed is not None else 12345)
+
+        # model-lifetime CEGB used-feature set (reference:
+        # CostEfficientGradientBoosting::is_feature_used_in_split_)
+        self._cegb_feat_used = None
+
         # sampling state
         self.bag_rng = jax.random.PRNGKey(cfg.bagging_seed)
         self.feat_rng = jax.random.PRNGKey(cfg.feature_fraction_seed)
@@ -211,6 +222,70 @@ class GBDT:
         mask = jnp.zeros((F,), dtype=bool).at[perm[:k]].set(True)
         return mask
 
+    def _discretize_gradients(self, grad, hess, row_sampling=False):
+        """Quantized-gradient training: stochastic rounding of (g, h) onto a
+        `num_grad_quant_bins`-level integer grid, returned on float carriers
+        so histogram sums equal integer-sum x scale exactly (f32 holds int
+        sums < 2^24 losslessly).  Mirrors GradientDiscretizer::
+        DiscretizeGradients (src/treelearner/gradient_discretizer.cpp:70):
+        grad_scale = max|g| / (bins/2), hess_scale = max|h| / bins (or
+        max|h| for constant-hessian objectives), truncation toward zero with
+        a uniform random offset away from zero.
+
+        The TPU-native histogram already accumulates on the MXU, so the
+        reference's 8/16/32-bit per-leaf accumulator selection
+        (SetNumBitsInHistogramBin) is unnecessary: the win retained here is
+        the regularization/accuracy semantics of quantized training."""
+        cfg = self.config
+        bins = float(cfg.num_grad_quant_bins)
+        max_g = jnp.max(jnp.abs(grad))
+        max_h = jnp.max(jnp.abs(hess))
+        # the constant-hessian shortcut (every int hessian := 1) is only
+        # valid when hessians are untouched by sampling: bagging zeroes
+        # out-of-bag rows and GOSS re-weights, so those paths must quantize
+        # hessians like any non-constant objective
+        const_h = (self.objective is not None
+                   and self.objective.is_constant_hessian
+                   and not row_sampling)
+        gs = jnp.maximum(max_g / (bins / 2.0), 1e-30)
+        hs = jnp.maximum(max_h if const_h else max_h / bins, 1e-30)
+        if cfg.stochastic_rounding:
+            self.quant_rng, sub = jax.random.split(self.quant_rng)
+            kg, kh = jax.random.split(sub)
+            rg = jax.random.uniform(kg, grad.shape)
+            rh = jax.random.uniform(kh, hess.shape)
+        else:
+            rg = rh = 0.5
+        ig = jnp.trunc(grad / gs + jnp.where(grad >= 0, rg, -rg))
+        ih = jnp.ones_like(hess) if const_h else jnp.trunc(hess / hs + rh)
+        return ig * gs, ih * hs
+
+    def _renew_quant_leaf_outputs(self, record, num_nodes: int, grad, hess):
+        """Recompute leaf outputs from the TRUE (un-quantized) gradient sums
+        (reference: GradientDiscretizer::RenewIntGradTreeOutput,
+        gradient_discretizer.cpp:209)."""
+        from ..ops.split import leaf_output
+        cfg = self.config
+        num_leaves = num_nodes + 1
+        indices = np.asarray(record["indices"])
+        leaf_start = np.asarray(record["leaf_start"])
+        leaf_cnt = np.asarray(record["leaf_cnt"])
+        g = np.asarray(grad)
+        h = np.asarray(hess)
+        new_values = np.asarray(record["leaf_value"]).copy()
+        for leaf in range(num_leaves):
+            s, c = int(leaf_start[leaf]), int(leaf_cnt[leaf])
+            if c <= 0:
+                continue
+            rows = indices[s:s + c]
+            rows = rows[rows < len(g)]
+            sum_g = float(g[rows].sum())
+            sum_h = float(h[rows].sum())
+            new_values[leaf] = float(leaf_output(
+                sum_g, sum_h + 2e-15, cfg.lambda_l1, cfg.lambda_l2,
+                cfg.max_delta_step))
+        return jnp.asarray(new_values)
+
     # ------------------------------------------------------------------
     def train_one_iter(self, grad=None, hess=None) -> bool:
         """One boosting iteration (reference: gbdt.cpp TrainOneIter:338).
@@ -250,14 +325,35 @@ class GBDT:
         for k in range(K):
             gk = grad[:, k] if K > 1 else grad
             hk = hess[:, k] if K > 1 else hess
+            gk_true, hk_true = gk, hk
+            if self.use_quant:
+                gk, hk = self._discretize_gradients(
+                    gk, hk,
+                    row_sampling=self.goss or (bag_mask is not None))
+            tree_seed = self.iter * K + k + 1
             if use_sharded:
-                record = self.sharded_builder.build_tree(gk, hk, feature_mask)
+                record = self.sharded_builder.build_tree(
+                    gk, hk, feature_mask, seed=tree_seed,
+                    feat_used=self._cegb_feat_used)
             else:
-                record = self.learner.build_tree(gk, hk, bag_cnt, feature_mask)
+                record = self.learner.build_tree(
+                    gk, hk, bag_cnt, feature_mask, seed=tree_seed,
+                    feat_used=self._cegb_feat_used)
+            if self.learner.has_cegb:
+                # coupled penalties persist for the model lifetime
+                self._cegb_feat_used = record["feat_used"]
             num_nodes = int(record["s"])
             if num_nodes > 0:
                 should_stop = False
             leaf_value_dev = record["leaf_value"]
+            if (self.use_quant and self.config.quant_train_renew_leaf
+                    and num_nodes > 0):
+                if use_sharded:
+                    log.warning("quant_train_renew_leaf is not yet supported "
+                                "by the distributed learners")
+                else:
+                    leaf_value_dev = self._renew_quant_leaf_outputs(
+                        record, num_nodes, gk_true, hk_true)
             if (self.objective is not None
                     and self.objective.is_renew_tree_output and num_nodes > 0):
                 if use_sharded:

@@ -76,6 +76,51 @@ def parse_monotone_constraints(spec, num_total_features: int) -> np.ndarray:
     return out
 
 
+def parse_interaction_constraints(spec, num_total_features: int):
+    """Parse interaction_constraints ("[0,1,2],[2,3]" or list of lists) into
+    a (C, F_total) bool matrix of allowed-feature sets (reference:
+    col_sampler.hpp SetInteractionConstraints)."""
+    if spec is None:
+        return None
+    if isinstance(spec, str):
+        s = spec.strip()
+        if not s:
+            return None
+        import json as _json
+        groups = _json.loads(f"[{s}]" if not s.startswith("[[") else s)
+    else:
+        groups = [list(g) for g in spec]
+    if not groups:
+        return None
+    out = np.zeros((len(groups), num_total_features), dtype=bool)
+    for i, g in enumerate(groups):
+        for f in g:
+            f = int(f)
+            if not 0 <= f < num_total_features:
+                raise ValueError(
+                    f"interaction_constraints feature {f} out of range")
+            out[i, f] = True
+    return out
+
+
+def parse_per_feature_penalty(spec, num_total_features: int):
+    """Parse cegb_penalty_feature_{lazy,coupled} ("0.1,0.2,...")."""
+    if spec is None:
+        return None
+    if isinstance(spec, str):
+        s = spec.strip().strip("()[]")
+        if not s:
+            return None
+        vals = [float(v) for v in s.replace(" ", "").split(",") if v]
+    else:
+        vals = [float(v) for v in spec]
+    if len(vals) != num_total_features:
+        raise ValueError(
+            f"per-feature penalty has {len(vals)} entries, expected "
+            f"{num_total_features}")
+    return np.asarray(vals, dtype=np.float32)
+
+
 def _pow2ceil(x: int) -> int:
     p = 1
     while p < x:
@@ -148,6 +193,44 @@ class SerialTreeLearner:
                 f"monotone_constraints_method="
                 f"{config.monotone_constraints_method} is not implemented; "
                 f"falling back to 'basic'")
+        # ---- interaction constraints ----
+        ic = parse_interaction_constraints(
+            config.interaction_constraints, dataset.num_total_features)
+        self.ic_masks = None
+        if ic is not None:
+            # map original-feature sets onto the used-feature enumeration
+            self.ic_masks = jnp.asarray(ic[:, meta["feature"]])  # (C, F)
+
+        # ---- CEGB ----
+        self.cegb_count_coeff = 0.0
+        self.cegb_coupled = None
+        tradeoff = float(config.cegb_tradeoff)
+        if float(config.cegb_penalty_split) > 0:
+            self.cegb_count_coeff = tradeoff * float(config.cegb_penalty_split)
+        coupled = parse_per_feature_penalty(
+            config.cegb_penalty_feature_coupled, dataset.num_total_features)
+        if coupled is not None:
+            self.cegb_coupled = jnp.asarray(tradeoff * coupled[meta["feature"]])
+        if config.cegb_penalty_feature_lazy:
+            log.warning("cegb_penalty_feature_lazy requires per-(row,feature)"
+                        " tracking and is not implemented; ignoring")
+        self.has_cegb = (self.cegb_count_coeff > 0
+                         or self.cegb_coupled is not None)
+
+        # ---- forced splits ----
+        self.forced = None
+        if config.forcedsplits_filename:
+            if parallel_mode == "voting":
+                log.warning("forcedsplits_filename is not supported with "
+                            "tree_learner=voting (local histograms); ignored")
+            else:
+                self.forced = self._load_forced_splits(
+                    config.forcedsplits_filename, dataset, meta)
+
+        # ---- per-node column sampling ----
+        self.frac_bynode = float(config.feature_fraction_bynode)
+        self.has_bynode = 0.0 < self.frac_bynode < 1.0
+
         self.cat_params = None
         if self.has_categorical:
             self.cat_params = {
@@ -224,7 +307,7 @@ class SerialTreeLearner:
         self.top_k = int(config.top_k)
 
         self._best_split_vmapped = jax.vmap(
-            self._leaf_best_split, in_axes=(0, 0, 0, 0, 0, 0, 0, 0, None))
+            self._leaf_best_split, in_axes=(0, 0, 0, 0, 0, 0, 0, 0, 0, None))
         self._build = jax.jit(self._build_impl)
 
     # ------------------------------------------------------------------
@@ -352,8 +435,107 @@ class SerialTreeLearner:
         return moved, nl
 
     # ------------------------------------------------------------------
+    def _load_forced_splits(self, filename, dataset, meta):
+        """Flatten the forced-splits JSON (reference: forced_split_json_
+        BFS in SerialTreeLearner::ForceSplits, serial_tree_learner.cpp:614)
+        into parallel arrays: feature enum, bin threshold, child node ids."""
+        import json as _json
+        with open(filename) as f:
+            root = _json.load(f)
+        enum_of = {int(orig): i for i, orig in enumerate(meta["feature"])}
+        feats, bins_, lefts, rights = [], [], [], []
+
+        def add(node):
+            if (not isinstance(node, dict) or "feature" not in node
+                    or "threshold" not in node):
+                return -1
+            orig = int(node["feature"])
+            if orig not in enum_of:
+                log.warning("forced split on unused feature %d ignored", orig)
+                return -1
+            fi = enum_of[orig]
+            if int(meta["is_categorical"][fi]):
+                log.warning("forced split on categorical feature %d ignored",
+                            orig)
+                return -1
+            bm = dataset.bin_mappers[orig]
+            thr_bin = bm.value_to_bin(float(node["threshold"]))
+            idx = len(feats)
+            feats.append(fi)
+            bins_.append(int(thr_bin))
+            lefts.append(-1)
+            rights.append(-1)
+            lefts[idx] = add(node.get("left"))
+            rights[idx] = add(node.get("right"))
+            return idx
+
+        if add(root) < 0:
+            return None
+        return {
+            "feature": jnp.asarray(np.asarray(feats, np.int32)),
+            "bin": jnp.asarray(np.asarray(bins_, np.int32)),
+            "left": jnp.asarray(np.asarray(lefts, np.int32)),
+            "right": jnp.asarray(np.asarray(rights, np.int32)),
+        }
+
+    def _forced_split_info(self, hist_group, f_enum, thr, sum_g, sum_h, cnt):
+        """Split stats at a fixed (feature, bin) threshold (reference:
+        FeatureHistogram::GatherInfoForThresholdNumerical,
+        feature_histogram.hpp:502): reverse-scan semantics — the right side
+        holds bins in (thr, bmax], the default bin is skipped for
+        zero-missing features, missing goes left."""
+        K_EPS = split_ops.K_EPSILON
+        feat_hist = self._feat_view(hist_group, sum_g, sum_h)
+        fh = feat_hist[f_enum]                                 # (BF, 2)
+        nb = self.ctx.num_bin[f_enum]
+        mtype = self.ctx.missing_type[f_enum]
+        dbin = self.ctx.default_bin[f_enum]
+        bins = jnp.arange(self.BF)
+        is_nan = mtype == split_ops.MISSING_NAN
+        is_zero = mtype == split_ops.MISSING_ZERO
+        bmax = nb - 1 - is_nan.astype(jnp.int32)
+        rmask = (bins > thr) & (bins <= bmax) & \
+            ~(is_zero & (bins == dbin))
+        rg = jnp.sum(fh[:, 0] * rmask)
+        rh = jnp.sum(fh[:, 1] * rmask) + K_EPS
+        sum_h_tot = sum_h + 2 * K_EPS
+        cnt_factor = cnt.astype(jnp.float32) / sum_h_tot
+        rc = jnp.sum(jnp.floor(fh[:, 1] * cnt_factor + 0.5).astype(jnp.int32)
+                     * rmask)
+        lg = sum_g - rg
+        lh = sum_h_tot - rh
+        lc = cnt - rc
+        args = (self.l1, self.l2, self.max_delta_step)
+        gain_shift = split_ops.leaf_gain(sum_g, sum_h_tot, *args)
+        gain = (split_ops.leaf_gain(lg, lh, *args) +
+                split_ops.leaf_gain(rg, rh, *args))
+        rel = gain - (gain_shift + self.min_gain_to_split)
+        valid = (lc >= 1) & (rc >= 1) & (rel >= 0) & (thr < nb - 1)
+        return {
+            "gain": rel, "valid": valid, "threshold": thr,
+            "lsg": lg, "lsh": lh - K_EPS, "rsg": rg, "rsh": rh - K_EPS,
+            "lcnt": lc.astype(jnp.int32), "rcnt": rc.astype(jnp.int32),
+            "lout": split_ops.leaf_output(lg, lh, *args),
+            "rout": split_ops.leaf_output(rg, rh, *args),
+        }
+
+    def _allowed_from_used(self, used):
+        """Interaction constraints (reference: col_sampler.hpp GetByNode):
+        a node may split on the union of all constraint sets that contain
+        every feature already used on its path."""
+        compat = ~jnp.any(used[None, :] & ~self.ic_masks, axis=1)   # (C,)
+        return jnp.any(self.ic_masks & compat[:, None], axis=0)     # (F,)
+
+    def _bynode_mask(self, key):
+        """feature_fraction_bynode sampling (reference: col_sampler.hpp
+        SampleUsedFeaturesByNode approximated with a uniform-score top-k)."""
+        k = max(int(round(self.F * self.frac_bynode)), 1)
+        scores = jax.random.uniform(key, (self.F,))
+        kth = jnp.sort(scores)[self.F - k]
+        return scores >= kth
+
     def _leaf_best_split(self, hist_group, sum_g, sum_h, cnt, local_cnt,
-                         depth, cmin, cmax, feature_mask):
+                         depth, cmin, cmax, feature_mask, feat_used):
         if self.F == 0:   # no usable features: every tree is a stub
             z = jnp.float32(0.0)
             zi = jnp.int32(0)
@@ -367,10 +549,10 @@ class SerialTreeLearner:
         if self.parallel_mode == "voting" and self.axis_name is not None:
             return self._leaf_best_split_voting(
                 hist_group, sum_g, sum_h, cnt, local_cnt, depth, cmin, cmax,
-                feature_mask)
+                feature_mask, feat_used)
         feat_hist = self._feat_view(hist_group, sum_g, sum_h)
         best = self._find_best(feat_hist, sum_g, sum_h, cnt, depth,
-                               cmin, cmax, feature_mask)
+                               cmin, cmax, feature_mask, feat_used=feat_used)
         return self._depth_guard(best, depth)
 
     def _feat_view(self, hist_group, sum_g, sum_h):
@@ -385,7 +567,10 @@ class SerialTreeLearner:
         return feat_hist.at[jnp.arange(self.F), self.default_pos].add(fix)
 
     def _find_best(self, feat_hist, sum_g, sum_h, cnt, depth, cmin, cmax,
-                   feature_mask, with_feature_gains=False):
+                   feature_mask, feat_used=None, with_feature_gains=False):
+        cegb_delta = None
+        if self.cegb_coupled is not None and feat_used is not None:
+            cegb_delta = jnp.where(feat_used, 0.0, self.cegb_coupled)
         return split_ops.find_best_split(
             feat_hist, self.ctx, sum_g, sum_h, cnt,
             self.l1, self.l2, self.max_delta_step, self.min_gain_to_split,
@@ -394,6 +579,8 @@ class SerialTreeLearner:
             monotone=self.monotone if self.use_mc else None,
             cmin=cmin, cmax=cmax, depth=depth,
             monotone_penalty=self.monotone_penalty,
+            cegb_count_coeff=self.cegb_count_coeff,
+            cegb_feature_delta=cegb_delta,
             with_feature_gains=with_feature_gains)
 
     def _depth_guard(self, best, depth):
@@ -402,7 +589,8 @@ class SerialTreeLearner:
         return best._replace(gain=gain)
 
     def _leaf_best_split_voting(self, hist_local, sum_g, sum_h, cnt,
-                                local_cnt, depth, cmin, cmax, feature_mask):
+                                local_cnt, depth, cmin, cmax, feature_mask,
+                                feat_used=None):
         """PV-Tree voting split search (reference:
         voting_parallel_tree_learner.cpp): each device votes its top-k
         features by LOCAL gain, the global top-2k features are elected by
@@ -419,7 +607,8 @@ class SerialTreeLearner:
         feat_hist_loc = self._feat_view(hist_local, local_sum_g, local_sum_h)
         _, gains_loc = self._find_best(
             feat_hist_loc, local_sum_g, local_sum_h, local_cnt, depth,
-            cmin, cmax, feature_mask, with_feature_gains=True)
+            cmin, cmax, feature_mask, feat_used=feat_used,
+            with_feature_gains=True)
         k = min(self.top_k, self.F)
         topv, topi = jax.lax.top_k(gains_loc, k)
         votes = jnp.zeros((self.F,), jnp.int32).at[topi].add(
@@ -438,7 +627,8 @@ class SerialTreeLearner:
         hist_glob = jnp.zeros_like(hist_local).at[eg].set(sub_glob)
         feat_hist = self._feat_view(hist_glob, sum_g, sum_h)
         best = self._find_best(feat_hist, sum_g, sum_h, cnt, depth,
-                               cmin, cmax, feature_mask & elected_mask)
+                               cmin, cmax, feature_mask & elected_mask,
+                               feat_used=feat_used)
         return self._depth_guard(best, depth)
 
     # ------------------------------------------------------------------
@@ -483,9 +673,23 @@ class SerialTreeLearner:
         return jax.tree.map(lambda a: a[winner], gathered)
 
     def _build_tree_impl(self, part_bins, grad_p, hess_p, rowid, bag_cnt,
-                         feature_mask):
+                         feature_mask, seed, feat_used_init=None):
         L, G, B, F = self.L, self.G, self.B, self.F
         nodes = self.max_splits
+        rng0 = jax.random.PRNGKey(seed)
+
+        root_mask = feature_mask
+        if self.ic_masks is not None:
+            root_mask = root_mask & self._allowed_from_used(
+                jnp.zeros((F,), jnp.bool_))
+        if self.has_bynode:
+            root_mask = root_mask & self._bynode_mask(
+                jax.random.fold_in(rng0, 0))
+        # coupled CEGB penalties persist across trees: the caller threads the
+        # model-lifetime used-feature set back in each iteration (reference:
+        # CostEfficientGradientBoosting::is_feature_used_in_split_)
+        feat_used0 = (jnp.zeros((F,), jnp.bool_) if feat_used_init is None
+                      else feat_used_init)
 
         root_hist = self._psum(self._hist_leaf(
             part_bins, grad_p, hess_p, jnp.int32(self.row0), jnp.int32(self.N)))
@@ -499,7 +703,7 @@ class SerialTreeLearner:
         pos_inf = jnp.float32(jnp.inf)
         best0 = self._sync_best(self._leaf_best_split(
             root_hist, sum_g, sum_h, bag_cnt_g, bag_cnt, jnp.int32(0),
-            neg_inf, pos_inf, feature_mask))
+            neg_inf, pos_inf, root_mask, feat_used0))
 
         def arr(val, dtype=jnp.float32):
             return jnp.full((L,), val, dtype=dtype)
@@ -522,6 +726,7 @@ class SerialTreeLearner:
             "leaf_depth": arr(0, jnp.int32),
             "leaf_cmin": arr(-jnp.inf),
             "leaf_cmax": arr(jnp.inf),
+            "feat_used": feat_used0,
             "leaf_value": arr(0.0),
             "leaf_parent_node": arr(-1, jnp.int32),
             "leaf_parent_side": arr(0, jnp.int32),
@@ -563,6 +768,12 @@ class SerialTreeLearner:
             "node_cat_set": jnp.zeros((nodes, self.BF), jnp.bool_),
         }
 
+        if self.ic_masks is not None:
+            state["leaf_used"] = jnp.zeros((L, F), jnp.bool_)
+        if self.forced is not None:
+            # leaf -> pending forced-node id (-1 none); root starts forced
+            state["leaf_forced"] = jnp.full((L,), -1, jnp.int32).at[0].set(0)
+
         # uniform vma typing under shard_map: mark the whole state varying
         state = self._pvary(state)
 
@@ -572,6 +783,30 @@ class SerialTreeLearner:
         def body(st):
             best_leaf = jnp.argmax(st["best_gain"]).astype(jnp.int32)
             gain = st["best_gain"][best_leaf]
+
+            # forced splits take precedence over the free search
+            # (reference: ForceSplits, serial_tree_learner.cpp:614)
+            forced_ok = jnp.bool_(False)
+            forced_node = jnp.int32(0)
+            forced_info = None
+            if self.forced is not None:
+                fids = st["leaf_forced"]
+                f_leaf = jnp.argmax(fids >= 0).astype(jnp.int32)
+                has_f = jnp.any(fids >= 0)
+                forced_node = jnp.maximum(fids[f_leaf], 0)
+                forced_info = self._forced_split_info(
+                    st["hist"][f_leaf], self.forced["feature"][forced_node],
+                    self.forced["bin"][forced_node],
+                    st["leaf_sum_g"][f_leaf], st["leaf_sum_h"][f_leaf],
+                    st["leaf_cnt_g"][f_leaf])
+                depth_ok = (self.max_depth <= 0) | \
+                    (st["leaf_depth"][f_leaf] < self.max_depth)
+                forced_ok = has_f & forced_info["valid"] & depth_ok
+                # a failed forced split is abandoned; free search resumes
+                st = {**st, "leaf_forced": jnp.where(
+                    has_f & ~forced_ok, fids.at[f_leaf].set(-1), fids)}
+                best_leaf = jnp.where(forced_ok, f_leaf, best_leaf)
+                gain = jnp.where(forced_ok, forced_info["gain"], gain)
 
             def no_split(st):
                 return self._pvary({**st, "done": jnp.bool_(True)})
@@ -584,6 +819,15 @@ class SerialTreeLearner:
                 dl = st["best_dl"][best_leaf]
                 is_cat = st["best_is_cat"][best_leaf]
                 cat_set = st["best_cat_set"][best_leaf]
+                if forced_info is not None:
+                    f_enum = jnp.where(forced_ok,
+                                       self.forced["feature"][forced_node],
+                                       f_enum)
+                    thr = jnp.where(forced_ok, forced_info["threshold"], thr)
+                    dl = jnp.where(forced_ok, True, dl)
+                    is_cat = jnp.where(forced_ok, False, is_cat)
+                    cat_set = jnp.where(forced_ok,
+                                        jnp.zeros_like(cat_set), cat_set)
                 col = self.f_group[f_enum]
                 bstart = self.f_bin_start[f_enum]
                 isb = self.f_is_bundled[f_enum]
@@ -603,6 +847,11 @@ class SerialTreeLearner:
                 # out-of-bag rows live in the ranges with zeroed gradients
                 left_cnt_g = st["best_lcnt"][best_leaf]
                 right_cnt_g = st["best_rcnt"][best_leaf]
+                if forced_info is not None:
+                    left_cnt_g = jnp.where(forced_ok, forced_info["lcnt"],
+                                           left_cnt_g)
+                    right_cnt_g = jnp.where(forced_ok, forced_info["rcnt"],
+                                            right_cnt_g)
                 l_start = start
                 r_start = start + left_cnt
 
@@ -627,6 +876,13 @@ class SerialTreeLearner:
                 rsh = st["best_rsh"][best_leaf]
                 lout = st["best_lout"][best_leaf]
                 rout = st["best_rout"][best_leaf]
+                if forced_info is not None:
+                    lsg = jnp.where(forced_ok, forced_info["lsg"], lsg)
+                    lsh = jnp.where(forced_ok, forced_info["lsh"], lsh)
+                    rsg = jnp.where(forced_ok, forced_info["rsg"], rsg)
+                    rsh = jnp.where(forced_ok, forced_info["rsh"], rsh)
+                    lout = jnp.where(forced_ok, forced_info["lout"], lout)
+                    rout = jnp.where(forced_ok, forced_info["rout"], rout)
                 depth_child = st["leaf_depth"][best_leaf] + 1
 
                 # basic-mode monotone bounds for the children (reference:
@@ -686,6 +942,24 @@ class SerialTreeLearner:
 
                 # child best splits (single traced program via vmap over the
                 # stacked pair — halves the while-body program size)
+                # per-child feature masks: interaction constraints narrow to
+                # sets compatible with the path, bynode sampling re-draws
+                f_onehot = jax.lax.broadcasted_iota(
+                    jnp.int32, (F,), 0) == f_enum
+                feat_used_new = (st["feat_used"] | f_onehot
+                                 if self.has_cegb else st["feat_used"])
+                mask_l = mask_r = feature_mask
+                if self.ic_masks is not None:
+                    used_child = st["leaf_used"][best_leaf] | f_onehot
+                    allowed = self._allowed_from_used(used_child)
+                    mask_l = mask_l & allowed
+                    mask_r = mask_r & allowed
+                if self.has_bynode:
+                    kstep = jax.random.fold_in(rng0, s + 1)
+                    kl, kr = jax.random.split(kstep)
+                    mask_l = mask_l & self._bynode_mask(kl)
+                    mask_r = mask_r & self._bynode_mask(kr)
+
                 both = self._best_split_vmapped(
                     jnp.stack([hist_left, hist_right]),
                     jnp.stack([lsg, rsg]), jnp.stack([lsh, rsh]),
@@ -693,7 +967,8 @@ class SerialTreeLearner:
                     jnp.stack([left_cnt, right_cnt]),
                     jnp.stack([depth_child, depth_child]),
                     jnp.stack([l_cmin, r_cmin]),
-                    jnp.stack([l_cmax, r_cmax]), feature_mask)
+                    jnp.stack([l_cmax, r_cmax]),
+                    jnp.stack([mask_l, mask_r]), feat_used_new)
                 best_l = self._sync_best(jax.tree.map(lambda a: a[0], both))
                 best_r = self._sync_best(jax.tree.map(lambda a: a[1], both))
 
@@ -712,9 +987,22 @@ class SerialTreeLearner:
                     "leaf_depth": seta("leaf_depth", depth_child, depth_child),
                     "leaf_cmin": seta("leaf_cmin", l_cmin, r_cmin),
                     "leaf_cmax": seta("leaf_cmax", l_cmax, r_cmax),
+                    "feat_used": feat_used_new,
                     "leaf_value": seta("leaf_value", lout, rout),
                     "leaf_parent_node": seta("leaf_parent_node", s, s),
                     "leaf_parent_side": seta("leaf_parent_side", 0, 1),
+                    **({"leaf_used": st["leaf_used"]
+                        .at[best_leaf].set(used_child)
+                        .at[new_leaf].set(used_child)}
+                       if self.ic_masks is not None else {}),
+                    **({"leaf_forced": st["leaf_forced"]
+                        .at[best_leaf].set(jnp.where(
+                            forced_ok, self.forced["left"][forced_node],
+                            jnp.int32(-1)))
+                        .at[new_leaf].set(jnp.where(
+                            forced_ok, self.forced["right"][forced_node],
+                            jnp.int32(-1)))}
+                       if self.forced is not None else {}),
                     "best_gain": seta("best_gain", best_l.gain, best_r.gain),
                     "best_feature": seta("best_feature", best_l.feature, best_r.feature),
                     "best_threshold": seta("best_threshold", best_l.threshold,
@@ -738,6 +1026,20 @@ class SerialTreeLearner:
                 })
                 return self._pvary(upd)
 
+            if self.forced is not None:
+                # an invalid pending forced split is abandoned WITHOUT
+                # consuming a split step, so remaining forced leaves are
+                # still tried before any free search (reference applies all
+                # forced splits first, serial_tree_learner.cpp:210)
+                skip_pending = has_f & ~forced_ok
+
+                def not_split(st2):
+                    return jax.lax.cond(skip_pending, lambda s2: s2,
+                                        no_split, st2)
+
+                return jax.lax.cond(
+                    forced_ok | ((gain > 0) & ~skip_pending),
+                    do_split, not_split, st)
             return jax.lax.cond(gain > 0, do_split, no_split, st)
 
         if self.F == 0:   # no splittable features: the root is the only leaf
@@ -746,7 +1048,8 @@ class SerialTreeLearner:
         return final
 
     # ------------------------------------------------------------------
-    def _build_impl(self, part_bins0, grad, hess, bag_cnt, feature_mask):
+    def _build_impl(self, part_bins0, grad, hess, bag_cnt, feature_mask,
+                    seed=jnp.int32(0), feat_used_init=None):
         """Front/tail-pad the per-row arrays and run the tree loop.
 
         ``grad``/``hess`` are (N,) in ORIGINAL row order with out-of-bag rows
@@ -761,19 +1064,23 @@ class SerialTreeLearner:
         iota = jax.lax.iota(jnp.int32, self.N_pad)
         rowid = jnp.where((iota >= C) & (iota < C + self.N), iota - C, self.N)
         return self._build_tree_impl(part_bins0, grad_p, hess_p, rowid,
-                                     bag_cnt, feature_mask)
+                                     bag_cnt, feature_mask, seed,
+                                     feat_used_init)
 
     def build_tree(self, grad, hess, bag_cnt=None,
-                   feature_mask=None) -> Dict[str, Any]:
+                   feature_mask=None, seed: int = 0,
+                   feat_used=None) -> Dict[str, Any]:
         """Train one tree; returns the device state record."""
         if feature_mask is None:
             feature_mask = jnp.ones((self.F,), dtype=bool)
+        if feat_used is None:
+            feat_used = jnp.zeros((self.F,), dtype=bool)
         grad = jnp.asarray(grad, dtype=jnp.float32)
         hess = jnp.asarray(hess, dtype=jnp.float32)
         if bag_cnt is None:
             bag_cnt = self.N
         return self._build(self._part0, grad, hess, jnp.int32(bag_cnt),
-                           feature_mask)
+                           feature_mask, jnp.int32(seed), feat_used)
 
     def node_arrays_for_predict(self, st: Dict[str, Any]) -> Dict[str, Any]:
         node = {

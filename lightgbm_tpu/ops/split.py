@@ -270,6 +270,8 @@ def find_best_split(feat_hist: jnp.ndarray, ctx: SplitContext,
                     monotone: jnp.ndarray | None = None,
                     cmin=None, cmax=None, depth=None,
                     monotone_penalty: float = 0.0,
+                    cegb_count_coeff: float = 0.0,
+                    cegb_feature_delta: jnp.ndarray | None = None,
                     with_feature_gains: bool = False):
     """Find the best numerical split for one leaf.
 
@@ -435,6 +437,17 @@ def find_best_split(feat_hist: jnp.ndarray, ctx: SplitContext,
         lh_c = jnp.zeros((F,))
         lc_c = jnp.zeros((F,), jnp.int32)
         l2_eff_c = jnp.full((F,), l2)
+
+    if cegb_count_coeff > 0.0 or cegb_feature_delta is not None:
+        # CEGB: subtract the split cost from the (relative) gain
+        # (reference: CostEfficientGradientBoosting::DeltaGain,
+        # cost_effective_gradient_boosting.hpp; applied at
+        # serial_tree_learner.cpp:982-986)
+        delta = cegb_count_coeff * num_data
+        if cegb_feature_delta is not None:
+            delta = delta + cegb_feature_delta
+        rel = feat_gain - min_gain_shift - delta
+        feat_gain = jnp.where(feat_gain > neg, min_gain_shift + rel, neg)
 
     if use_mc and monotone_penalty > 0:
         # gain *= penalty for splits on monotone features

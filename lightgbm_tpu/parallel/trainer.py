@@ -97,7 +97,8 @@ class ShardedTreeBuilder:
 
         lr = self.learner
 
-        def build_shard(binned, grad, hess, cnt, feature_mask):
+        def build_shard(binned, grad, hess, cnt, feature_mask, seed,
+                        feat_used):
             # binned: (local_n+1, G); grad/hess: (local_n,); cnt: (1,)
             C = lr.row0
             part_bins = jnp.pad(
@@ -113,13 +114,14 @@ class ShardedTreeBuilder:
                 mine = (fidx >= d * per) & (fidx < (d + 1) * per)
                 feature_mask = feature_mask & mine
             return lr._build_impl(part_bins, grad_l, hess_l,
-                                  cnt[0], feature_mask)
+                                  cnt[0], feature_mask, seed, feat_used)
 
         row_spec = P() if self.mode == "feature" else P(AXIS)
-        in_specs = (row_spec, row_spec, row_spec, P(AXIS), P())
+        in_specs = (row_spec, row_spec, row_spec, P(AXIS), P(), P(), P())
 
-        def wrapper(binned, grad, hess, cnt, feature_mask):
-            rec = build_shard(binned, grad, hess, cnt, feature_mask)
+        def wrapper(binned, grad, hess, cnt, feature_mask, seed, feat_used):
+            rec = build_shard(binned, grad, hess, cnt, feature_mask, seed,
+                              feat_used)
             # drop per-shard-varying state (partition arrays and LOCAL leaf
             # offsets/counts) — only globally-identical values may be
             # replicated out; consumers must use leaf_cnt_g
@@ -155,10 +157,13 @@ class ShardedTreeBuilder:
             arr = np.concatenate([arr, np.zeros(total - len(arr), np.float32)])
         return jax.device_put(arr, NamedSharding(self.mesh, P(AXIS)))
 
-    def build_tree(self, grad, hess, feature_mask=None) -> Dict[str, Any]:
+    def build_tree(self, grad, hess, feature_mask=None,
+                   seed: int = 0, feat_used=None) -> Dict[str, Any]:
         lr = self.learner
         if feature_mask is None:
             feature_mask = jnp.ones((lr.F,), dtype=bool)
+        if feat_used is None:
+            feat_used = jnp.zeros((lr.F,), dtype=bool)
         return self._build_sharded(self.binned_sharded, self.pad_rows(grad),
                                    self.pad_rows(hess), self.local_counts,
-                                   feature_mask)
+                                   feature_mask, jnp.int32(seed), feat_used)

@@ -1,0 +1,148 @@
+"""CEGB, interaction-constraint and per-node column sampling tests
+(reference model: tests/python_package_test/test_engine.py
+test_cegb / test_interaction_constraints)."""
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+
+def _make_data(n=800, f=6, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] * 2.0 + X[:, 1] - X[:, 2] + X[:, 3] * 0.5
+         + 0.1 * rng.normal(size=n))
+    return X, y
+
+
+BASE = {"objective": "regression", "num_leaves": 15, "min_data_in_leaf": 5,
+        "verbosity": -1}
+
+
+def _used_features_per_tree(bst):
+    model = bst.dump_model()
+    out = []
+    for t in model["tree_info"]:
+        feats = set()
+
+        def walk(node):
+            if "split_feature" in node:
+                feats.add(node["split_feature"])
+                walk(node["left_child"])
+                walk(node["right_child"])
+        walk(t["tree_structure"])
+        out.append(feats)
+    return out
+
+
+def test_interaction_constraints_respected():
+    X, y = _make_data()
+    bst = lgb.train({**BASE, "interaction_constraints": "[0,1],[2,3,4,5]"},
+                    lgb.Dataset(X, label=y), num_boost_round=20)
+    allowed = [frozenset({0, 1}), frozenset({2, 3, 4, 5})]
+    for feats in _used_features_per_tree(bst):
+        # every tree branch must stay within one constraint set; since sets
+        # partition the features here, each tree's PATHS must each fit a set
+        assert any(feats <= a for a in allowed) or _paths_ok(bst, allowed)
+    # quality: still learns something
+    assert np.mean((y - bst.predict(X)) ** 2) < 0.6 * np.var(y)
+
+
+def _paths_ok(bst, allowed):
+    """Check every root->leaf path uses features from a single set."""
+    model = bst.dump_model()
+    ok = True
+
+    def walk(node, path):
+        nonlocal ok
+        if "split_feature" in node:
+            p = path | {node["split_feature"]}
+            if not any(p <= a for a in allowed):
+                ok = False
+            walk(node["left_child"], p)
+            walk(node["right_child"], p)
+    for t in model["tree_info"]:
+        walk(t["tree_structure"], set())
+    return ok
+
+
+def test_interaction_constraints_paths():
+    X, y = _make_data(1000, 8, seed=3)
+    bst = lgb.train({**BASE, "num_leaves": 31,
+                     "interaction_constraints": [[0, 1, 2], [2, 3], [4, 5, 6, 7]]},
+                    lgb.Dataset(X, label=y), num_boost_round=15)
+    assert _paths_ok(bst, [frozenset({0, 1, 2}), frozenset({2, 3}),
+                           frozenset({4, 5, 6, 7})])
+
+
+def test_cegb_penalty_split_reduces_leaves():
+    X, y = _make_data()
+    ds = lgb.Dataset(X, label=y)
+    bst_free = lgb.train(dict(BASE), ds, num_boost_round=10)
+    bst_pen = lgb.train({**BASE, "cegb_tradeoff": 1.0,
+                         "cegb_penalty_split": 1.0},
+                        lgb.Dataset(X, label=y), num_boost_round=10)
+    n_free = sum(t["num_leaves"] for t in bst_free.dump_model()["tree_info"])
+    n_pen = sum(t["num_leaves"] for t in bst_pen.dump_model()["tree_info"])
+    assert n_pen < n_free
+
+
+def test_cegb_coupled_feature_penalty_narrows_features():
+    X, y = _make_data(1000, 6, seed=2)
+    # make features 1..5 expensive; only feature 0 cheap
+    pen = "0.0," + ",".join(["1e6"] * 5)
+    bst = lgb.train({**BASE, "cegb_tradeoff": 1.0,
+                     "cegb_penalty_feature_coupled": pen},
+                    lgb.Dataset(X, label=y), num_boost_round=10)
+    used = set().union(*_used_features_per_tree(bst))
+    assert used <= {0}
+
+
+def test_feature_fraction_bynode_trains():
+    X, y = _make_data(1000, 10, seed=4)
+    bst = lgb.train({**BASE, "feature_fraction_bynode": 0.5},
+                    lgb.Dataset(X, label=y), num_boost_round=25)
+    assert np.mean((y - bst.predict(X)) ** 2) < 0.4 * np.var(y)
+    # different trees should use different features (sampling active)
+    per_tree = _used_features_per_tree(bst)
+    assert len(set(map(frozenset, per_tree))) > 1
+
+
+def test_forced_splits(tmp_path):
+    """forcedsplits_filename: the first splits of every tree must follow the
+    JSON spec (reference: ForceSplits, serial_tree_learner.cpp:614)."""
+    import json
+    X, y = _make_data(1000, 6, seed=9)
+    fs = {"feature": 4, "threshold": 0.0,
+          "left": {"feature": 5, "threshold": 0.25}}
+    p = tmp_path / "forced.json"
+    p.write_text(json.dumps(fs))
+    bst = lgb.train({**BASE, "forcedsplits_filename": str(p)},
+                    lgb.Dataset(X, label=y), num_boost_round=15)
+    model = bst.dump_model()
+    for t in model["tree_info"]:
+        root = t["tree_structure"]
+        assert root["split_feature"] == 4
+        assert abs(root["threshold"] - 0.0) < 0.1
+        left = root["left_child"]
+        assert left["split_feature"] == 5
+        assert abs(left["threshold"] - 0.25) < 0.1
+    # still learns
+    assert np.mean((y - bst.predict(X)) ** 2) < 0.5 * np.var(y)
+
+
+def test_cegb_coupled_penalty_persists_across_trees():
+    """A feature acquired in an early tree must not be re-charged later:
+    with a coupled penalty affordable once, later trees keep using the
+    acquired feature rather than avoiding it (reference: is_feature_used_in_split_
+    persists for the model lifetime)."""
+    X, y = _make_data(1000, 6, seed=13)
+    pen = ",".join(["5.0"] * 6)   # affordable once, noticeable if re-charged
+    bst = lgb.train({**BASE, "cegb_tradeoff": 1.0,
+                     "cegb_penalty_feature_coupled": pen},
+                    lgb.Dataset(X, label=y), num_boost_round=12)
+    per_tree = _used_features_per_tree(bst)
+    acquired = set().union(*per_tree[:3]) if per_tree else set()
+    # later trees should still split (on acquired features) rather than stub out
+    assert any(len(f) > 0 for f in per_tree[3:])
+    assert np.mean((y - bst.predict(X)) ** 2) < 0.5 * np.var(y)

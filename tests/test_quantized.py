@@ -1,0 +1,54 @@
+"""Quantized-gradient training tests (reference model:
+tests/python_package_test/test_engine.py test_quantized_training)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _make_binary(n=1500, f=12, seed=5):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, f))
+    logit = 2 * X[:, 0] + X[:, 1] - X[:, 2]
+    y = (logit + 0.5 * rng.normal(size=n) > 0).astype(np.float64)
+    return X, y
+
+
+@pytest.mark.parametrize("renew", [False, True])
+def test_quantized_binary_close_to_fp(renew):
+    X, y = _make_binary()
+    base = {"objective": "binary", "num_leaves": 31, "min_data_in_leaf": 5,
+            "verbosity": -1}
+    bst_fp = lgb.train(base, lgb.Dataset(X, label=y), num_boost_round=30)
+    bst_q = lgb.train({**base, "use_quantized_grad": True,
+                       "num_grad_quant_bins": 4,
+                       "quant_train_renew_leaf": renew},
+                      lgb.Dataset(X, label=y), num_boost_round=30)
+    acc_fp = np.mean((bst_fp.predict(X) > 0.5) == y)
+    acc_q = np.mean((bst_q.predict(X) > 0.5) == y)
+    assert acc_q > acc_fp - 0.03, (acc_q, acc_fp)
+
+
+def test_quantized_regression_learns():
+    rng = np.random.RandomState(0)
+    X = rng.normal(size=(1000, 8))
+    y = X[:, 0] * 2.0 + np.sin(X[:, 1] * 3.0) + 0.1 * rng.normal(size=1000)
+    bst = lgb.train({"objective": "regression", "num_leaves": 31,
+                     "min_data_in_leaf": 5, "verbosity": -1,
+                     "use_quantized_grad": True, "num_grad_quant_bins": 8,
+                     "quant_train_renew_leaf": True},
+                    lgb.Dataset(X, label=y), num_boost_round=30)
+    mse = np.mean((y - bst.predict(X)) ** 2)
+    assert mse < 0.3 * np.var(y)
+
+
+def test_quantized_deterministic_rounding():
+    """stochastic_rounding=false must be reproducible run-to-run."""
+    X, y = _make_binary(600, 6)
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "use_quantized_grad": True, "stochastic_rounding": False,
+              "min_data_in_leaf": 5}
+    p1 = lgb.train(params, lgb.Dataset(X, label=y), 10).predict(X)
+    p2 = lgb.train(params, lgb.Dataset(X, label=y), 10).predict(X)
+    np.testing.assert_allclose(p1, p2)
