@@ -137,6 +137,8 @@ class BinnedDataset:
             ds.groups = reference.groups
             ds.feature_names = reference.feature_names
             ds._bin_data(data)
+            if config.linear_tree:
+                ds.raw_data = np.ascontiguousarray(data, dtype=np.float32)
             return ds
 
         ds._construct_mappers(data, categorical_features or [])

@@ -286,6 +286,98 @@ class GBDT:
                 cfg.max_delta_step))
         return jnp.asarray(new_values)
 
+    def _fit_linear_leaves(self, tree, record, num_nodes: int, grad, hess):
+        """Fit per-leaf linear models on the raw features along each leaf's
+        path (reference: LinearTreeLearner::CalculateLinear,
+        linear_tree_learner.cpp:173): weighted normal equations
+        coeffs = -(X^T H X + linear_lambda I)^-1 X^T g over the leaf's
+        non-NaN rows, constant fallback when the system is under-determined.
+        The Eigen fullPivLu solve becomes numpy lstsq."""
+        cfg = self.config
+        raw = self.train_data.raw_data
+        num_leaves = num_nodes + 1
+        nf = np.asarray(record["node_feature"])
+        nl = np.asarray(record["node_left"])
+        nr = np.asarray(record["node_right"])
+        nc = (np.asarray(record["node_is_cat"])
+              if "node_is_cat" in record else np.zeros(len(nf), bool))
+        paths = [[] for _ in range(num_leaves)]
+        if num_nodes > 0:
+            stack = [(0, [])]
+            while stack:
+                node, path = stack.pop()
+                feats = path if nc[node] else path + [int(nf[node])]
+                for child in (int(nl[node]), int(nr[node])):
+                    if child < 0:
+                        paths[~child] = feats
+                    else:
+                        stack.append((child, feats))
+        indices = np.asarray(record["indices"])
+        ls = np.asarray(record["leaf_start"])
+        lc = np.asarray(record["leaf_cnt"])
+        g = np.asarray(grad, dtype=np.float64)
+        h = np.asarray(hess, dtype=np.float64)
+        lam = float(cfg.linear_lambda)
+        shr = self.shrinkage_rate
+        tree.is_linear = True
+        for leaf in range(num_leaves):
+            feats = list(dict.fromkeys(paths[leaf]))
+            s, c = int(ls[leaf]), int(lc[leaf])
+            rows = indices[s:s + c]
+            rows = rows[rows < len(g)]
+            tree.leaf_features[leaf] = []
+            tree.leaf_coeff[leaf] = []
+            tree.leaf_const[leaf] = float(tree.leaf_value[leaf])
+            if not feats or len(rows) == 0:
+                continue
+            Xl = raw[np.ix_(rows, np.asarray(feats, np.intp))] \
+                .astype(np.float64)
+            ok = ~np.isnan(Xl).any(axis=1)
+            Xl, gi, hi = Xl[ok], g[rows][ok], h[rows][ok]
+            d = len(feats)
+            if len(Xl) < d + 1:
+                continue
+            Xa = np.concatenate([Xl, np.ones((len(Xl), 1))], axis=1)
+            XTHX = (Xa * hi[:, None]).T @ Xa
+            XTHX[np.arange(d), np.arange(d)] += lam
+            XTg = Xa.T @ gi
+            coeffs = -np.linalg.lstsq(XTHX, XTg, rcond=None)[0]
+            keep = np.abs(coeffs[:d]) > 1e-35   # reference: kZeroThreshold
+            tree.leaf_features[leaf] = [feats[i] for i in range(d)
+                                        if keep[i]]
+            tree.leaf_coeff[leaf] = [float(coeffs[i] * shr)
+                                     for i in range(d) if keep[i]]
+            tree.leaf_const[leaf] = float(coeffs[d] * shr)
+
+    def _linear_tree_deltas(self, nodes, tree, init_score_adjust=0.0):
+        """Per-row (train, [valid...]) deltas through the linear leaves;
+        recomputable at any time from the host tree, so nothing per-row needs
+        to be retained for rollback (reference: Tree::AddPredictionToScore
+        linear arm)."""
+        leaf_train = np.asarray(self._traverse_train(nodes,
+                                                     self.train_binned))
+        delta = tree._linear_output(self.train_data.raw_data, leaf_train) \
+            - init_score_adjust
+        out = [jnp.asarray(delta.astype(np.float32))]
+        for vd, metrics, binned in self.valid_sets:
+            leaf_v = np.asarray(predict_leaf_binned(binned, nodes))
+            dv = tree._linear_output(vd.raw_data, leaf_v) - init_score_adjust
+            out.append(jnp.asarray(dv.astype(np.float32)))
+        return out
+
+    def _apply_score_update_linear(self, nodes, tree, k: int) -> None:
+        deltas = self._linear_tree_deltas(nodes, tree)
+        if self.num_tree_per_iteration == 1:
+            self.scores = self.scores + deltas[0]
+        else:
+            self.scores = self.scores.at[:, k].add(deltas[0])
+        for vi in range(len(self.valid_sets)):
+            dv = deltas[vi + 1]
+            if self.num_tree_per_iteration == 1:
+                self.valid_scores[vi] = self.valid_scores[vi] + dv
+            else:
+                self.valid_scores[vi] = self.valid_scores[vi].at[:, k].add(dv)
+
     # ------------------------------------------------------------------
     def train_one_iter(self, grad=None, hess=None) -> bool:
         """One boosting iteration (reference: gbdt.cpp TrainOneIter:338).
@@ -365,7 +457,15 @@ class GBDT:
             # device score update via traversal
             nodes = self.learner.node_arrays_for_predict(record)
             delta_leaf = leaf_value_dev * self.shrinkage_rate
-            self._apply_score_update(nodes, delta_leaf, k)
+            use_linear = self.config.linear_tree and not use_sharded
+            if self.config.linear_tree and use_sharded:
+                if not getattr(self, "_warned_linear_sharded", False):
+                    log.warning("linear_tree is not yet supported by the "
+                                "distributed learners; training constant "
+                                "leaves")
+                    self._warned_linear_sharded = True
+            if not use_linear:
+                self._apply_score_update(nodes, delta_leaf, k)
             # host tree for the model
             host_record = {key: np.asarray(val) for key, val in record.items()
                            if key.startswith(("node_", "leaf_"))}
@@ -373,6 +473,11 @@ class GBDT:
             tree = tree_from_device_record(
                 host_record, num_nodes, self.train_data.bin_mappers,
                 None, shrinkage=self.shrinkage_rate)
+            if use_linear:
+                # fit on the TRUE gradients, not the quantized carriers
+                self._fit_linear_leaves(tree, record, num_nodes,
+                                        gk_true, hk_true)
+                self._apply_score_update_linear(nodes, tree, k)
             # fold the boost-from-average init score into the first
             # iteration's trees (reference: gbdt.cpp:408-424 AddBias /
             # AsConstantTree) so the saved model is self-contained
@@ -380,10 +485,15 @@ class GBDT:
                 if num_nodes > 0:
                     tree.leaf_value = tree.leaf_value + self.init_scores[k]
                     tree.internal_value = tree.internal_value + self.init_scores[k]
+                    if tree.is_linear:
+                        tree.leaf_const = tree.leaf_const + self.init_scores[k]
                 else:
                     tree.leaf_value = np.asarray([self.init_scores[k]])
+                    if tree.is_linear:
+                        tree.leaf_const = np.asarray([self.init_scores[k]])
             self.models.append(tree)
-            self.device_trees.append({"nodes": nodes, "leaf_value": delta_leaf})
+            self.device_trees.append({"nodes": nodes,
+                                      "leaf_value": delta_leaf})
         self.iter += 1
         if should_stop:
             log.warning("Stopped training because there are no more leaves "
@@ -522,16 +632,32 @@ class GBDT:
             dt = self.device_trees.pop()
             tree = self.models.pop()
             nodes, delta_leaf = dt["nodes"], dt["leaf_value"]
-            leaf_train = self._traverse_train(nodes, self.train_binned)
-            delta = jnp.take(delta_leaf, leaf_train)
             kk = K - 1 - k
+            if tree.is_linear:
+                # recompute the per-row deltas from the host tree; undo the
+                # init-score fold if this was a first-iteration tree
+                t_idx = len(self.models)
+                adj = (self.init_scores[kk]
+                       if t_idx < K and abs(self.init_scores[kk]) > K_EPSILON
+                       else 0.0)
+                deltas = self._linear_tree_deltas(nodes, tree,
+                                                  init_score_adjust=adj)
+                delta = deltas[0]
+                valid_dvs = deltas[1:]
+            else:
+                leaf_train = self._traverse_train(nodes, self.train_binned)
+                delta = jnp.take(delta_leaf, leaf_train)
+                valid_dvs = None
             if K == 1:
                 self.scores = self.scores - delta
             else:
                 self.scores = self.scores.at[:, kk].add(-delta)
             for vi, (vd, metrics, binned) in enumerate(self.valid_sets):
-                leaf_v = predict_leaf_binned(binned, nodes)
-                dv = jnp.take(delta_leaf, leaf_v)
+                if valid_dvs is not None:
+                    dv = valid_dvs[vi]
+                else:
+                    leaf_v = predict_leaf_binned(binned, nodes)
+                    dv = jnp.take(delta_leaf, leaf_v)
                 if K == 1:
                     self.valid_scores[vi] = self.valid_scores[vi] - dv
                 else:
@@ -543,6 +669,9 @@ class DART(GBDT):
     """DART boosting (reference: src/boosting/dart.hpp:23)."""
 
     def __init__(self, config: Config, train_data, objective):
+        if config.linear_tree:
+            log.fatal("Cannot use linear tree with DART boosting "
+                      "(reference: config.cpp linear_tree checks)")
         super().__init__(config, train_data, objective)
         self.drop_rng = np.random.RandomState(config.drop_seed)
         self.tree_weights: List[float] = []  # per model tree

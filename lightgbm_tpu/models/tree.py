@@ -49,6 +49,11 @@ class Tree:
         self.cat_boundaries: List[int] = [0]
         self.cat_threshold: List[int] = []
         self.is_linear: bool = False
+        # linear-tree leaf models (reference: tree.h leaf_coeff_/leaf_const_/
+        # leaf_features_, populated by LinearTreeLearner::CalculateLinear)
+        self.leaf_const: np.ndarray = np.zeros(num_leaves, dtype=np.float64)
+        self.leaf_features: List[List[int]] = [[] for _ in range(num_leaves)]
+        self.leaf_coeff: List[List[float]] = [[] for _ in range(num_leaves)]
 
     # -- decision bits --------------------------------------------------
     @staticmethod
@@ -71,9 +76,32 @@ class Tree:
         """Vectorized traversal (reference: tree.h Predict/NumericalDecision:335)."""
         n = data.shape[0]
         if self.num_leaves <= 1:
+            if self.is_linear:
+                return self._linear_output(data,
+                                           np.zeros(n, dtype=np.int32))
             return np.full(n, self.leaf_value[0] if len(self.leaf_value) else 0.0)
         out_leaf = self.predict_leaf(data)
+        if self.is_linear:
+            return self._linear_output(data, out_leaf)
         return self.leaf_value[out_leaf]
+
+    def _linear_output(self, data: np.ndarray, leaf: np.ndarray) -> np.ndarray:
+        """Linear-leaf prediction with per-row NaN fallback to the constant
+        leaf value (reference: tree.cpp PredictLinear macro, tree.cpp:133)."""
+        out = np.empty(len(leaf), dtype=np.float64)
+        for lf in np.unique(leaf):
+            sel = leaf == lf
+            feats = self.leaf_features[lf]
+            if not feats:
+                out[sel] = self.leaf_const[lf]
+                continue
+            sub = data[np.ix_(sel, np.asarray(feats, dtype=np.intp))] \
+                .astype(np.float64)
+            vals = self.leaf_const[lf] + sub.dot(
+                np.asarray(self.leaf_coeff[lf], dtype=np.float64))
+            nan_rows = np.isnan(sub).any(axis=1)
+            out[sel] = np.where(nan_rows, self.leaf_value[lf], vals)
+        return out
 
     def predict_leaf(self, data: np.ndarray) -> np.ndarray:
         n = data.shape[0]
@@ -176,6 +204,19 @@ class Tree:
             lines.append("leaf_value=" + repr(float(
                 self.leaf_value[0] if len(self.leaf_value) else 0.0)))
         lines.append(f"is_linear={int(self.is_linear)}")
+        if self.is_linear:
+            # reference: Tree::ToString linear block (tree.cpp:377-399)
+            lines.append("leaf_const=" + " ".join(
+                repr(float(v)) for v in self.leaf_const[:self.num_leaves]))
+            lines.append("num_features=" + join(
+                [len(c) for c in self.leaf_coeff[:self.num_leaves]], "{:d}"))
+            lines.append("leaf_features=" + " ".join(
+                " ".join(str(f) for f in feats)
+                for feats in self.leaf_features[:self.num_leaves]
+                if feats is not None))
+            lines.append("leaf_coeff=" + " ".join(
+                " ".join(repr(float(c)) for c in coefs)
+                for coefs in self.leaf_coeff[:self.num_leaves]))
         lines.append(f"shrinkage={self.shrinkage:g}")
         lines.append("")
         lines.append("")
@@ -220,6 +261,17 @@ class Tree:
             t.leaf_value = np.asarray([float(kv.get("leaf_value", 0.0))])
         t.shrinkage = float(kv.get("shrinkage", 1.0))
         t.is_linear = bool(int(kv.get("is_linear", 0)))
+        if t.is_linear:
+            t.leaf_const = parse("leaf_const", np.float64, num_leaves)
+            nfeat = parse("num_features", np.int64, num_leaves)
+            flat_f = [int(x) for x in kv.get("leaf_features", "").split()]
+            flat_c = [float(x) for x in kv.get("leaf_coeff", "").split()]
+            pos = 0
+            for i in range(num_leaves):
+                k = int(nfeat[i])
+                t.leaf_features[i] = flat_f[pos:pos + k]
+                t.leaf_coeff[i] = flat_c[pos:pos + k]
+                pos += k
         return t
 
     def to_json(self) -> dict:
@@ -269,17 +321,26 @@ class Tree:
                 "right_child": self._node_to_json(int(self.right_child[node])),
             }
         leaf = ~node
-        return {
+        out = {
             "leaf_index": int(leaf),
             "leaf_value": float(self.leaf_value[leaf]),
             "leaf_weight": float(self.leaf_weight[leaf]),
             "leaf_count": int(self.leaf_count[leaf]),
         }
+        if self.is_linear:
+            out["leaf_const"] = float(self.leaf_const[leaf])
+            out["leaf_features"] = list(self.leaf_features[leaf])
+            out["leaf_coeff"] = [float(c) for c in self.leaf_coeff[leaf]]
+        return out
 
     def apply_shrinkage(self, rate: float) -> None:
         """reference: Tree::Shrinkage (tree.h)."""
         self.leaf_value = self.leaf_value * rate
         self.internal_value = self.internal_value * rate
+        if self.is_linear:
+            self.leaf_const = self.leaf_const * rate
+            self.leaf_coeff = [[c * rate for c in cs]
+                               for cs in self.leaf_coeff]
         self.shrinkage *= rate
 
     def num_nodes(self) -> int:

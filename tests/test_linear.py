@@ -1,0 +1,95 @@
+"""Linear tree tests (reference model: tests/python_package_test/
+test_engine.py test_linear_trees*)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _make_piecewise_linear(n=1200, seed=0):
+    """Data a piecewise-LINEAR model fits far better than piecewise-constant."""
+    rng = np.random.RandomState(seed)
+    x = rng.uniform(-2, 2, size=n)
+    z = rng.normal(size=n)
+    y = np.where(x > 0, 3 * x + 1, -2 * x - 1) + 0.05 * rng.normal(size=n)
+    X = np.column_stack([x, z])
+    return X, y
+
+
+BASE = {"objective": "regression", "num_leaves": 4, "min_data_in_leaf": 20,
+        "verbosity": -1, "learning_rate": 0.5}
+
+
+def test_linear_tree_beats_constant_on_linear_data():
+    X, y = _make_piecewise_linear()
+    bst_c = lgb.train(dict(BASE), lgb.Dataset(X, label=y), 10)
+    bst_l = lgb.train({**BASE, "linear_tree": True},
+                      lgb.Dataset(X, label=y), 10)
+    mse_c = np.mean((y - bst_c.predict(X)) ** 2)
+    mse_l = np.mean((y - bst_l.predict(X)) ** 2)
+    assert mse_l < 0.5 * mse_c, (mse_l, mse_c)
+
+
+def test_linear_tree_save_load_roundtrip(tmp_path):
+    X, y = _make_piecewise_linear(600)
+    bst = lgb.train({**BASE, "linear_tree": True},
+                    lgb.Dataset(X, label=y), 8)
+    p1 = bst.predict(X, raw_score=True)
+    f = tmp_path / "linear.txt"
+    bst.save_model(str(f))
+    bst2 = lgb.Booster(model_file=str(f))
+    p2 = bst2.predict(X, raw_score=True)
+    np.testing.assert_allclose(p1, p2, rtol=1e-6, atol=1e-6)
+    # dump_model carries the leaf linear models
+    m = bst2.dump_model()
+    leaf = m["tree_info"][0]["tree_structure"]
+    while "left_child" in leaf:
+        leaf = leaf["left_child"]
+    assert "leaf_const" in leaf and "leaf_coeff" in leaf
+
+
+def test_linear_tree_nan_rows_fall_back_to_constant():
+    X, y = _make_piecewise_linear(800)
+    bst = lgb.train({**BASE, "linear_tree": True},
+                    lgb.Dataset(X, label=y), 8)
+    Xn = X[:5].copy()
+    Xn[:, 0] = np.nan
+    p = bst.predict(Xn)
+    assert np.isfinite(p).all()
+
+
+def test_linear_tree_with_early_stopping_valid_scores():
+    X, y = _make_piecewise_linear(1000, seed=3)
+    Xv, yv = _make_piecewise_linear(300, seed=4)
+    ds = lgb.Dataset(X, label=y)
+    vs = lgb.Dataset(Xv, label=yv, reference=ds)
+    evals = {}
+    bst = lgb.train({**BASE, "linear_tree": True, "metric": "l2"},
+                    ds, 30, valid_sets=[vs], valid_names=["v"],
+                    callbacks=[lgb.record_evaluation(evals)])
+    hist = evals["v"]["l2"]
+    # the recorded (incrementally-updated) valid score must match a fresh
+    # prediction-based eval at the end
+    fresh = np.mean((yv - bst.predict(Xv)) ** 2)
+    assert abs(hist[-1] - fresh) < 1e-4 * max(1.0, fresh)
+
+
+def test_linear_tree_rollback_restores_scores():
+    """rollback_one_iter must exactly undo a linear tree's score update
+    (recomputed from the host tree, including the first-iteration
+    init-score fold)."""
+    X, y = _make_piecewise_linear(500, seed=7)
+    bst = lgb.train({**BASE, "linear_tree": True},
+                    lgb.Dataset(X, label=y), 3)
+    g = bst._gbdt
+    before = np.asarray(g.scores).copy()
+    g.train_one_iter()
+    g.rollback_one_iter()
+    np.testing.assert_allclose(np.asarray(g.scores), before,
+                               rtol=1e-5, atol=1e-5)
+    # rollback all the way through the init-folded first tree
+    g.rollback_one_iter()
+    g.rollback_one_iter()
+    g.rollback_one_iter()
+    assert np.allclose(np.asarray(g.scores), np.asarray(g.scores)[0])
