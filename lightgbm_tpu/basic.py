@@ -25,11 +25,67 @@ from .utils.log import LightGBMError
 __all__ = ["Dataset", "Booster", "LightGBMError"]
 
 
-def _to_matrix(data) -> np.ndarray:
+def _is_cat_dtype(dt: str) -> bool:
+    return (dt == "category" or dt in ("object", "bool", "boolean")
+            or dt.startswith("str"))
+
+
+def _dataframe_to_matrix(df, pandas_categorical=None):
+    """pandas DataFrame -> (matrix, auto categorical column indices,
+    pandas_categorical).
+
+    category/object/str/bool dtype columns are encoded as integer codes;
+    missing/unseen values become NaN.  The per-column category lists are
+    persisted in the model (reference: basic.py _data_from_pandas +
+    the `pandas_categorical` model-file line written by the Python
+    wrapper) so predict-time frames are mapped with the TRAINING codes."""
+    cols = []
+    auto_cats = []
+    maps_out = []
+    cat_i = 0
+    for j, name in enumerate(df.columns):
+        col = df[name]
+        dt = str(col.dtype)
+        if not _is_cat_dtype(dt):
+            cols.append(np.asarray(col, dtype=np.float64))
+            continue
+        if pandas_categorical is not None:   # predict: reuse training maps
+            if cat_i >= len(pandas_categorical):
+                raise ValueError(
+                    "DataFrame has more categorical columns than the model "
+                    "was trained with")
+            lookup = {v: i for i, v in enumerate(pandas_categorical[cat_i])}
+            codes = np.array([float(lookup.get(v, -1))
+                              for v in col.tolist()], dtype=np.float64)
+        elif dt == "category":
+            maps_out.append(list(col.cat.categories))
+            codes = np.asarray(col.cat.codes, dtype=np.float64)
+        else:
+            seen: Dict[Any, int] = {}
+            vals = col.tolist()
+            codes = np.empty(len(vals), dtype=np.float64)
+            for i, v in enumerate(vals):
+                if v is None or (isinstance(v, float) and np.isnan(v)):
+                    codes[i] = -1
+                    continue
+                if v not in seen:
+                    seen[v] = len(seen)
+                codes[i] = seen[v]
+            maps_out.append(list(seen.keys()))
+        cols.append(np.where(codes < 0, np.nan, codes))
+        auto_cats.append(j)
+        cat_i += 1
+    mat = np.column_stack(cols) if cols else np.zeros((len(df), 0))
+    if pandas_categorical is None:
+        pandas_categorical = maps_out
+    return mat, auto_cats, pandas_categorical
+
+
+def _to_matrix(data, pandas_categorical=None) -> np.ndarray:
     if isinstance(data, np.ndarray):
         return data
-    if hasattr(data, "values"):  # pandas DataFrame
-        return np.asarray(data.values, dtype=np.float64)
+    if hasattr(data, "columns") and hasattr(data, "dtypes"):  # DataFrame
+        return _dataframe_to_matrix(data, pandas_categorical)[0]
     if hasattr(data, "toarray"):  # scipy sparse
         return np.asarray(data.toarray(), dtype=np.float64)
     return np.asarray(data, dtype=np.float64)
@@ -60,6 +116,7 @@ class Dataset:
         self.free_raw_data = free_raw_data
         self._inner: Optional[BinnedDataset] = None
         self.used_indices: Optional[np.ndarray] = None
+        self.pandas_categorical: Optional[List[List[Any]]] = None
 
     # ------------------------------------------------------------------
     def construct(self, extra_params: Optional[Dict[str, Any]] = None) -> "Dataset":
@@ -71,7 +128,46 @@ class Dataset:
             merged.update(params)
             params = merged
         cfg = Config(params)
-        mat = _to_matrix(self.data)
+        if isinstance(self.data, str):
+            # file path: binary fast path (reference: LoadFromBinFile,
+            # dataset_loader.cpp:417) or text load
+            from .dataset import BinnedDataset as _BD
+            if _BD.is_binary_file(self.data):
+                self._inner = _BD.load_binary(self.data, cfg)
+                md = self._inner.metadata
+                if self.label is not None:
+                    md.set_label(self.label)
+                if self.weight is not None:
+                    md.set_weight(self.weight)
+                if self.group is not None:
+                    md.set_group(self.group)
+                if self.init_score is not None:
+                    md.set_init_score(self.init_score)
+                return self
+            from .utils.textio import load_text_file
+            loaded = load_text_file(
+                self.data, has_header=bool(cfg.header),
+                label_column=cfg.label_column,
+                weight_column=cfg.weight_column,
+                group_column=cfg.group_column,
+                ignore_column=cfg.ignore_column)
+            if self.label is None:
+                self.label = loaded.label
+            if self.weight is None:
+                self.weight = loaded.weight
+            if self.group is None:
+                self.group = loaded.group
+            self.data = loaded.X
+            if loaded.feature_names and not isinstance(self.feature_name,
+                                                       list):
+                self.feature_name = loaded.feature_names
+        auto_cats: List[int] = []
+        self.pandas_categorical = None
+        if hasattr(self.data, "columns") and hasattr(self.data, "dtypes"):
+            mat, auto_cats, self.pandas_categorical = \
+                _dataframe_to_matrix(self.data)
+        else:
+            mat = _to_matrix(self.data)
         feature_names = None
         if isinstance(self.feature_name, list):
             feature_names = list(self.feature_name)
@@ -87,6 +183,8 @@ class Dataset:
         elif cfg.categorical_feature:
             cats = [int(x) for x in str(cfg.categorical_feature).split(",")
                     if x.strip().lstrip("-").isdigit()]
+        else:
+            cats = auto_cats   # pandas category dtypes ("auto" mode)
         ref_inner = None
         if self.reference is not None:
             self.reference.construct(extra_params)
@@ -179,10 +277,10 @@ class Dataset:
                        params=params or self.params)
 
     def save_binary(self, filename: str) -> "Dataset":
-        import pickle
+        """Write the constructed dataset in the binary fast-load format
+        (reference: Dataset::SaveBinaryFile, dataset.h:691)."""
         self.construct()
-        with open(filename, "wb") as fh:
-            pickle.dump(self._inner, fh)
+        self._inner.save_binary(filename)
         return self
 
 
@@ -203,11 +301,13 @@ class Booster:
         self._valid_names: List[str] = []
         self._valid_sets: List[Dataset] = []
 
+        self.pandas_categorical: Optional[List[List[Any]]] = None
         if train_set is not None:
             train_set.construct(self.params)
             objective = create_objective(self.config)
             self._gbdt = create_boosting(self.config, train_set._inner, objective)
             self._objective = objective
+            self.pandas_categorical = train_set.pandas_categorical
         elif model_file is not None:
             with open(model_file) as fh:
                 self._load_model_string(fh.read())
@@ -303,14 +403,17 @@ class Booster:
             num_iteration = self.best_iteration if self.best_iteration > 0 else -1
         elif num_iteration == 0:
             num_iteration = -1
-        mat = _to_matrix(data)
+        mat = _to_matrix(data, self.pandas_categorical)
         if pred_leaf:
             return self._gbdt.predict_leaf_index(mat)
         if pred_contrib:
             return self.predict_contrib(mat, start_iteration, num_iteration)
+        es_kw = {k: kwargs[k] for k in
+                 ("pred_early_stop", "pred_early_stop_freq",
+                  "pred_early_stop_margin") if k in kwargs}
         return self._gbdt.predict(mat, raw_score=raw_score,
                                   start_iteration=start_iteration,
-                                  num_iteration=num_iteration)
+                                  num_iteration=num_iteration, **es_kw)
 
     def predict_contrib(self, data, start_iteration=0, num_iteration=-1):
         """SHAP feature contributions via per-tree path attribution
@@ -361,6 +464,13 @@ class Booster:
         for v, n in pairs:
             body += f"{n}={int(v)}\n"
         body += "\nparameters:\n" + self.config.save_to_string() + "\nend of parameters\n"
+        if self.pandas_categorical is not None:
+            # final line, like the reference Python wrapper (basic.py
+            # _dump_pandas_categorical)
+            import json as _json
+            body += ("pandas_categorical:"
+                     + _json.dumps(self.pandas_categorical, default=str)
+                     + "\n")
         return body
 
     def save_model(self, filename: str, num_iteration: int = -1,
@@ -373,6 +483,15 @@ class Booster:
 
     def _load_model_string(self, text: str) -> None:
         """reference: GBDT::LoadModelFromString (gbdt_model_text.cpp:430-560)."""
+        for line in reversed(text.rstrip().split("\n")[-5:]):
+            if line.startswith("pandas_categorical:"):
+                import json as _json
+                try:
+                    self.pandas_categorical = _json.loads(
+                        line[len("pandas_categorical:"):])
+                except ValueError:
+                    pass
+                break
         header: Dict[str, str] = {}
         lines = text.split("\n")
         i = 0

@@ -82,6 +82,11 @@ class Application:
         cfg = self.config
         if not cfg.data:
             log.fatal("No training data file specified (data=)")
+        from .dataset import BinnedDataset
+        if BinnedDataset.is_binary_file(cfg.data):
+            # binary fast path (reference: LoadFromBinFile,
+            # dataset_loader.cpp:417)
+            return Dataset(cfg.data, params=dict(self.raw_params))
         loaded = load_text_file(
             cfg.data, has_header=cfg.header, label_column=cfg.label_column,
             weight_column=cfg.weight_column, group_column=cfg.group_column,

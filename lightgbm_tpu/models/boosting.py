@@ -590,9 +590,18 @@ class GBDT:
         return self.iter
 
     def predict_raw(self, data: np.ndarray, start_iteration: int = 0,
-                    num_iteration: int = -1) -> np.ndarray:
+                    num_iteration: int = -1,
+                    pred_early_stop: bool = False,
+                    pred_early_stop_freq: int = 10,
+                    pred_early_stop_margin: float = 10.0) -> np.ndarray:
         """Raw-score batch prediction on host feature values
-        (reference: gbdt_prediction.cpp PredictRaw)."""
+        (reference: gbdt_prediction.cpp PredictRaw).
+
+        With ``pred_early_stop``, rows whose margin already exceeds
+        ``pred_early_stop_margin`` stop accumulating trees every
+        ``pred_early_stop_freq`` iterations (reference:
+        prediction_early_stop.cpp CreatePredictionEarlyStopInstance —
+        |score| for binary, top1-top2 gap for multiclass)."""
         data = np.asarray(data, dtype=np.float64)
         n = data.shape[0]
         K = self.num_tree_per_iteration
@@ -602,9 +611,34 @@ class GBDT:
         total_iters = len(self.models) // K
         end_iter = total_iters if num_iteration <= 0 else min(
             total_iters, start_iteration + num_iteration)
+        use_es = (pred_early_stop and not self.average_output
+                  and (K > 1 or (self.objective is not None
+                                 and self.objective.name in
+                                 ("binary", "cross_entropy",
+                                  "cross_entropy_lambda"))))
+        active = np.ones(n, dtype=bool) if use_es else None
+        any_stopped = False
         for it in range(start_iteration, end_iter):
+            if use_es and (it - start_iteration) > 0 and \
+                    (it - start_iteration) % pred_early_stop_freq == 0:
+                if K == 1:
+                    margin = np.abs(out[:, 0])
+                else:
+                    part = np.partition(out, K - 2, axis=1)
+                    margin = part[:, K - 1] - part[:, K - 2]
+                active &= margin < pred_early_stop_margin
+                any_stopped = not active.all()
+                if not active.any():
+                    break
+            # avoid copying the full matrix while every row is still active
+            if use_es and any_stopped:
+                rows = np.nonzero(active)[0]
+                sub = data[rows]
+            else:
+                rows = slice(None)
+                sub = data
             for k in range(K):
-                out[:, k] += self.models[it * K + k].predict(data)
+                out[rows, k] += self.models[it * K + k].predict(sub)
         if self.average_output and end_iter > start_iteration:
             out /= (end_iter - start_iteration)
         return out[:, 0] if K == 1 else out

@@ -272,6 +272,75 @@ class MultiErrorMetric(Metric):
         return [(self.name, float(self._wmean(err)))]
 
 
+class AucMuMetric(Metric):
+    """AUC-mu for multiclass (reference: src/metric/multiclass_metric.hpp
+    AucMuMetric:183, following Kleiman & Page 2019): average over class
+    pairs (i, j) of the AUC of the projection onto the partition-weight
+    difference vector, with optional `auc_mu_weights` (K*K, row-major,
+    zero diagonal)."""
+    name = "auc_mu"
+    is_max_better = True
+
+    def init(self, metadata):
+        super().init(metadata)
+        K = int(self.config.num_class)
+        self.K = K
+        spec = str(self.config.auc_mu_weights or "").strip()
+        if spec:
+            vals = [float(v) for v in spec.replace(" ", "").split(",") if v]
+            if len(vals) != K * K:
+                from ..utils import log as _log
+                _log.fatal("auc_mu_weights must have %d elements, found %d",
+                           K * K, len(vals))
+            W = np.asarray(vals, dtype=np.float64).reshape(K, K)
+            np.fill_diagonal(W, 0.0)
+        else:
+            W = 1.0 - np.eye(K)
+        self.W = W
+
+    def eval(self, score, objective):
+        score = np.asarray(score, dtype=np.float64)   # (N, K) raw
+        lbl = np.asarray(self.label).astype(np.int64)
+        w = np.asarray(self.weight) if self.weight is not None else None
+        K = self.K
+        total = 0.0
+        for i in range(K):
+            ii = np.nonzero(lbl == i)[0]
+            if len(ii) == 0:
+                continue
+            for j in range(i + 1, K):
+                jj = np.nonzero(lbl == j)[0]
+                if len(jj) == 0:
+                    continue
+                v = self.W[i] - self.W[j]                   # (K,)
+                t1 = v[i] - v[j]
+                idx = np.concatenate([ii, jj])
+                dist = t1 * (score[idx] @ v)
+                is_i = lbl[idx] == i
+                wi = w[idx] if w is not None else np.ones(len(idx))
+                # rank with ties counted half (reference: the sequential
+                # num_j/num_current_j scan, multiclass_metric.hpp:282-323)
+                order = np.lexsort((~is_i, dist))   # ties: class j first
+                d_s = dist[order]
+                i_s = is_i[order]
+                w_s = wi[order]
+                wj = np.where(~i_s, w_s, 0.0)
+                cum_j = np.concatenate([[0.0], np.cumsum(wj)])[:-1]
+                # per tied-group j-weight for the 0.5 correction
+                grp = np.concatenate([[True], np.abs(np.diff(d_s)) > 1e-15])
+                gid = np.cumsum(grp) - 1
+                grp_j = np.zeros(gid[-1] + 1)
+                np.add.at(grp_j, gid, wj)
+                grp_start_cum = cum_j[np.nonzero(grp)[0]]
+                s_ij = np.sum(np.where(
+                    i_s, w_s * (grp_start_cum[gid] + 0.5 * grp_j[gid]), 0.0))
+                den_i = np.sum(wi[:len(ii)]) if w is not None else len(ii)
+                den_j = np.sum(w[jj]) if w is not None else len(jj)
+                total += (s_ij / den_i) / den_j
+        ans = (2.0 * total / K) / (K - 1)
+        return [(self.name, float(ans))]
+
+
 # ---------------------------------------------------------------------------
 # Ranking metrics (reference: src/metric/rank_metric.hpp, dcg_calculator.cpp)
 # ---------------------------------------------------------------------------
@@ -441,6 +510,7 @@ _METRICS = {
     "binary_logloss": BinaryLoglossMetric, "binary_error": BinaryErrorMetric,
     "auc": AUCMetric, "average_precision": AveragePrecisionMetric,
     "multi_logloss": MultiLoglossMetric, "multi_error": MultiErrorMetric,
+    "auc_mu": AucMuMetric,
     "ndcg": NDCGMetric, "map": MapMetric,
     "xentropy": CrossEntropyMetric, "xentlambda": CrossEntropyLambdaMetric,
     "kullback_leibler": KLDivMetric,

@@ -1,0 +1,120 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference implements its data loader in C++ (src/io/parser.cpp,
+src/io/dataset_loader.cpp); this package provides the TPU framework's
+native equivalents.  The shared library is compiled on demand with g++
+(cached beside the source) and every entry point has a pure-Python
+fallback, so the framework works even without a toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..utils import log
+
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+_SRC = os.path.join(os.path.dirname(__file__), "text_parser.cpp")
+_SO = os.path.join(os.path.dirname(__file__), "_text_parser.so")
+
+
+def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.lgbm_parse_delim.restype = ctypes.POINTER(ctypes.c_double)
+    lib.lgbm_parse_delim.argtypes = [
+        ctypes.c_char_p, ctypes.c_long, ctypes.c_char, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_long), ctypes.POINTER(ctypes.c_int)]
+    lib.lgbm_parse_libsvm.restype = ctypes.POINTER(ctypes.c_double)
+    lib.lgbm_parse_libsvm.argtypes = [
+        ctypes.c_char_p, ctypes.c_long, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_long), ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_double))]
+    lib.lgbm_native_free.restype = None
+    lib.lgbm_native_free.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def get_native() -> Optional[ctypes.CDLL]:
+    """Return the native library, building it on first use (or None)."""
+    global _LIB, _TRIED
+    with _LOCK:
+        if _TRIED:
+            return _LIB
+        _TRIED = True
+        try:
+            if (not os.path.exists(_SO)
+                    or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+                tmp = _SO + ".tmp"
+                subprocess.run(
+                    ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                     "-pthread", "-o", tmp, _SRC],
+                    check=True, capture_output=True)
+                os.replace(tmp, _SO)
+            _LIB = _configure(ctypes.CDLL(_SO))
+        except Exception as exc:  # missing g++, sandboxed fs, ...
+            log.info("native text parser unavailable (%s); "
+                     "using the Python fallback", exc)
+            _LIB = None
+        return _LIB
+
+
+def parse_delim(text: str, sep: str,
+                num_threads: int = 0) -> Optional[np.ndarray]:
+    """Parse delimited text into a dense (R, C) float64 matrix, or None if
+    the native library is unavailable."""
+    lib = get_native()
+    if lib is None:
+        return None
+    buf = text.encode()
+    rows = ctypes.c_long()
+    cols = ctypes.c_int()
+    ptr = lib.lgbm_parse_delim(buf, len(buf), sep.encode(), num_threads,
+                               ctypes.byref(rows), ctypes.byref(cols))
+    if not ptr or rows.value == 0 or cols.value == 0:
+        if ptr:
+            lib.lgbm_native_free(ptr)
+        return np.zeros((rows.value, cols.value), dtype=np.float64)
+    arr = np.ctypeslib.as_array(ptr, shape=(rows.value, cols.value)).copy()
+    lib.lgbm_native_free(ptr)
+    return arr
+
+
+def parse_libsvm(text: str, num_threads: int = 0
+                 ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Parse LibSVM text into (X dense (R, C), labels (R,)), or None."""
+    lib = get_native()
+    if lib is None:
+        return None
+    buf = text.encode()
+    rows = ctypes.c_long()
+    cols = ctypes.c_int()
+    labels_ptr = ctypes.POINTER(ctypes.c_double)()
+    ptr = lib.lgbm_parse_libsvm(buf, len(buf), num_threads,
+                                ctypes.byref(rows), ctypes.byref(cols),
+                                ctypes.byref(labels_ptr))
+    if rows.value == 0:
+        if ptr:
+            lib.lgbm_native_free(ptr)
+        if labels_ptr:
+            lib.lgbm_native_free(labels_ptr)
+        return (np.zeros((0, 0), dtype=np.float64),
+                np.zeros(0, dtype=np.float64))
+    labels = np.ctypeslib.as_array(labels_ptr, shape=(rows.value,)).copy() \
+        if labels_ptr else np.zeros(rows.value, dtype=np.float64)
+    if ptr and cols.value > 0:
+        X = np.ctypeslib.as_array(ptr, shape=(rows.value, cols.value)).copy()
+    else:
+        X = np.zeros((rows.value, 0), dtype=np.float64)
+    if ptr:
+        lib.lgbm_native_free(ptr)
+    if labels_ptr:
+        lib.lgbm_native_free(labels_ptr)
+    return X, labels

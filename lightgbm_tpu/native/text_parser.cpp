@@ -1,0 +1,236 @@
+// Multi-threaded text -> dense matrix parser.
+//
+// Native runtime component of lightgbm_tpu, standing in for the reference's
+// C++ Parser / DatasetLoader text path (reference: src/io/parser.cpp
+// CSVParser/TSVParser/LibSVMParser, src/io/dataset_loader.cpp
+// LoadFromFile): line indexing, per-thread chunked parsing, missing-value
+// tokens ("", na, nan, null, none) -> NaN, ragged rows padded with NaN.
+//
+// Exposed through a minimal C ABI consumed via ctypes
+// (lightgbm_tpu/native/__init__.py); compiled on demand with g++.
+
+#include <algorithm>
+#include <cctype>
+#include <cmath>
+#include <cstdlib>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct LineIndex {
+  std::vector<const char*> starts;
+  std::vector<long> lens;
+};
+
+LineIndex IndexLines(const char* buf, long len) {
+  LineIndex out;
+  long i = 0;
+  while (i < len) {
+    long start = i;
+    while (i < len && buf[i] != '\n') ++i;
+    long end = i;
+    if (end > start && buf[end - 1] == '\r') --end;
+    bool nonempty = false;
+    for (long j = start; j < end; ++j) {
+      if (!std::isspace(static_cast<unsigned char>(buf[j]))) {
+        nonempty = true;
+        break;
+      }
+    }
+    if (nonempty) {
+      out.starts.push_back(buf + start);
+      out.lens.push_back(end - start);
+    }
+    ++i;
+  }
+  return out;
+}
+
+bool IsMissingToken(const char* s, long n) {
+  while (n > 0 && std::isspace(static_cast<unsigned char>(*s))) { ++s; --n; }
+  while (n > 0 && std::isspace(static_cast<unsigned char>(s[n - 1]))) --n;
+  if (n == 0) return true;
+  static const char* kWords[] = {"na", "nan", "null", "none"};
+  for (const char* w : kWords) {
+    const long wl = static_cast<long>(std::strlen(w));
+    if (n == wl) {
+      bool eq = true;
+      for (long k = 0; k < wl; ++k) {
+        if (std::tolower(static_cast<unsigned char>(s[k])) != w[k]) {
+          eq = false;
+          break;
+        }
+      }
+      if (eq) return true;
+    }
+  }
+  return false;
+}
+
+// Parse one token [s, s+n) like Python float(): full consumption required.
+double ParseToken(const char* s, long n) {
+  while (n > 0 && std::isspace(static_cast<unsigned char>(*s))) { ++s; --n; }
+  while (n > 0 && std::isspace(static_cast<unsigned char>(s[n - 1]))) --n;
+  if (n == 0) return NAN;
+  char* end = nullptr;
+  const double v = std::strtod(s, &end);
+  if (end != s + n) return NAN;
+  return v;
+}
+
+int ResolveThreads(int num_threads) {
+  if (num_threads <= 0) {
+    num_threads = static_cast<int>(std::thread::hardware_concurrency());
+  }
+  return std::max(1, std::min(num_threads, 64));
+}
+
+template <typename Fn>
+void ParallelFor(int num_threads, Fn&& fn) {
+  if (num_threads == 1) {
+    fn(0);
+    return;
+  }
+  std::vector<std::thread> pool;
+  pool.reserve(num_threads);
+  for (int t = 0; t < num_threads; ++t) pool.emplace_back(fn, t);
+  for (auto& th : pool) th.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// Delimited (CSV/TSV/...) parse: returns a malloc'd row-major (R x C) double
+// matrix; rows shorter than C are NaN-padded.  Caller frees with
+// lgbm_native_free.
+double* lgbm_parse_delim(const char* buf, long len, char sep, int num_threads,
+                         long* n_rows_out, int* n_cols_out) {
+  const LineIndex lines = IndexLines(buf, len);
+  const long R = static_cast<long>(lines.starts.size());
+  *n_rows_out = R;
+  *n_cols_out = 0;
+  if (R == 0) return nullptr;
+  const int T = ResolveThreads(num_threads);
+
+  std::vector<int> tmax(T, 1);
+  ParallelFor(T, [&](int t) {
+    int mx = 1;
+    for (long i = t; i < R; i += T) {
+      int c = 1;
+      const char* s = lines.starts[i];
+      const long n = lines.lens[i];
+      for (long j = 0; j < n; ++j) c += (s[j] == sep);
+      mx = std::max(mx, c);
+    }
+    tmax[t] = mx;
+  });
+  const int C = *std::max_element(tmax.begin(), tmax.end());
+
+  double* mat = static_cast<double*>(std::malloc(sizeof(double) * R * C));
+  if (mat == nullptr) return nullptr;
+  ParallelFor(T, [&](int t) {
+    for (long i = t; i < R; i += T) {
+      const char* s = lines.starts[i];
+      const long n = lines.lens[i];
+      double* row = mat + i * C;
+      int col = 0;
+      long tok_start = 0;
+      for (long j = 0; j <= n && col < C; ++j) {
+        if (j == n || s[j] == sep) {
+          const char* tok = s + tok_start;
+          const long tlen = j - tok_start;
+          row[col++] = IsMissingToken(tok, tlen) ? NAN : ParseToken(tok, tlen);
+          tok_start = j + 1;
+        }
+      }
+      for (; col < C; ++col) row[col] = NAN;
+    }
+  });
+  *n_cols_out = C;
+  return mat;
+}
+
+// LibSVM parse ("label idx:val idx:val ..."): returns a malloc'd dense
+// (R x C) feature matrix (zeros for absent entries); labels written to a
+// malloc'd (R,) array returned through labels_out.
+double* lgbm_parse_libsvm(const char* buf, long len, int num_threads,
+                          long* n_rows_out, int* n_cols_out,
+                          double** labels_out) {
+  const LineIndex lines = IndexLines(buf, len);
+  const long R = static_cast<long>(lines.starts.size());
+  *n_rows_out = R;
+  *n_cols_out = 0;
+  *labels_out = nullptr;
+  if (R == 0) return nullptr;
+  const int T = ResolveThreads(num_threads);
+
+  double* labels = static_cast<double*>(std::malloc(sizeof(double) * R));
+  if (labels == nullptr) return nullptr;
+  std::vector<long> tmaxf(T, -1);
+  ParallelFor(T, [&](int t) {
+    long mx = -1;
+    for (long i = t; i < R; i += T) {
+      const char* s = lines.starts[i];
+      const char* endl = s + lines.lens[i];
+      char* end = nullptr;
+      labels[i] = std::strtod(s, &end);
+      const char* p = end;
+      while (p < endl) {
+        while (p < endl && std::isspace(static_cast<unsigned char>(*p))) ++p;
+        const char* colon = p;
+        while (colon < endl && *colon != ':' &&
+               !std::isspace(static_cast<unsigned char>(*colon))) ++colon;
+        if (colon >= endl || *colon != ':') { p = colon; continue; }
+        const long idx = std::strtol(p, nullptr, 10);
+        mx = std::max(mx, idx);
+        p = colon + 1;
+        while (p < endl && !std::isspace(static_cast<unsigned char>(*p))) ++p;
+      }
+    }
+    tmaxf[t] = mx;
+  });
+  const long maxf = *std::max_element(tmaxf.begin(), tmaxf.end());
+  const int C = static_cast<int>(maxf + 1);
+  if (C <= 0) {
+    *labels_out = labels;
+    return nullptr;
+  }
+  double* mat = static_cast<double*>(std::calloc(R * C, sizeof(double)));
+  if (mat == nullptr) {
+    std::free(labels);
+    *labels_out = nullptr;
+    return nullptr;
+  }
+  ParallelFor(T, [&](int t) {
+    for (long i = t; i < R; i += T) {
+      const char* s = lines.starts[i];
+      const char* endl = s + lines.lens[i];
+      char* end = nullptr;
+      std::strtod(s, &end);  // skip label
+      const char* p = end;
+      double* row = mat + i * C;
+      while (p < endl) {
+        while (p < endl && std::isspace(static_cast<unsigned char>(*p))) ++p;
+        const char* colon = p;
+        while (colon < endl && *colon != ':' &&
+               !std::isspace(static_cast<unsigned char>(*colon))) ++colon;
+        if (colon >= endl || *colon != ':') { p = colon; continue; }
+        const long idx = std::strtol(p, nullptr, 10);
+        char* vend = nullptr;
+        const double v = std::strtod(colon + 1, &vend);
+        if (idx >= 0 && idx < C) row[idx] = v;
+        p = vend;
+      }
+    }
+  });
+  *labels_out = labels;
+  *n_cols_out = C;
+  return mat;
+}
+
+void lgbm_native_free(void* p) { std::free(p); }
+
+}  // extern "C"

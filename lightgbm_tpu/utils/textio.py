@@ -112,18 +112,25 @@ def load_text_file(path: str, *, has_header: bool = False,
     if kind == "libsvm":
         return _load_libsvm(data_lines, weight_idx, group_idx)
 
-    rows = [ln.split(sep) for ln in data_lines]
-    ncol = max(len(r) for r in rows)
-    mat = np.full((len(rows), ncol), np.nan, dtype=np.float64)
-    for i, r in enumerate(rows):
-        for j, tok in enumerate(r):
-            tok = tok.strip()
-            if tok == "" or tok.lower() in ("na", "nan", "null", "none"):
-                continue
-            try:
-                mat[i, j] = float(tok)
-            except ValueError:
-                mat[i, j] = np.nan
+    # hot path: the native C++ parser (multi-threaded, ctypes; reference
+    # analog: src/io/parser.cpp CSVParser::ParseOneLine), with the Python
+    # loop as fallback
+    from ..native import parse_delim
+    mat = parse_delim("\n".join(data_lines), sep)
+    if mat is None:
+        rows = [ln.split(sep) for ln in data_lines]
+        ncol = max(len(r) for r in rows)
+        mat = np.full((len(rows), ncol), np.nan, dtype=np.float64)
+        for i, r in enumerate(rows):
+            for j, tok in enumerate(r):
+                tok = tok.strip()
+                if tok == "" or tok.lower() in ("na", "nan", "null", "none"):
+                    continue
+                try:
+                    mat[i, j] = float(tok)
+                except ValueError:
+                    mat[i, j] = np.nan
+    ncol = mat.shape[1]
 
     label = mat[:, label_idx].copy()
     weight = mat[:, weight_idx].copy() if weight_idx >= 0 else None
@@ -157,6 +164,11 @@ def load_text_file(path: str, *, has_header: bool = False,
 
 def _load_libsvm(data_lines: List[str], weight_idx: int,
                  group_idx: int) -> LoadedFile:
+    from ..native import parse_libsvm
+    native = parse_libsvm("\n".join(data_lines))
+    if native is not None:
+        X, labels = native
+        return LoadedFile(X, labels, None, None, None)
     labels = np.empty(len(data_lines), dtype=np.float64)
     entries: List[List[Tuple[int, float]]] = []
     max_feat = -1

@@ -160,3 +160,16 @@ def test_cegb_coupled_penalty_persists_across_trees():
                      "cegb_penalty_feature_coupled": ",".join(["1e9"] * 6)},
                     lgb.Dataset(X, label=y), num_boost_round=3)
     assert all(len(f) == 0 for f in _used_features_per_tree(bst))
+    # plumbing: the booster threads the model-lifetime used-feature set
+    # through every build (a regression dropping _cegb_feat_used threading
+    # must fail here)
+    bst2 = lgb.train({**BASE, "cegb_tradeoff": 1.0,
+                      "cegb_penalty_feature_coupled": ",".join(["0.01"] * 6)},
+                     lgb.Dataset(X, label=y), num_boost_round=4)
+    used_model = np.asarray(bst2._gbdt._cegb_feat_used)
+    used_trees = set().union(*_used_features_per_tree(bst2))
+    lr2 = bst2._gbdt.learner
+    orig_of_enum = {i: int(f) for i, f in
+                    enumerate(np.asarray(lr2.ctx.feature_index))}
+    acquired = {orig_of_enum[i] for i in np.nonzero(used_model)[0]}
+    assert acquired == used_trees and len(acquired) > 0

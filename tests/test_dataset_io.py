@@ -1,0 +1,171 @@
+"""Dataset ingestion & binary serde tests (reference model:
+tests/python_package_test/test_basic.py Dataset construction paths +
+save_binary round-trips)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.dataset import BinnedDataset
+
+
+def _make(n=400, f=6, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float64)
+    return X, y
+
+
+def test_binary_roundtrip_identical_training(tmp_path):
+    X, y = _make()
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "min_data_in_leaf": 5}
+    ds = lgb.Dataset(X, label=y)
+    ds.construct(params)
+    f = tmp_path / "train.bin"
+    ds.save_binary(str(f))
+
+    ds2 = lgb.Dataset(str(f))
+    bst1 = lgb.train(params, lgb.Dataset(X, label=y), 10)
+    bst2 = lgb.train(params, ds2, 10)
+    np.testing.assert_allclose(bst1.predict(X), bst2.predict(X), rtol=1e-6)
+
+
+def test_binary_preserves_mappers_and_metadata(tmp_path):
+    X, y = _make()
+    w = np.abs(np.random.RandomState(1).normal(size=len(y))) + 0.1
+    cfg = Config({"verbosity": -1})
+    inner = BinnedDataset.from_matrix(X, cfg, label=y, weight=w)
+    f = tmp_path / "d.bin"
+    inner.save_binary(str(f))
+    back = BinnedDataset.load_binary(str(f), cfg)
+    assert back.num_data == inner.num_data
+    assert back.num_total_features == inner.num_total_features
+    np.testing.assert_array_equal(back.binned, inner.binned)
+    np.testing.assert_allclose(back.metadata.label, inner.metadata.label)
+    np.testing.assert_allclose(back.metadata.weight, inner.metadata.weight)
+    for a, b in zip(back.bin_mappers, inner.bin_mappers):
+        assert a.num_bin == b.num_bin
+        np.testing.assert_allclose(a.bin_upper_bound, b.bin_upper_bound)
+
+
+def test_scipy_sparse_input():
+    scipy = pytest.importorskip("scipy.sparse")
+    X, y = _make()
+    Xs = scipy.csr_matrix(np.where(np.abs(X) < 0.5, 0.0, X))
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1, "min_data_in_leaf": 5},
+                    lgb.Dataset(Xs, label=y), 10)
+    p = bst.predict(Xs.toarray())
+    assert 0 <= p.min() and p.max() <= 1
+
+
+def test_pandas_category_dtype_auto_categorical():
+    pd = pytest.importorskip("pandas")
+    rng = np.random.RandomState(3)
+    n = 600
+    cat = rng.choice(["a", "b", "c", "d"], size=n)
+    x1 = rng.normal(size=n)
+    y = (np.isin(cat, ["a", "c"]).astype(float) * 2 + x1
+         + 0.1 * rng.normal(size=n) > 1.0).astype(float)
+    df = pd.DataFrame({"c": pd.Categorical(cat), "x": x1})
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1, "min_data_in_leaf": 5},
+                    lgb.Dataset(df, label=y), 20)
+    mat, auto, _ = __import__("lightgbm_tpu.basic", fromlist=["x"]) \
+        ._dataframe_to_matrix(df)
+    assert auto == [0]
+    pred = bst.predict(mat)
+    acc = np.mean((pred > 0.5) == y)
+    assert acc > 0.8
+
+
+def test_text_file_path_as_data(tmp_path):
+    X, y = _make(300, 4)
+    path = tmp_path / "train.csv"
+    with open(path, "w") as f:
+        for i in range(len(y)):
+            f.write(f"{y[i]:g}," + ",".join(f"{v!r}" for v in map(float, X[i]))
+                    + "\n")
+    ds = lgb.Dataset(str(path))
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1, "min_data_in_leaf": 5}, ds, 10)
+    acc = np.mean((bst.predict(X) > 0.5) == y)
+    assert acc > 0.85
+
+
+def test_auc_mu_metric():
+    """auc_mu equals plain binary AUC averaged over class pairs for K=2 and
+    stays in [0,1] for K=3 (reference: multiclass_metric.hpp AucMuMetric)."""
+    rng = np.random.RandomState(5)
+    n = 900
+    X = rng.normal(size=(n, 6))
+    y = np.argmax(X[:, :3] + 0.5 * rng.normal(size=(n, 3)), axis=1)
+    evals = {}
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "metric": "auc_mu", "num_leaves": 15,
+                     "min_data_in_leaf": 5, "verbosity": -1},
+                    lgb.Dataset(X, label=y), 15,
+                    valid_sets=[lgb.Dataset(X, label=y)],
+                    valid_names=["train"],
+                    callbacks=[lgb.record_evaluation(evals)])
+    hist = evals["train"]["auc_mu"]
+    assert all(0.0 <= v <= 1.0 for v in hist)
+    assert hist[-1] > 0.9          # separable-ish problem, train metric
+    assert hist[-1] >= hist[0]     # improves with boosting
+
+
+def test_pred_early_stop_close_to_exact():
+    X, y = _make(800, 6, seed=8)
+    bst = lgb.train({"objective": "binary", "num_leaves": 31,
+                     "verbosity": -1, "min_data_in_leaf": 5},
+                    lgb.Dataset(X, label=y), 60)
+    exact = bst.predict(X)
+    es = bst.predict(X, pred_early_stop=True, pred_early_stop_freq=5,
+                     pred_early_stop_margin=8.0)
+    # classification decisions must agree
+    assert np.mean((exact > 0.5) == (es > 0.5)) > 0.999
+    # with a huge margin nothing stops early: identical
+    es2 = bst.predict(X, pred_early_stop=True, pred_early_stop_margin=1e9)
+    np.testing.assert_allclose(exact, es2)
+
+
+def test_pandas_categorical_mapping_persists(tmp_path):
+    """Predict-time DataFrames with different category order/appearance must
+    be mapped with the TRAINING codes (reference: pandas_categorical in the
+    model file)."""
+    pd = pytest.importorskip("pandas")
+    rng = np.random.RandomState(7)
+    n = 600
+    cat = rng.choice(["a", "b"], size=n)
+    y = (cat == "a").astype(float) * 8.8 - 4.4
+    df = pd.DataFrame({"c": cat})     # object/str dtype: 'a' seen first? mixed
+    bst = lgb.train({"objective": "regression", "num_leaves": 4,
+                     "verbosity": -1, "min_data_in_leaf": 5,
+                     "learning_rate": 1.0},
+                    lgb.Dataset(df, label=y), 8)
+    # predict frame where 'b' appears first: codes must still match training
+    dfb = pd.DataFrame({"c": ["b", "a"]})
+    pb, pa = bst.predict(dfb)
+    assert abs(pb - (-4.4)) < 0.5 and abs(pa - 4.4) < 0.5
+    # survives model save/load
+    f = tmp_path / "m.txt"
+    bst.save_model(str(f))
+    bst2 = lgb.Booster(model_file=str(f))
+    assert bst2.pandas_categorical is not None
+    pb2, pa2 = bst2.predict(dfb)
+    assert abs(pb2 - pb) < 1e-9 and abs(pa2 - pa) < 1e-9
+    # unseen category -> missing (finite prediction, no crash)
+    assert np.isfinite(bst2.predict(pd.DataFrame({"c": ["zzz"]}))).all()
+
+
+def test_binary_without_raw_rejects_linear_tree(tmp_path):
+    X, y = _make(200, 3)
+    ds = lgb.Dataset(X, label=y)
+    ds.construct({"verbosity": -1})
+    f = tmp_path / "noraw.bin"
+    ds.save_binary(str(f))
+    with pytest.raises(Exception):
+        lgb.train({"objective": "regression", "linear_tree": True,
+                   "verbosity": -1}, lgb.Dataset(str(f)), 2)

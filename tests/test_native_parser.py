@@ -1,0 +1,72 @@
+"""Native C++ text parser tests: parity with the Python fallback
+(reference analog: src/io/parser.cpp parsers)."""
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.native import get_native, parse_delim, parse_libsvm
+
+
+pytestmark = pytest.mark.skipif(get_native() is None,
+                                reason="no native toolchain")
+
+
+def test_parse_delim_basic():
+    text = "1.5,2,3\n4,,6\n7,nan,NA"
+    m = parse_delim(text, ",")
+    assert m.shape == (3, 3)
+    np.testing.assert_allclose(m[0], [1.5, 2, 3])
+    assert np.isnan(m[1, 1]) and m[1, 2] == 6
+    assert np.isnan(m[2, 1]) and np.isnan(m[2, 2])
+
+
+def test_parse_delim_ragged_and_garbage():
+    text = "1\t2\t3\t4\n5\t6\nx\t7\t1e300\t-2.5e-3"
+    m = parse_delim(text, "\t")
+    assert m.shape == (3, 4)
+    assert np.isnan(m[1, 2]) and np.isnan(m[1, 3])     # padded
+    assert np.isnan(m[2, 0])                            # 'x' -> NaN
+    np.testing.assert_allclose(m[2, 1:], [7, 1e300, -2.5e-3])
+
+
+def test_parse_delim_crlf_and_blank_lines():
+    text = "1,2\r\n\r\n3,4\n\n"
+    m = parse_delim(text, ",")
+    assert m.shape == (2, 2)
+    np.testing.assert_allclose(m, [[1, 2], [3, 4]])
+
+
+def test_parse_libsvm():
+    text = "1 0:1.5 3:2.25\n0 1:-4\n1\n"
+    X, y = parse_libsvm(text)
+    assert X.shape == (3, 4)
+    np.testing.assert_allclose(y, [1, 0, 1])
+    np.testing.assert_allclose(X[0], [1.5, 0, 0, 2.25])
+    np.testing.assert_allclose(X[1], [0, -4, 0, 0])
+    np.testing.assert_allclose(X[2], [0, 0, 0, 0])
+
+
+def test_native_matches_python_fallback(tmp_path, rng):
+    """End-to-end: load_text_file must give identical results with and
+    without the native parser."""
+    import lightgbm_tpu.utils.textio as textio
+    from lightgbm_tpu.utils.textio import load_text_file
+    X = rng.normal(size=(200, 5))
+    X[rng.uniform(size=X.shape) < 0.1] = np.nan
+    y = rng.randint(0, 2, size=200)
+    path = tmp_path / "data.csv"
+    with open(path, "w") as f:
+        for i in range(200):
+            f.write(f"{y[i]}," + ",".join(
+                "" if np.isnan(v) else repr(v) for v in X[i]) + "\n")
+    lf_native = load_text_file(str(path))
+    import lightgbm_tpu.native as native_mod
+    orig = native_mod.get_native
+    try:
+        native_mod.get_native = lambda: None
+        import importlib
+        lf_py = load_text_file(str(path))
+    finally:
+        native_mod.get_native = orig
+    np.testing.assert_allclose(lf_native.X, lf_py.X, equal_nan=True)
+    np.testing.assert_allclose(lf_native.label, lf_py.label)
