@@ -114,6 +114,7 @@ class Dataset:
         self.categorical_feature = categorical_feature
         self.params = dict(params) if params else {}
         self.free_raw_data = free_raw_data
+        self.position = position
         self._inner: Optional[BinnedDataset] = None
         self.used_indices: Optional[np.ndarray] = None
         self.pandas_categorical: Optional[List[List[Any]]] = None
@@ -143,6 +144,8 @@ class Dataset:
                     md.set_group(self.group)
                 if self.init_score is not None:
                     md.set_init_score(self.init_score)
+                if self.position is not None:
+                    md.set_position(self.position)
                 return self
             from .utils.textio import load_text_file
             loaded = load_text_file(
@@ -161,11 +164,19 @@ class Dataset:
             if loaded.feature_names and not isinstance(self.feature_name,
                                                        list):
                 self.feature_name = loaded.feature_names
+        ref_inner_early = None
+        if self.reference is not None:
+            self.reference.construct(extra_params)
+            ref_inner_early = self.reference._inner
         auto_cats: List[int] = []
         self.pandas_categorical = None
         if hasattr(self.data, "columns") and hasattr(self.data, "dtypes"):
+            # validation frames must be encoded with the TRAINING category
+            # codes (reference: _data_from_pandas with pandas_categorical)
+            ref_maps = (self.reference.pandas_categorical
+                        if self.reference is not None else None)
             mat, auto_cats, self.pandas_categorical = \
-                _dataframe_to_matrix(self.data)
+                _dataframe_to_matrix(self.data, ref_maps)
         else:
             mat = _to_matrix(self.data)
         feature_names = None
@@ -185,14 +196,12 @@ class Dataset:
                     if x.strip().lstrip("-").isdigit()]
         else:
             cats = auto_cats   # pandas category dtypes ("auto" mode)
-        ref_inner = None
-        if self.reference is not None:
-            self.reference.construct(extra_params)
-            ref_inner = self.reference._inner
+        ref_inner = ref_inner_early
         self._inner = BinnedDataset.from_matrix(
             mat, cfg, label=self.label, weight=self.weight, group=self.group,
             init_score=self.init_score, feature_names=feature_names,
-            categorical_features=cats, reference=ref_inner)
+            categorical_features=cats, reference=ref_inner,
+            position=self.position)
         self._raw_mat = None if self.free_raw_data else mat
         return self
 

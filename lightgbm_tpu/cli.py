@@ -139,7 +139,10 @@ class Application:
             loaded.X, raw_score=bool(cfg.predict_raw_score),
             pred_leaf=bool(cfg.predict_leaf_index),
             pred_contrib=bool(cfg.predict_contrib),
-            num_iteration=cfg.num_iteration_predict)
+            num_iteration=cfg.num_iteration_predict,
+            pred_early_stop=bool(cfg.pred_early_stop),
+            pred_early_stop_freq=int(cfg.pred_early_stop_freq),
+            pred_early_stop_margin=float(cfg.pred_early_stop_margin))
         preds = np.asarray(preds)
         with open(cfg.output_result, "w") as fh:
             if preds.ndim == 1:

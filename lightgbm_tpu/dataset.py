@@ -37,6 +37,30 @@ class Metadata:
         self.weight: Optional[np.ndarray] = None
         self.query_boundaries: Optional[np.ndarray] = None  # int32 [nq+1]
         self.init_score: Optional[np.ndarray] = None
+        self.positions: Optional[np.ndarray] = None         # int32 ids/row
+        self.position_ids: Optional[List[str]] = None       # id -> label
+
+    def set_position(self, position) -> None:
+        """Per-row presentation positions for unbiased lambdarank
+        (reference: Metadata::SetPosition, metadata.cpp; positions factorize
+        to compact ids like the `.position` file loader)."""
+        if position is None:
+            self.positions = None
+            self.position_ids = None
+            return
+        vals = list(np.asarray(position).reshape(-1))
+        if len(vals) != self.num_data:
+            log.fatal("Length of position (%d) != num_data (%d)",
+                      len(vals), self.num_data)
+        seen: Dict[Any, int] = {}
+        ids = np.empty(len(vals), dtype=np.int32)
+        for i, v in enumerate(vals):
+            key = v.item() if hasattr(v, "item") else v
+            if key not in seen:
+                seen[key] = len(seen)
+            ids[i] = seen[key]
+        self.positions = ids
+        self.position_ids = [str(k) for k in seen.keys()]
 
     def set_label(self, label) -> None:
         arr = np.asarray(label, dtype=np.float32).reshape(-1)
@@ -114,7 +138,8 @@ class BinnedDataset:
                     label=None, weight=None, group=None, init_score=None,
                     feature_names: Optional[List[str]] = None,
                     categorical_features: Optional[Sequence[int]] = None,
-                    reference: Optional["BinnedDataset"] = None) -> "BinnedDataset":
+                    reference: Optional["BinnedDataset"] = None,
+                    position=None) -> "BinnedDataset":
         data = np.asarray(data)
         if data.ndim != 2:
             log.fatal("Data must be 2-dimensional")
@@ -128,6 +153,7 @@ class BinnedDataset:
         ds.metadata.set_weight(weight)
         ds.metadata.set_group(group)
         ds.metadata.set_init_score(init_score)
+        ds.metadata.set_position(position)
 
         if reference is not None:
             # validation data: reuse the training mappers & grouping
@@ -380,10 +406,13 @@ class BinnedDataset:
                   else np.zeros((self.num_data, 0), np.uint8)}
         md = self.metadata
         if md is not None:
-            for name in ("label", "weight", "query_boundaries", "init_score"):
+            for name in ("label", "weight", "query_boundaries", "init_score",
+                         "positions"):
                 v = getattr(md, name)
                 if v is not None:
                     arrays[f"meta_{name}"] = np.asarray(v)
+            if md.position_ids is not None:
+                header["position_ids"] = list(md.position_ids)
         if self.raw_data is not None:
             arrays["raw_data"] = self.raw_data
         with open(path, "wb") as fh:   # keep the exact filename (no .npz)
@@ -411,10 +440,13 @@ class BinnedDataset:
                          for g in header["groups"]]
             ds.binned = np.ascontiguousarray(z["binned"])
             ds.metadata = Metadata(ds.num_data)
-            for name in ("label", "weight", "query_boundaries", "init_score"):
+            for name in ("label", "weight", "query_boundaries", "init_score",
+                         "positions"):
                 key = f"meta_{name}"
                 if key in z:
                     setattr(ds.metadata, name, np.ascontiguousarray(z[key]))
+            if "position_ids" in header:
+                ds.metadata.position_ids = list(header["position_ids"])
             if "raw_data" in z:
                 ds.raw_data = np.ascontiguousarray(z["raw_data"])
             elif config.linear_tree:

@@ -384,8 +384,10 @@ class GBDT:
 
         Returns True when training should stop (no further splits possible).
         """
+        from ..utils.timer import global_timer
         if grad is None or hess is None:
-            grad, hess = self._compute_gradients()
+            with global_timer.section("GBDT::Boosting (gradients)"):
+                grad, hess = self._compute_gradients()
         else:
             grad = jnp.asarray(grad, dtype=jnp.float32)
             hess = jnp.asarray(hess, dtype=jnp.float32)
@@ -423,14 +425,16 @@ class GBDT:
                     gk, hk,
                     row_sampling=self.goss or (bag_mask is not None))
             tree_seed = self.iter * K + k + 1
-            if use_sharded:
-                record = self.sharded_builder.build_tree(
-                    gk, hk, feature_mask, seed=tree_seed,
-                    feat_used=self._cegb_feat_used)
-            else:
-                record = self.learner.build_tree(
-                    gk, hk, bag_cnt, feature_mask, seed=tree_seed,
-                    feat_used=self._cegb_feat_used)
+            with global_timer.section("TreeLearner::Train",
+                                      sync=lambda: record["leaf_value"]):
+                if use_sharded:
+                    record = self.sharded_builder.build_tree(
+                        gk, hk, feature_mask, seed=tree_seed,
+                        feat_used=self._cegb_feat_used)
+                else:
+                    record = self.learner.build_tree(
+                        gk, hk, bag_cnt, feature_mask, seed=tree_seed,
+                        feat_used=self._cegb_feat_used)
             if self.learner.has_cegb:
                 # coupled penalties persist for the model lifetime
                 self._cegb_feat_used = record["feat_used"]
@@ -465,7 +469,9 @@ class GBDT:
                                 "leaves")
                     self._warned_linear_sharded = True
             if not use_linear:
-                self._apply_score_update(nodes, delta_leaf, k)
+                with global_timer.section("GBDT::UpdateScore",
+                                          sync=lambda: self.scores):
+                    self._apply_score_update(nodes, delta_leaf, k)
             # host tree for the model
             host_record = {key: np.asarray(val) for key, val in record.items()
                            if key.startswith(("node_", "leaf_"))}
@@ -549,6 +555,11 @@ class GBDT:
     # ------------------------------------------------------------------
     def eval_metrics(self) -> Dict[str, List[Tuple[str, float, bool]]]:
         """Evaluate all metrics; returns {dataset_name: [(metric, value, is_max_better)]}."""
+        from ..utils.timer import global_timer
+        with global_timer.section("Metric::Eval"):
+            return self._eval_metrics_impl()
+
+    def _eval_metrics_impl(self):
         out: Dict[str, List[Tuple[str, float, bool]]] = {}
         if self.train_metrics and self.config.is_provide_training_metric:
             res = []

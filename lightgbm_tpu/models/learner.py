@@ -305,9 +305,11 @@ class SerialTreeLearner:
         self.min_sum_hessian = float(config.min_sum_hessian_in_leaf)
         self.max_depth = int(config.max_depth)
         self.top_k = int(config.top_k)
+        self.path_smooth = float(config.path_smooth)
 
         self._best_split_vmapped = jax.vmap(
-            self._leaf_best_split, in_axes=(0, 0, 0, 0, 0, 0, 0, 0, 0, None))
+            self._leaf_best_split,
+            in_axes=(0, 0, 0, 0, 0, 0, 0, 0, 0, 0, None))
         self._build = jax.jit(self._build_impl)
 
     # ------------------------------------------------------------------
@@ -535,7 +537,8 @@ class SerialTreeLearner:
         return scores >= kth
 
     def _leaf_best_split(self, hist_group, sum_g, sum_h, cnt, local_cnt,
-                         depth, cmin, cmax, feature_mask, feat_used):
+                         depth, cmin, cmax, parent_out, feature_mask,
+                         feat_used):
         if self.F == 0:   # no usable features: every tree is a stub
             z = jnp.float32(0.0)
             zi = jnp.int32(0)
@@ -549,10 +552,11 @@ class SerialTreeLearner:
         if self.parallel_mode == "voting" and self.axis_name is not None:
             return self._leaf_best_split_voting(
                 hist_group, sum_g, sum_h, cnt, local_cnt, depth, cmin, cmax,
-                feature_mask, feat_used)
+                parent_out, feature_mask, feat_used)
         feat_hist = self._feat_view(hist_group, sum_g, sum_h)
         best = self._find_best(feat_hist, sum_g, sum_h, cnt, depth,
-                               cmin, cmax, feature_mask, feat_used=feat_used)
+                               cmin, cmax, feature_mask, feat_used=feat_used,
+                               parent_out=parent_out)
         return self._depth_guard(best, depth)
 
     def _feat_view(self, hist_group, sum_g, sum_h):
@@ -567,7 +571,8 @@ class SerialTreeLearner:
         return feat_hist.at[jnp.arange(self.F), self.default_pos].add(fix)
 
     def _find_best(self, feat_hist, sum_g, sum_h, cnt, depth, cmin, cmax,
-                   feature_mask, feat_used=None, with_feature_gains=False):
+                   feature_mask, feat_used=None, parent_out=None,
+                   with_feature_gains=False):
         cegb_delta = None
         if self.cegb_coupled is not None and feat_used is not None:
             cegb_delta = jnp.where(feat_used, 0.0, self.cegb_coupled)
@@ -581,6 +586,8 @@ class SerialTreeLearner:
             monotone_penalty=self.monotone_penalty,
             cegb_count_coeff=self.cegb_count_coeff,
             cegb_feature_delta=cegb_delta,
+            path_smooth=self.path_smooth,
+            parent_output=parent_out,
             with_feature_gains=with_feature_gains)
 
     def _depth_guard(self, best, depth):
@@ -589,8 +596,8 @@ class SerialTreeLearner:
         return best._replace(gain=gain)
 
     def _leaf_best_split_voting(self, hist_local, sum_g, sum_h, cnt,
-                                local_cnt, depth, cmin, cmax, feature_mask,
-                                feat_used=None):
+                                local_cnt, depth, cmin, cmax, parent_out,
+                                feature_mask, feat_used=None):
         """PV-Tree voting split search (reference:
         voting_parallel_tree_learner.cpp): each device votes its top-k
         features by LOCAL gain, the global top-2k features are elected by
@@ -608,7 +615,7 @@ class SerialTreeLearner:
         _, gains_loc = self._find_best(
             feat_hist_loc, local_sum_g, local_sum_h, local_cnt, depth,
             cmin, cmax, feature_mask, feat_used=feat_used,
-            with_feature_gains=True)
+            parent_out=parent_out, with_feature_gains=True)
         k = min(self.top_k, self.F)
         topv, topi = jax.lax.top_k(gains_loc, k)
         votes = jnp.zeros((self.F,), jnp.int32).at[topi].add(
@@ -628,7 +635,7 @@ class SerialTreeLearner:
         feat_hist = self._feat_view(hist_glob, sum_g, sum_h)
         best = self._find_best(feat_hist, sum_g, sum_h, cnt, depth,
                                cmin, cmax, feature_mask & elected_mask,
-                               feat_used=feat_used)
+                               feat_used=feat_used, parent_out=parent_out)
         return self._depth_guard(best, depth)
 
     # ------------------------------------------------------------------
@@ -703,7 +710,7 @@ class SerialTreeLearner:
         pos_inf = jnp.float32(jnp.inf)
         best0 = self._sync_best(self._leaf_best_split(
             root_hist, sum_g, sum_h, bag_cnt_g, bag_cnt, jnp.int32(0),
-            neg_inf, pos_inf, root_mask, feat_used0))
+            neg_inf, pos_inf, jnp.float32(0.0), root_mask, feat_used0))
 
         def arr(val, dtype=jnp.float32):
             return jnp.full((L,), val, dtype=dtype)
@@ -968,6 +975,7 @@ class SerialTreeLearner:
                     jnp.stack([depth_child, depth_child]),
                     jnp.stack([l_cmin, r_cmin]),
                     jnp.stack([l_cmax, r_cmax]),
+                    jnp.stack([lout, rout]),
                     jnp.stack([mask_l, mask_r]), feat_used_new)
                 best_l = self._sync_best(jax.tree.map(lambda a: a[0], both))
                 best_r = self._sync_best(jax.tree.map(lambda a: a[1], both))

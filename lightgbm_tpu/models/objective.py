@@ -548,6 +548,15 @@ class LambdarankNDCG(ObjectiveFunction):
         self.label_gain_dev = jnp.asarray(self.label_gain.astype(np.float32))
         self.label_dev = jnp.asarray(lbl)
         self._grad_fns = {}
+        # position bias state (reference: rank_objective.hpp:43-56)
+        self.positions = None
+        if metadata.positions is not None:
+            self.positions = jnp.asarray(metadata.positions)
+            self.pos_biases = jnp.zeros(len(metadata.position_ids),
+                                        dtype=jnp.float32)
+            self.position_bias_regularization = float(
+                self.config.lambdarank_position_bias_regularization)
+            self.bias_learning_rate = float(self.config.learning_rate)
 
     def _bucket_grad_fn(self, P: int):
         if P in self._grad_fns:
@@ -618,6 +627,11 @@ class LambdarankNDCG(ObjectiveFunction):
         return fn
 
     def get_gradients(self, score):
+        # unbiased lambdarank: scores are adjusted by the learned per-position
+        # bias factors before lambda computation (reference:
+        # rank_objective.hpp:66-71)
+        if self.positions is not None:
+            score = score + self.pos_biases[self.positions]
         grad = jnp.zeros_like(score)
         hess = jnp.zeros_like(score)
         for b in self.buckets:
@@ -626,7 +640,24 @@ class LambdarankNDCG(ObjectiveFunction):
             flat_idx = b["doc_idx"].reshape(-1)
             grad = grad.at[flat_idx].add(lam.reshape(-1), mode="drop")
             hess = hess.at[flat_idx].add(hes.reshape(-1), mode="drop")
+        if self.positions is not None:
+            self._update_position_bias(grad, hess)
         return grad, hess
+
+    def _update_position_bias(self, grad, hess):
+        """Newton-Raphson step on the per-position bias factors with L2
+        regularization (reference: UpdatePositionBiasFactors,
+        rank_objective.hpp:290-328)."""
+        npos = len(self.pos_biases)
+        seg = self.positions
+        first = jnp.zeros(npos).at[seg].add(-grad)
+        second = jnp.zeros(npos).at[seg].add(-hess)
+        counts = jnp.zeros(npos).at[seg].add(1.0)
+        reg = self.position_bias_regularization
+        first = first - self.pos_biases * reg * counts
+        second = second - reg * counts
+        self.pos_biases = self.pos_biases + \
+            self.bias_learning_rate * first / (jnp.abs(second) + 0.001)
 
     def to_string(self):
         return "lambdarank"

@@ -36,6 +36,7 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.lgbm_parse_libsvm.argtypes = [
         ctypes.c_char_p, ctypes.c_long, ctypes.c_int,
         ctypes.POINTER(ctypes.c_long), ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
         ctypes.POINTER(ctypes.POINTER(ctypes.c_double))]
     lib.lgbm_native_free.restype = None
     lib.lgbm_native_free.argtypes = [ctypes.c_void_p]
@@ -66,14 +67,14 @@ def get_native() -> Optional[ctypes.CDLL]:
         return _LIB
 
 
-def parse_delim(text: str, sep: str,
+def parse_delim(text, sep: str,
                 num_threads: int = 0) -> Optional[np.ndarray]:
-    """Parse delimited text into a dense (R, C) float64 matrix, or None if
-    the native library is unavailable."""
+    """Parse delimited text (str or bytes) into a dense (R, C) float64
+    matrix, or None if the native library is unavailable."""
     lib = get_native()
     if lib is None:
         return None
-    buf = text.encode()
+    buf = text if isinstance(text, bytes) else text.encode()
     rows = ctypes.c_long()
     cols = ctypes.c_int()
     ptr = lib.lgbm_parse_delim(buf, len(buf), sep.encode(), num_threads,
@@ -87,34 +88,37 @@ def parse_delim(text: str, sep: str,
     return arr
 
 
-def parse_libsvm(text: str, num_threads: int = 0
-                 ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
-    """Parse LibSVM text into (X dense (R, C), labels (R,)), or None."""
+def parse_libsvm(text, num_threads: int = 0
+                 ) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    """Parse LibSVM text (str or bytes) into (X dense (R, C), labels (R,),
+    qids (R,) with NaN where absent), or None."""
     lib = get_native()
     if lib is None:
         return None
-    buf = text.encode()
+    buf = text if isinstance(text, bytes) else text.encode()
     rows = ctypes.c_long()
     cols = ctypes.c_int()
     labels_ptr = ctypes.POINTER(ctypes.c_double)()
+    qids_ptr = ctypes.POINTER(ctypes.c_double)()
     ptr = lib.lgbm_parse_libsvm(buf, len(buf), num_threads,
                                 ctypes.byref(rows), ctypes.byref(cols),
-                                ctypes.byref(labels_ptr))
-    if rows.value == 0:
+                                ctypes.byref(labels_ptr),
+                                ctypes.byref(qids_ptr))
+    def _take(p, shape, default):
+        if p:
+            arr = np.ctypeslib.as_array(p, shape=shape).copy()
+            lib.lgbm_native_free(p)
+            return arr
+        return default
+    R = rows.value
+    labels = _take(labels_ptr, (R,), np.zeros(R, dtype=np.float64)) \
+        if R else np.zeros(0, dtype=np.float64)
+    qids = _take(qids_ptr, (R,), np.full(R, np.nan)) \
+        if R else np.zeros(0, dtype=np.float64)
+    if ptr and cols.value > 0 and R:
+        X = _take(ptr, (R, cols.value), None)
+    else:
         if ptr:
             lib.lgbm_native_free(ptr)
-        if labels_ptr:
-            lib.lgbm_native_free(labels_ptr)
-        return (np.zeros((0, 0), dtype=np.float64),
-                np.zeros(0, dtype=np.float64))
-    labels = np.ctypeslib.as_array(labels_ptr, shape=(rows.value,)).copy() \
-        if labels_ptr else np.zeros(rows.value, dtype=np.float64)
-    if ptr and cols.value > 0:
-        X = np.ctypeslib.as_array(ptr, shape=(rows.value, cols.value)).copy()
-    else:
-        X = np.zeros((rows.value, 0), dtype=np.float64)
-    if ptr:
-        lib.lgbm_native_free(ptr)
-    if labels_ptr:
-        lib.lgbm_native_free(labels_ptr)
-    return X, labels
+        X = np.zeros((R, 0), dtype=np.float64)
+    return X, labels, qids

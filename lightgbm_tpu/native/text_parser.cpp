@@ -69,11 +69,41 @@ bool IsMissingToken(const char* s, long n) {
   return false;
 }
 
-// Parse one token [s, s+n) like Python float(): full consumption required.
+// Parse one token [s, s+n) like Python float(): full consumption required,
+// no hex floats, single underscores allowed between digits.
 double ParseToken(const char* s, long n) {
   while (n > 0 && std::isspace(static_cast<unsigned char>(*s))) { ++s; --n; }
   while (n > 0 && std::isspace(static_cast<unsigned char>(s[n - 1]))) --n;
   if (n == 0) return NAN;
+  // Python float() rejects hex literals that strtod accepts
+  {
+    long k = 0;
+    if (k < n && (s[k] == '+' || s[k] == '-')) ++k;
+    if (k + 1 < n && s[k] == '0' && (s[k + 1] == 'x' || s[k + 1] == 'X')) {
+      return NAN;
+    }
+  }
+  char buf[64];
+  if (std::memchr(s, '_', n) != nullptr) {
+    // Python float() allows single underscores BETWEEN digits
+    if (n >= static_cast<long>(sizeof(buf))) return NAN;
+    long m = 0;
+    for (long k = 0; k < n; ++k) {
+      if (s[k] == '_') {
+        const bool ok = k > 0 && k + 1 < n &&
+            std::isdigit(static_cast<unsigned char>(s[k - 1])) &&
+            std::isdigit(static_cast<unsigned char>(s[k + 1]));
+        if (!ok) return NAN;
+        continue;
+      }
+      buf[m++] = s[k];
+    }
+    buf[m] = '\0';
+    char* end = nullptr;
+    const double v = std::strtod(buf, &end);
+    if (end != buf + m) return NAN;
+    return v;
+  }
   char* end = nullptr;
   const double v = std::strtod(s, &end);
   if (end != s + n) return NAN;
@@ -153,22 +183,48 @@ double* lgbm_parse_delim(const char* buf, long len, char sep, int num_threads,
   return mat;
 }
 
-// LibSVM parse ("label idx:val idx:val ..."): returns a malloc'd dense
-// (R x C) feature matrix (zeros for absent entries); labels written to a
-// malloc'd (R,) array returned through labels_out.
+namespace {
+
+// Parse "key:value"; returns feature index, or -1 for qid (value stored in
+// *qid), or -2 for any other non-integer key (ignored, like the reference
+// parser skipping malformed pairs).
+long ParseSvmKey(const char* p, const char* colon, double* qid,
+                 const char* colon_end) {
+  char* iend = nullptr;
+  const long idx = std::strtol(p, &iend, 10);
+  if (iend == colon && iend != p) return idx;
+  if (colon - p == 3 && p[0] == 'q' && p[1] == 'i' && p[2] == 'd') {
+    *qid = std::strtod(colon + 1, nullptr);
+    (void)colon_end;
+    return -1;
+  }
+  return -2;
+}
+
+}  // namespace
+
+// LibSVM parse ("label [qid:q] idx:val idx:val ..."): returns a malloc'd
+// dense (R x C) feature matrix (zeros for absent entries); labels and
+// per-row qids (NaN when absent) written to malloc'd (R,) arrays.
 double* lgbm_parse_libsvm(const char* buf, long len, int num_threads,
                           long* n_rows_out, int* n_cols_out,
-                          double** labels_out) {
+                          double** labels_out, double** qids_out) {
   const LineIndex lines = IndexLines(buf, len);
   const long R = static_cast<long>(lines.starts.size());
   *n_rows_out = R;
   *n_cols_out = 0;
   *labels_out = nullptr;
+  *qids_out = nullptr;
   if (R == 0) return nullptr;
   const int T = ResolveThreads(num_threads);
 
   double* labels = static_cast<double*>(std::malloc(sizeof(double) * R));
-  if (labels == nullptr) return nullptr;
+  double* qids = static_cast<double*>(std::malloc(sizeof(double) * R));
+  if (labels == nullptr || qids == nullptr) {
+    std::free(labels);
+    std::free(qids);
+    return nullptr;
+  }
   std::vector<long> tmaxf(T, -1);
   ParallelFor(T, [&](int t) {
     long mx = -1;
@@ -177,6 +233,7 @@ double* lgbm_parse_libsvm(const char* buf, long len, int num_threads,
       const char* endl = s + lines.lens[i];
       char* end = nullptr;
       labels[i] = std::strtod(s, &end);
+      qids[i] = NAN;
       const char* p = end;
       while (p < endl) {
         while (p < endl && std::isspace(static_cast<unsigned char>(*p))) ++p;
@@ -184,8 +241,8 @@ double* lgbm_parse_libsvm(const char* buf, long len, int num_threads,
         while (colon < endl && *colon != ':' &&
                !std::isspace(static_cast<unsigned char>(*colon))) ++colon;
         if (colon >= endl || *colon != ':') { p = colon; continue; }
-        const long idx = std::strtol(p, nullptr, 10);
-        mx = std::max(mx, idx);
+        const long idx = ParseSvmKey(p, colon, &qids[i], endl);
+        if (idx >= 0) mx = std::max(mx, idx);
         p = colon + 1;
         while (p < endl && !std::isspace(static_cast<unsigned char>(*p))) ++p;
       }
@@ -196,12 +253,13 @@ double* lgbm_parse_libsvm(const char* buf, long len, int num_threads,
   const int C = static_cast<int>(maxf + 1);
   if (C <= 0) {
     *labels_out = labels;
+    *qids_out = qids;
     return nullptr;
   }
   double* mat = static_cast<double*>(std::calloc(R * C, sizeof(double)));
   if (mat == nullptr) {
     std::free(labels);
-    *labels_out = nullptr;
+    std::free(qids);
     return nullptr;
   }
   ParallelFor(T, [&](int t) {
@@ -212,13 +270,14 @@ double* lgbm_parse_libsvm(const char* buf, long len, int num_threads,
       std::strtod(s, &end);  // skip label
       const char* p = end;
       double* row = mat + i * C;
+      double qid_dummy;
       while (p < endl) {
         while (p < endl && std::isspace(static_cast<unsigned char>(*p))) ++p;
         const char* colon = p;
         while (colon < endl && *colon != ':' &&
                !std::isspace(static_cast<unsigned char>(*colon))) ++colon;
         if (colon >= endl || *colon != ':') { p = colon; continue; }
-        const long idx = std::strtol(p, nullptr, 10);
+        const long idx = ParseSvmKey(p, colon, &qid_dummy, endl);
         char* vend = nullptr;
         const double v = std::strtod(colon + 1, &vend);
         if (idx >= 0 && idx < C) row[idx] = v;
@@ -227,6 +286,7 @@ double* lgbm_parse_libsvm(const char* buf, long len, int num_threads,
     }
   });
   *labels_out = labels;
+  *qids_out = qids;
   *n_cols_out = C;
   return mat;
 }

@@ -272,6 +272,7 @@ def find_best_split(feat_hist: jnp.ndarray, ctx: SplitContext,
                     monotone_penalty: float = 0.0,
                     cegb_count_coeff: float = 0.0,
                     cegb_feature_delta: jnp.ndarray | None = None,
+                    path_smooth: float = 0.0, parent_output=None,
                     with_feature_gains: bool = False):
     """Find the best numerical split for one leaf.
 
@@ -350,29 +351,51 @@ def find_best_split(feat_hist: jnp.ndarray, ctx: SplitContext,
     left_c_r = num_data.astype(jnp.int32) - right_c_r
 
     use_mc = monotone is not None
-    if use_mc:
-        parent_out = jnp.clip(
+    use_smooth = path_smooth > 0.0
+    if use_smooth:
+        # reference: USE_SMOOTHING arm of FindBestThresholdSequentially —
+        # gain shift is evaluated at the leaf's CURRENT output
+        gain_shift = _leaf_gain_given_output(sum_g, sum_h_tot, l1, l2,
+                                             parent_output)
+    elif use_mc:
+        parent_out_est = jnp.clip(
             leaf_output(sum_g, sum_h_tot, l1, l2, max_delta_step), cmin, cmax)
         gain_shift = _leaf_gain_given_output(sum_g, sum_h_tot, l1, l2,
-                                             parent_out)
+                                             parent_out_est)
     else:
         gain_shift = leaf_gain(sum_g, sum_h_tot, l1, l2, max_delta_step)
     min_gain_shift = gain_shift + min_gain_to_split
 
-    def side_gain(gl, hl, gr, hr):
-        if not use_mc:
+    def child_output(g, h, c):
+        out = leaf_output(g, h, l1, l2, max_delta_step)
+        if use_smooth:
+            # reference: CalculateSplittedLeafOutput smoothing arm
+            # (feature_histogram.hpp:717): shrink toward the parent output
+            # proportionally to n/path_smooth
+            f = c.astype(jnp.float32) / path_smooth
+            out = out * f / (f + 1.0) + parent_output / (f + 1.0)
+        if use_mc:
+            out = jnp.clip(out, cmin, cmax)
+        return out
+
+    def side_gain(gl, hl, gr, hr, cl, cr):
+        if not (use_mc or use_smooth):
             return (leaf_gain(gl, hl, l1, l2, max_delta_step) +
                     leaf_gain(gr, hr, l1, l2, max_delta_step))
-        lo = jnp.clip(leaf_output(gl, hl, l1, l2, max_delta_step), cmin, cmax)
-        ro = jnp.clip(leaf_output(gr, hr, l1, l2, max_delta_step), cmin, cmax)
+        lo = child_output(gl, hl, cl)
+        ro = child_output(gr, hr, cr)
         g = (_leaf_gain_given_output(gl, hl, l1, l2, lo) +
              _leaf_gain_given_output(gr, hr, l1, l2, ro))
-        mono = monotone[:, None]
-        bad = ((mono > 0) & (lo > ro)) | ((mono < 0) & (lo < ro))
-        return jnp.where(bad, K_MIN_SCORE, g)
+        if use_mc:
+            mono = monotone[:, None]
+            bad = ((mono > 0) & (lo > ro)) | ((mono < 0) & (lo < ro))
+            g = jnp.where(bad, K_MIN_SCORE, g)
+        return g
 
-    gain_f = side_gain(left_g_f, left_h_f, right_g_f, right_h_f)
-    gain_r = side_gain(left_g_r, left_h_r, right_g_r, right_h_r)
+    gain_f = side_gain(left_g_f, left_h_f, right_g_f, right_h_f,
+                       left_c_f, right_c_f)
+    gain_r = side_gain(left_g_r, left_h_r, right_g_r, right_h_r,
+                       left_c_r, right_c_r)
 
     def common_valid(lc, rc, lh, rh):
         return ((lc >= min_data_in_leaf) & (rc >= min_data_in_leaf) &
@@ -482,6 +505,11 @@ def find_best_split(feat_hist: jnp.ndarray, ctx: SplitContext,
 
     lout_best = leaf_output(lg, lh, l1, l2_out, max_delta_step)
     rout_best = leaf_output(rg, rh, l1, l2_out, max_delta_step)
+    if use_smooth:
+        fl = lc.astype(jnp.float32) / path_smooth
+        fr = rc.astype(jnp.float32) / path_smooth
+        lout_best = lout_best * fl / (fl + 1.0) + parent_output / (fl + 1.0)
+        rout_best = rout_best * fr / (fr + 1.0) + parent_output / (fr + 1.0)
     if use_mc:
         lout_best = jnp.clip(lout_best, cmin, cmax)
         rout_best = jnp.clip(rout_best, cmin, cmax)

@@ -81,26 +81,32 @@ def load_text_file(path: str, *, has_header: bool = False,
                    label_column: str = "", weight_column: str = "",
                    group_column: str = "", ignore_column: str = "",
                    max_rows: Optional[int] = None) -> LoadedFile:
-    """Load a CSV/TSV/LibSVM file into a dense matrix + metadata columns."""
-    with open(path, "r") as fh:
-        text = fh.read()
-    lines = [ln for ln in text.split("\n") if ln.strip() != ""]
-    if not lines:
+    """Load a CSV/TSV/LibSVM file into a dense matrix + metadata columns.
+
+    The file is read once as bytes; the native parser consumes the raw
+    buffer directly (no per-line or re-encoded copies on the hot path)."""
+    with open(path, "rb") as fh:
+        raw = fh.read()
+    # decode only a small probe for header/format detection
+    probe_text = raw[:65536].decode("utf-8", errors="replace")
+    probe_lines = [ln for ln in probe_text.split("\n") if ln.strip() != ""]
+    if not probe_lines:
         raise ValueError(f"Empty data file: {path}")
 
     header_names: Optional[List[str]] = None
-    first_data = 0
-    probe = lines[0].replace(",", " ").replace("\t", " ").split()
+    data_start = 0
+    first_line = probe_lines[0]
+    probe = first_line.replace(",", " ").replace("\t", " ").split()
     header_detected = has_header or not all(
         _is_number(t) or ":" in t for t in probe)
     if header_detected:
-        sep0 = "\t" if "\t" in lines[0] else ("," if "," in lines[0] else " ")
-        header_names = [c.strip() for c in lines[0].split(sep0)]
-        first_data = 1
-    data_lines = lines[first_data:]
-    if max_rows is not None:
-        data_lines = data_lines[:max_rows]
-    kind, sep = _detect_format(data_lines[:100])
+        sep0 = "\t" if "\t" in first_line else \
+            ("," if "," in first_line else " ")
+        header_names = [c.strip() for c in first_line.split(sep0)]
+        nl = raw.find(b"\n")
+        data_start = nl + 1 if nl >= 0 else len(raw)
+    kind, sep = _detect_format(
+        probe_lines[1:101] if header_detected else probe_lines[:100])
 
     label_idx = parse_column_spec(label_column, header_names)
     if label_idx < 0:
@@ -109,15 +115,29 @@ def load_text_file(path: str, *, has_header: bool = False,
     group_idx = parse_column_spec(group_column, header_names)
     ignore = set(_parse_ignore_spec(ignore_column, header_names))
 
+    data = raw[data_start:]
+    if max_rows is not None:
+        # keep only the first max_rows non-empty lines
+        kept, cnt, pos = [], 0, 0
+        while cnt < max_rows and pos < len(data):
+            nl = data.find(b"\n", pos)
+            end = nl if nl >= 0 else len(data)
+            if data[pos:end].strip():
+                cnt += 1
+            pos = end + 1 if nl >= 0 else len(data)
+        data = data[:pos]
+
     if kind == "libsvm":
-        return _load_libsvm(data_lines, weight_idx, group_idx)
+        return _load_libsvm(data, weight_idx, group_idx)
 
     # hot path: the native C++ parser (multi-threaded, ctypes; reference
     # analog: src/io/parser.cpp CSVParser::ParseOneLine), with the Python
     # loop as fallback
     from ..native import parse_delim
-    mat = parse_delim("\n".join(data_lines), sep)
+    mat = parse_delim(data, sep)
     if mat is None:
+        data_lines = [ln for ln in data.decode("utf-8", errors="replace")
+                      .split("\n") if ln.strip() != ""]
         rows = [ln.split(sep) for ln in data_lines]
         ncol = max(len(r) for r in rows)
         mat = np.full((len(rows), ncol), np.nan, dtype=np.float64)
@@ -162,14 +182,28 @@ def load_text_file(path: str, *, has_header: bool = False,
     return LoadedFile(X, label, weight, group, feature_names)
 
 
-def _load_libsvm(data_lines: List[str], weight_idx: int,
-                 group_idx: int) -> LoadedFile:
+def _qids_to_group(qids: np.ndarray) -> Optional[np.ndarray]:
+    """Consecutive qid runs -> group sizes (reference: Metadata::SetQueryId)."""
+    if qids is None or np.isnan(qids).all():
+        return None
+    boundaries = [0]
+    for i in range(1, len(qids)):
+        if qids[i] != qids[i - 1]:
+            boundaries.append(i)
+    boundaries.append(len(qids))
+    return np.diff(boundaries).astype(np.int32)
+
+
+def _load_libsvm(data, weight_idx: int, group_idx: int) -> LoadedFile:
     from ..native import parse_libsvm
-    native = parse_libsvm("\n".join(data_lines))
+    native = parse_libsvm(data)
     if native is not None:
-        X, labels = native
-        return LoadedFile(X, labels, None, None, None)
+        X, labels, qids = native
+        return LoadedFile(X, labels, None, _qids_to_group(qids), None)
+    data_lines = [ln for ln in data.decode("utf-8", errors="replace")
+                  .split("\n") if ln.strip() != ""]
     labels = np.empty(len(data_lines), dtype=np.float64)
+    qids = np.full(len(data_lines), np.nan)
     entries: List[List[Tuple[int, float]]] = []
     max_feat = -1
     for i, ln in enumerate(data_lines):
@@ -180,7 +214,13 @@ def _load_libsvm(data_lines: List[str], weight_idx: int,
             if ":" not in t:
                 continue
             k, v = t.split(":", 1)
-            j = int(k)
+            if k == "qid":
+                qids[i] = float(v)
+                continue
+            try:
+                j = int(k)
+            except ValueError:   # malformed key: skip, like the native path
+                continue
             row.append((j, float(v)))
             max_feat = max(max_feat, j)
         entries.append(row)
@@ -188,4 +228,4 @@ def _load_libsvm(data_lines: List[str], weight_idx: int,
     for i, row in enumerate(entries):
         for j, v in row:
             X[i, j] = v
-    return LoadedFile(X, labels, None, None, None)
+    return LoadedFile(X, labels, None, _qids_to_group(qids), None)

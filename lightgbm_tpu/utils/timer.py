@@ -1,0 +1,99 @@
+"""Per-phase wall-clock accounting (reference: Common::Timer /
+FunctionTimer + the -DUSE_TIMETAG global_timer, include/LightGBM/utils/
+common.h:973-1060): every hot phase is annotated and an aggregate table is
+printed at shutdown.
+
+Enabled by LIGHTGBM_TPU_TIMETAG=1 (the runtime analog of the reference's
+compile-time flag).  When enabled, device work is synchronized at section
+ends so phases are attributed correctly despite XLA's async dispatch; a
+`jax.profiler` trace can additionally be captured with
+LIGHTGBM_TPU_PROFILE_DIR=<dir> for TensorBoard.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from typing import Dict
+
+__all__ = ["global_timer", "timed"]
+
+
+class GlobalTimer:
+    def __init__(self):
+        self.enabled = os.environ.get("LIGHTGBM_TPU_TIMETAG", "") == "1"
+        self.profile_dir = os.environ.get("LIGHTGBM_TPU_PROFILE_DIR", "")
+        self._acc: Dict[str, float] = defaultdict(float)
+        self._cnt: Dict[str, int] = defaultdict(int)
+        self._started_profile = False
+        if self.enabled:
+            atexit.register(self.print_table)
+        if self.profile_dir:
+            self._start_profiler()
+
+    def _start_profiler(self):
+        try:
+            import jax
+            jax.profiler.start_trace(self.profile_dir)
+            self._started_profile = True
+            atexit.register(self._stop_profiler)
+        except Exception:
+            pass
+
+    def _stop_profiler(self):
+        if self._started_profile:
+            import jax
+            jax.profiler.stop_trace()
+            self._started_profile = False
+
+    @contextmanager
+    def section(self, name: str, sync=None):
+        """Accumulate wall time under `name`; `sync` is an optional value
+        whose device computation is waited on before stopping the clock."""
+        if not self.enabled:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            if sync is not None:
+                try:
+                    import jax
+                    jax.block_until_ready(sync() if callable(sync) else sync)
+                except Exception:
+                    pass
+            self._acc[name] += time.perf_counter() - t0
+            self._cnt[name] += 1
+
+    def print_table(self):
+        if not self._acc:
+            return
+        from . import log
+        width = max(len(k) for k in self._acc)
+        log.info("%-*s %12s %8s", width, "phase", "seconds", "calls")
+        for name, sec in sorted(self._acc.items(), key=lambda kv: -kv[1]):
+            log.info("%-*s %12.3f %8d", width, name, sec, self._cnt[name])
+
+    def reset(self):
+        self._acc.clear()
+        self._cnt.clear()
+
+
+global_timer = GlobalTimer()
+
+
+def timed(name: str):
+    """Decorator form (reference: FunctionTimer RAII)."""
+    def wrap(fn):
+        if not global_timer.enabled:
+            return fn
+
+        def inner(*a, **kw):
+            with global_timer.section(name):
+                return fn(*a, **kw)
+        return inner
+    return wrap

@@ -169,3 +169,82 @@ def test_binary_without_raw_rejects_linear_tree(tmp_path):
     with pytest.raises(Exception):
         lgb.train({"objective": "regression", "linear_tree": True,
                    "verbosity": -1}, lgb.Dataset(str(f)), 2)
+
+
+def test_lambdarank_position_bias():
+    """Unbiased lambdarank: per-position bias factors are learned via
+    Newton steps when Dataset(position=...) is given (reference:
+    rank_objective.hpp UpdatePositionBiasFactors)."""
+    rng = np.random.RandomState(11)
+    nq, per = 60, 10
+    n = nq * per
+    X = rng.normal(size=(n, 5))
+    true_rel = np.clip((X[:, 0] + 0.3 * rng.normal(size=n)) > 0.5, 0, 1)
+    # clicks biased by presentation position: top positions clicked more
+    pos = np.tile(np.arange(per), nq)
+    click_p = np.where(true_rel > 0, 0.9, 0.15) * (1.0 / (1 + 0.35 * pos))
+    y = (rng.uniform(size=n) < click_p).astype(int)
+    group = np.full(nq, per)
+    ds = lgb.Dataset(X, label=y, group=group, position=pos)
+    bst = lgb.train({"objective": "lambdarank", "num_leaves": 15,
+                     "min_data_in_leaf": 5, "verbosity": -1,
+                     "lambdarank_position_bias_regularization": 0.1},
+                    ds, 20)
+    obj = bst._gbdt.objective
+    biases = np.asarray(obj.pos_biases)
+    assert biases.shape == (per,)
+    assert np.any(biases != 0.0)
+    # learned bias should favor top positions (clicks inflated there)
+    assert biases[0] > biases[-1]
+    # and training without positions is unaffected
+    bst2 = lgb.train({"objective": "lambdarank", "num_leaves": 15,
+                      "min_data_in_leaf": 5, "verbosity": -1},
+                     lgb.Dataset(X, label=y, group=group), 5)
+    assert bst2._gbdt.objective.positions is None
+
+
+def test_validation_dataframe_uses_training_codes():
+    """A valid_set DataFrame with different category appearance order must be
+    encoded with the training codes (metrics were corrupted otherwise)."""
+    pd = pytest.importorskip("pandas")
+    rng = np.random.RandomState(19)
+    n = 400
+    cat = rng.choice(["x", "y"], size=n)
+    y = (cat == "x").astype(float)
+    df = pd.DataFrame({"c": cat})
+    # valid set: same data REVERSED so the first-seen category differs
+    dfv = pd.DataFrame({"c": cat[::-1]})
+    ds = lgb.Dataset(df, label=y)
+    vs = lgb.Dataset(dfv, label=y[::-1], reference=ds)
+    evals = {}
+    lgb.train({"objective": "binary", "num_leaves": 4, "verbosity": -1,
+               "metric": "binary_error", "min_data_in_leaf": 5},
+              ds, 5, valid_sets=[vs], valid_names=["v"],
+              callbacks=[lgb.record_evaluation(evals)])
+    assert evals["v"]["binary_error"][-1] < 0.01
+
+
+def test_binary_roundtrip_preserves_positions(tmp_path):
+    X = np.random.RandomState(0).normal(size=(100, 3))
+    y = (X[:, 0] > 0).astype(float)
+    pos = np.tile(np.arange(10), 10)
+    ds = lgb.Dataset(X, label=y, group=np.full(10, 10), position=pos)
+    ds.construct({"verbosity": -1})
+    f = tmp_path / "p.bin"
+    ds.save_binary(str(f))
+    from lightgbm_tpu.config import Config
+    back = BinnedDataset.load_binary(str(f), Config({"verbosity": -1}))
+    np.testing.assert_array_equal(back.metadata.positions,
+                                  ds._inner.metadata.positions)
+    assert back.metadata.position_ids == ds._inner.metadata.position_ids
+
+
+def test_libsvm_qid_group_loading(tmp_path):
+    from lightgbm_tpu.utils.textio import load_text_file
+    p = tmp_path / "rank.svm"
+    p.write_text("2 qid:1 0:0.5 2:1.0\n1 qid:1 1:0.25\n0 qid:2 0:3.0\n"
+                 "1 qid:2 1:1.0\n0 qid:3 0:0.1\n")
+    lf = load_text_file(str(p))
+    np.testing.assert_array_equal(lf.group, [2, 2, 1])
+    assert lf.X.shape == (5, 3)
+    assert lf.X[1, 0] == 0.0    # qid never leaks into features

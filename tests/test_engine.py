@@ -143,3 +143,20 @@ def test_constant_dataset_trains_stub_trees():
                      "verbosity": -1}, lgb.Dataset(X, label=y),
                     num_boost_round=2)
     assert np.allclose(bst.predict(X), 3.0)
+
+
+def test_path_smooth_regularizes():
+    """path_smooth shrinks leaf outputs toward the parent: predictions get
+    smoother (lower variance) but the model still learns
+    (reference: CalculateSplittedLeafOutput smoothing arm)."""
+    X, y = make_regression(600, 6, seed=5)
+    base = {"objective": "regression", "num_leaves": 31, "verbosity": -1,
+            "min_data_in_leaf": 5}
+    b0 = lgb.train(dict(base), lgb.Dataset(X, label=y), 20)
+    b1 = lgb.train({**base, "path_smooth": 50.0},
+                   lgb.Dataset(X, label=y), 20)
+    mse0 = np.mean((y - b0.predict(X)) ** 2)
+    mse1 = np.mean((y - b1.predict(X)) ** 2)
+    # smoothing trades a bit of train fit for regularization
+    assert mse1 > mse0
+    assert mse1 < 0.4 * np.var(y)

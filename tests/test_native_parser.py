@@ -38,12 +38,36 @@ def test_parse_delim_crlf_and_blank_lines():
 
 def test_parse_libsvm():
     text = "1 0:1.5 3:2.25\n0 1:-4\n1\n"
-    X, y = parse_libsvm(text)
+    X, y, q = parse_libsvm(text)
     assert X.shape == (3, 4)
     np.testing.assert_allclose(y, [1, 0, 1])
     np.testing.assert_allclose(X[0], [1.5, 0, 0, 2.25])
     np.testing.assert_allclose(X[1], [0, -4, 0, 0])
     np.testing.assert_allclose(X[2], [0, 0, 0, 0])
+    assert np.isnan(q).all()
+
+
+def test_parse_libsvm_qid():
+    """qid tokens map to group info, never to feature 0 (standard ranking
+    LibSVM files)."""
+    text = "2 qid:1 0:0.5 2:1.0\n1 qid:1 1:0.25\n0 qid:2 0:3.0\n"
+    X, y, q = parse_libsvm(text)
+    assert X.shape == (3, 3)
+    np.testing.assert_allclose(X[0], [0.5, 0, 1.0])
+    np.testing.assert_allclose(X[1], [0, 0.25, 0])      # no qid leak into f0
+    np.testing.assert_allclose(X[2], [3.0, 0, 0])
+    np.testing.assert_allclose(q, [1, 1, 2])
+
+
+def test_parse_delim_python_float_parity():
+    """Hex floats rejected, single underscores between digits accepted —
+    exactly like Python float()."""
+    m = parse_delim("0x10,1_0,1__0,_1,1_,inf,-inf", ",")
+    assert np.isnan(m[0, 0])          # hex rejected
+    assert m[0, 1] == 10.0            # 1_0 -> 10
+    assert np.isnan(m[0, 2])          # double underscore rejected
+    assert np.isnan(m[0, 3]) and np.isnan(m[0, 4])
+    assert np.isinf(m[0, 5]) and np.isinf(m[0, 6])
 
 
 def test_native_matches_python_fallback(tmp_path, rng):
