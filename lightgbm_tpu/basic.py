@@ -428,6 +428,7 @@ class Booster:
         """SHAP feature contributions via per-tree path attribution
         (reference: tree.h PredictContrib / TreeSHAP)."""
         from .models.shap import predict_contrib
+        self._gbdt._flush_pending()
         return predict_contrib(self._gbdt, np.asarray(data, dtype=np.float64),
                                start_iteration, num_iteration)
 
@@ -437,6 +438,7 @@ class Booster:
                         importance_type: str = "split") -> str:
         """reference: GBDT::SaveModelToString (gbdt_model_text.cpp:280-430)."""
         g = self._gbdt
+        g._flush_pending()
         cfg = self.config
         K = g.num_tree_per_iteration
         lines = ["tree"]
@@ -552,6 +554,7 @@ class Booster:
     def dump_model(self, num_iteration: int = -1, start_iteration: int = 0) -> dict:
         """reference: GBDT::DumpModel (gbdt_model_text.cpp:23-120)."""
         g = self._gbdt
+        g._flush_pending()
         K = g.num_tree_per_iteration
         total = len(g.models)
         end = total if num_iteration < 0 else min(total, (start_iteration + num_iteration) * K)
@@ -573,6 +576,7 @@ class Booster:
     def feature_importance(self, importance_type: str = "split",
                            iteration: Optional[int] = None) -> np.ndarray:
         """reference: GBDT::FeatureImportance (gbdt.cpp)."""
+        self._gbdt._flush_pending()
         n = self._gbdt.max_feature_idx + 1
         imp = np.zeros(n, dtype=np.float64)
         for tree in self._gbdt.models:
@@ -599,6 +603,7 @@ class Booster:
         from .ops.split import leaf_output as _leaf_output
 
         g = self._gbdt
+        g._flush_pending()
         if not g.models:
             raise LightGBMError("Cannot refit an empty model")
         merged = dict(self.params)

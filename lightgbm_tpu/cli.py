@@ -64,6 +64,10 @@ class Application:
 
     def run(self) -> None:
         task = self.config.task
+        # multi-host bootstrap before any device work (reference:
+        # application.cpp:171 Network::Init ahead of LoadData/Train)
+        from .parallel.network import init_from_config
+        init_from_config(self.config)
         if task == "train":
             self.train()
         elif task in ("predict", "prediction", "test"):

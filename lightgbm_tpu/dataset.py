@@ -265,9 +265,17 @@ class BinnedDataset:
                 self.groups.append(FeatureGroupInfo(
                     [f], self.bin_mappers[f].num_bin, [0]))
 
-        n = self.num_data
-        ngroups = len(self.groups)
-        out = np.zeros((n, ngroups), dtype=np.int32)
+        self.binned = self._pack_groups(cols, self.num_data).astype(
+            self._bin_dtype())
+
+    def _bin_dtype(self):
+        max_bin_overall = max((grp.num_total_bin for grp in self.groups),
+                              default=2)
+        return np.uint8 if max_bin_overall <= 256 else np.uint16
+
+    def _pack_groups(self, cols: Dict[int, np.ndarray], n: int) -> np.ndarray:
+        """Pack per-feature bin columns into the (n, num_groups) matrix."""
+        out = np.zeros((n, len(self.groups)), dtype=np.int32)
         for g, grp in enumerate(self.groups):
             if len(grp.feature_indices) == 1:
                 out[:, g] = cols[grp.feature_indices[0]]
@@ -283,9 +291,7 @@ class BinnedDataset:
                     shifted = c + offset - (1 if bm.most_freq_bin == 0 else 0)
                     acc = np.where(nz, shifted, acc)
                 out[:, g] = acc
-        max_bin_overall = max((grp.num_total_bin for grp in self.groups), default=2)
-        dtype = np.uint8 if max_bin_overall <= 256 else np.uint16
-        self.binned = out.astype(dtype)
+        return out
 
     def _bundle_sparse(self, sparse: List[int], cols: Dict[int, np.ndarray]) -> None:
         """Greedy conflict-count bundling (reference: dataset.cpp FindGroups)."""

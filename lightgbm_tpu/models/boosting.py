@@ -118,6 +118,8 @@ class GBDT:
         # model-lifetime CEGB used-feature set (reference:
         # CostEfficientGradientBoosting::is_feature_used_in_split_)
         self._cegb_feat_used = None
+        # lagged fused-iteration records awaiting host materialization
+        self._pending_recs: List[Dict[str, Any]] = []
 
         # sampling state
         self.bag_rng = jax.random.PRNGKey(cfg.bagging_seed)
@@ -131,6 +133,134 @@ class GBDT:
 
         self._traverse_train = jax.jit(
             lambda nodes, binned: predict_leaf_binned(binned, nodes))
+
+        # ---- fused training step ----
+        # One jitted program per boosting iteration: gradients -> tree build
+        # -> score update, with only two host round-trips (dispatch + small
+        # record fetch).  Vital on TPU where per-dispatch latency dominates
+        # the eager path (the TPU analog of the reference keeping the whole
+        # iteration inside C++, gbdt.cpp:338-441).
+        self._fused = None
+        if (self.sharded_builder is None and self.objective is not None
+                and getattr(self.objective, "is_jit_safe", True)
+                and K == 1
+                and not cfg.linear_tree and not self.use_quant
+                and not self.goss and not self.need_bagging
+                and not self.objective.is_renew_tree_output):
+            self._setup_fused_step()
+
+    def _setup_fused_step(self) -> None:
+        lr_ = self.learner
+        obj = self.objective
+        shrink = self.shrinkage_rate
+        N = self.num_data
+        L = lr_.L
+        Npad = lr_.N_pad
+
+        def step(part_bins, scores, feature_mask, seed, feat_used):
+            grad, hess = obj.get_gradients(scores)
+            rec = lr_._build_impl(part_bins, grad, hess, jnp.int32(N),
+                                  feature_mask, seed, feat_used)
+            # per-row score delta from the physical leaf ranges: leaves are
+            # disjoint contiguous row windows, so scatter +/- leaf values at
+            # the range boundaries and prefix-sum — the +v/-v pairs of each
+            # closed range cancel exactly before the next range opens — then
+            # ONE scatter maps physical rows back to original row order
+            d = jnp.zeros((Npad + 1,), jnp.float32)
+            d = d.at[rec["leaf_start"]].add(rec["leaf_value"], mode="drop")
+            d = d.at[rec["leaf_start"] + rec["leaf_cnt"]].add(
+                -rec["leaf_value"], mode="drop")
+            delta_phys = jnp.cumsum(d)[:-1]
+            delta = jnp.zeros((N,), jnp.float32).at[rec["indices"]].set(
+                delta_phys, mode="drop")
+            new_scores = scores + delta * shrink
+            small = {k: v for k, v in rec.items()
+                     if k.startswith(("node_", "leaf_")) or k in
+                     ("s", "feat_used")}
+            small["leaf_delta"] = rec["leaf_value"] * shrink
+            return new_scores, small
+
+        self._fused = jax.jit(step, donate_argnums=(1,))
+
+    def _train_one_iter_fused(self) -> bool:
+        """Fast path: the whole iteration in one device program.
+
+        Host round-trips are the per-iteration floor on remote-attached
+        TPUs, so the small tree record is copied to the host ASYNCHRONOUSLY
+        and materialized with a one-iteration lag (its transfer overlaps the
+        next iteration's device compute).  Consumers of `models` call
+        `_flush_pending()` first."""
+        from ..utils.timer import global_timer
+        feature_mask = self._feature_mask(self.iter)
+        if self._cegb_feat_used is not None:
+            feat_used = self._cegb_feat_used
+        else:
+            if not hasattr(self, "_zeros_fused"):
+                self._zeros_fused = jnp.zeros((self.learner.F,), dtype=bool)
+            feat_used = self._zeros_fused
+        with global_timer.section("GBDT::FusedIter",
+                                  sync=lambda: self.scores):
+            self.scores, rec = self._fused(
+                self.learner._part0, self.scores, feature_mask,
+                self.iter + 1, feat_used)
+        if self.learner.has_cegb:
+            self._cegb_feat_used = rec["feat_used"]
+        small = {k: v for k, v in rec.items()
+                 if k.startswith(("node_", "leaf_")) or k == "s"}
+        for v in small.values():
+            try:
+                v.copy_to_host_async()
+            except Exception:
+                break
+        self._pending_recs.append(small)
+        self.iter += 1
+        # with validation sets the record is needed NOW (scores update per
+        # iteration); otherwise lag by one to hide the transfer latency
+        lag = 0 if self.valid_sets else 1
+        should_stop = False
+        while len(self._pending_recs) > lag:
+            if self._materialize_pending():
+                should_stop = True
+                # the lagged extra iteration(s) past the stop produced only
+                # duplicate stub trees: drop them and roll the counter back
+                self.iter -= len(self._pending_recs)
+                self._pending_recs.clear()
+        if should_stop:
+            log.warning("Stopped training because there are no more leaves "
+                        "that meet the split requirements")
+        return should_stop
+
+    def _materialize_pending(self) -> bool:
+        """Convert the oldest pending device record into a host tree."""
+        small = self._pending_recs.pop(0)
+        host_record = jax.device_get(small)
+        num_nodes = int(host_record["s"])
+        nodes = self.learner.node_arrays_for_predict(small)
+        delta_leaf = small["leaf_delta"]
+        for vi, (vd, metrics, binned) in enumerate(self.valid_sets):
+            leaf_v = predict_leaf_binned(binned, nodes)
+            self.valid_scores[vi] = self.valid_scores[vi] + \
+                jnp.take(delta_leaf, leaf_v)
+        tree = tree_from_device_record(
+            host_record, num_nodes, self.train_data.bin_mappers,
+            None, shrinkage=self.shrinkage_rate)
+        K = self.num_tree_per_iteration
+        if (len(self.models) < K and abs(self.init_scores[0]) > K_EPSILON):
+            if num_nodes > 0:
+                tree.leaf_value = tree.leaf_value + self.init_scores[0]
+                tree.internal_value = tree.internal_value + self.init_scores[0]
+            else:
+                tree.leaf_value = np.asarray([self.init_scores[0]])
+        self.models.append(tree)
+        self.device_trees.append({"nodes": nodes, "leaf_value": delta_leaf})
+        return num_nodes == 0
+
+    def _flush_pending(self) -> None:
+        """Materialize all lagged fused-iteration records (no-op usually)."""
+        while getattr(self, "_pending_recs", None):
+            if self._materialize_pending():
+                self.iter -= len(self._pending_recs)
+                self._pending_recs.clear()
 
     # ------------------------------------------------------------------
     def add_valid_data(self, valid_data: BinnedDataset) -> None:
@@ -215,7 +345,9 @@ class GBDT:
         frac = float(self.config.feature_fraction)
         F = self.learner.F
         if frac >= 1.0 or F <= 1:
-            return jnp.ones((F,), dtype=bool)
+            if not hasattr(self, "_ones_fmask"):
+                self._ones_fmask = jnp.ones((F,), dtype=bool)
+            return self._ones_fmask
         k = max(int(F * frac), 1)
         self.feat_rng, sub = jax.random.split(self.feat_rng)
         perm = jax.random.permutation(sub, F)
@@ -385,6 +517,8 @@ class GBDT:
         Returns True when training should stop (no further splits possible).
         """
         from ..utils.timer import global_timer
+        if grad is None and hess is None and self._fused is not None:
+            return self._train_one_iter_fused()
         if grad is None or hess is None:
             with global_timer.section("GBDT::Boosting (gradients)"):
                 grad, hess = self._compute_gradients()
@@ -594,6 +728,7 @@ class GBDT:
 
     # ------------------------------------------------------------------
     def num_trees(self) -> int:
+        self._flush_pending()
         return len(self.models)
 
     @property
@@ -613,6 +748,7 @@ class GBDT:
         ``pred_early_stop_freq`` iterations (reference:
         prediction_early_stop.cpp CreatePredictionEarlyStopInstance —
         |score| for binary, top1-top2 gap for multiclass)."""
+        self._flush_pending()
         data = np.asarray(data, dtype=np.float64)
         n = data.shape[0]
         K = self.num_tree_per_iteration
@@ -662,6 +798,7 @@ class GBDT:
         return np.asarray(conv)
 
     def predict_leaf_index(self, data: np.ndarray) -> np.ndarray:
+        self._flush_pending()
         data = np.asarray(data, dtype=np.float64)
         out = np.zeros((data.shape[0], len(self.models)), dtype=np.int32)
         for t, tree in enumerate(self.models):
@@ -670,6 +807,7 @@ class GBDT:
 
     def rollback_one_iter(self) -> None:
         """reference: gbdt.cpp RollbackOneIter:443."""
+        self._flush_pending()
         if self.iter <= 0:
             return
         K = self.num_tree_per_iteration
@@ -718,11 +856,15 @@ class DART(GBDT):
             log.fatal("Cannot use linear tree with DART boosting "
                       "(reference: config.cpp linear_tree checks)")
         super().__init__(config, train_data, objective)
+        # DART's drop/normalize bookkeeping needs each tree materialized
+        # IMMEDIATELY after its iteration; the fused path's lag breaks that
+        self._fused = None
         self.drop_rng = np.random.RandomState(config.drop_seed)
         self.tree_weights: List[float] = []  # per model tree
 
     def train_one_iter(self, grad=None, hess=None) -> bool:
         # select trees to drop (reference: dart.hpp DroppingTrees:97)
+        self._flush_pending()
         cfg = self.config
         K = self.num_tree_per_iteration
         n_iters = len(self.models) // K
@@ -799,6 +941,9 @@ class RF(GBDT):
                           "(bagging_freq > 0 and bagging_fraction < 1) or "
                           "feature_fraction < 1")
         super().__init__(config, train_data, objective)
+        # the fused fast path captures GBDT gradient/shrinkage semantics at
+        # trace time; RF overrides both (fixed-score gradients, shrinkage 1)
+        self._fused = None
         self.average_output = True
         self.shrinkage_rate = 1.0
         # gradients are always taken at the init score

@@ -50,6 +50,33 @@ from ..utils import log
 
 NEG_INF = float("-inf")
 
+# ---------------------------------------------------------------------------
+# Packed while-loop state: per-leaf scalars live as rows of one (NLF, L) f32
+# matrix and per-node scalars as rows of one (NND, nodes) f32 matrix (ints
+# bitcast into the f32 container).  A split then updates TWO columns of each
+# matrix instead of ~45 separate arrays — on TPU the per-op overhead of the
+# many tiny dynamic-updates dominated the whole tree build.
+# ---------------------------------------------------------------------------
+(LM_START, LM_CNT, LM_CNT_G, LM_SUM_G, LM_SUM_H, LM_DEPTH, LM_CMIN, LM_CMAX,
+ LM_VALUE, LM_PARENT, LM_PSIDE, LM_BGAIN, LM_BFEAT, LM_BTHR, LM_BDL,
+ LM_BLCNT, LM_BRCNT, LM_BLSG, LM_BLSH, LM_BRSG, LM_BRSH, LM_BLOUT,
+ LM_BROUT, LM_BISCAT, LM_FORCED) = range(25)
+NLF = 25
+
+(ND_FEATURE, ND_FEATURE_ENUM, ND_THRESHOLD, ND_DL, ND_GAIN, ND_LEFT,
+ ND_RIGHT, ND_IVALUE, ND_IWEIGHT, ND_ICOUNT, ND_COL, ND_BIN_START,
+ ND_IS_BUNDLED, ND_NUM_BIN, ND_DEFAULT_BIN, ND_MISSING, ND_IS_CAT) = range(17)
+NND = 17
+
+
+def _i2f(x):
+    return jax.lax.bitcast_convert_type(
+        jnp.asarray(x, jnp.int32), jnp.float32)
+
+
+def _f2i(x):
+    return jax.lax.bitcast_convert_type(x, jnp.int32)
+
 
 def parse_monotone_constraints(spec, num_total_features: int) -> np.ndarray:
     """Parse the `monotone_constraints` param ("1,-1,0" / list) into a
@@ -313,12 +340,12 @@ class SerialTreeLearner:
         self._build = jax.jit(self._build_impl)
 
     # ------------------------------------------------------------------
-    def _hist_leaf(self, part_bins, grad_p, hess_p, start, cnt):
+    def _hist_leaf(self, part_bins, part_ghi, start, cnt):
         if self._use_pallas:
-            return leaf_hist_pallas(part_bins, grad_p, hess_p, start, cnt,
-                                    num_bins=self.B,
+            return leaf_hist_pallas(part_bins, part_ghi[:, 0], part_ghi[:, 1],
+                                    start, cnt, num_bins=self.B,
                                     row_chunk=self.row_chunk)
-        return leaf_hist_slice(part_bins, grad_p, hess_p, start, cnt,
+        return leaf_hist_slice(part_bins, part_ghi, start, cnt,
                                num_bins=self.B, row_chunk=self.row_chunk,
                                vary=self._pvary)
 
@@ -349,27 +376,30 @@ class SerialTreeLearner:
 
         TPUs scatter into HBM one element at a time (scalar-core DMA), so the
         global scatter a literal CUDA port would use is off the table.
-        Instead each fixed-size chunk is compacted LOCALLY (VMEM-sized
-        argsort/permute into [lefts | pad | rights]) and the compacted block
-        is written with two contiguous read-blend-write window updates —
-        lefts packed forward from ``start`` at running offset ``nl``, rights
-        packed backward from ``start + cnt``.  All HBM traffic is bulk DMA.
-        A second pass copies the scratch range back.  This replaces the CUDA
-        bitvector + AggregateBlockOffset + SplitInner kernels
-        (cuda_data_partition.cu:288-907).
+        Each fixed-size chunk is compacted LOCALLY (VMEM-sized argsort /
+        permute) and written with contiguous full-window updates.  This
+        replaces the CUDA bitvector + AggregateBlockOffset + SplitInner
+        kernels (cuda_data_partition.cu:288-907).
+
+        No window is ever masked against its DESTINATION: a read-modify-
+        write fusion on a loop-carried buffer defeats XLA's in-place
+        aliasing and forces a full copy of that buffer every while-loop
+        iteration (measured as ~half the tree-build time).  Instead lefts
+        and rights are forward-packed UNMASKED into their own scratch
+        regions (each window's garbage tail is overwritten by the next
+        window), boundary slivers of untouched rows are pre-copied into the
+        scratches, and the copy-back composes every destination window
+        purely from the two scratches.
         """
         C = self.row_chunk
         G = self.G
-        n_chunks = (cnt + C - 1) // C
         part_bins = st["part_bins"]
-        # grad/hess/rowid travel as one (N_pad, 3) f32 matrix so the per-chunk
-        # permute is a 2-D row gather (1-D gathers serialize on TPU); rowid is
-        # bitcast to f32 — no arithmetic ever touches it, so bits survive.
-        def pack3(g, h, i):
-            return jnp.stack(
-                [g, h, jax.lax.bitcast_convert_type(i, jnp.float32)], axis=1)
-
-        part_ghi = pack3(st["part_grad"], st["part_hess"], st["indices"])
+        # grad/hess/rowid live PERMANENTLY as one (N_pad, 3) f32 matrix
+        # (rowid bitcast to f32) so the per-chunk permute is a 2-D row gather
+        # (1-D gathers serialize on TPU) and no per-split pack/unpack of the
+        # full row payload is materialized.
+        part_ghi = st["part_ghi"]
+        n_chunks = (cnt + C - 1) // C
 
         def blend(dst, val, off, mask):
             win = jax.lax.dynamic_slice(dst, (off, 0), val.shape)
@@ -428,9 +458,7 @@ class SerialTreeLearner:
             0, n_chunks, copyback, self._pvary((part_bins, part_ghi)))
         moved = {
             "part_bins": part_bins,
-            "part_grad": part_ghi[:, 0],
-            "part_hess": part_ghi[:, 1],
-            "indices": jax.lax.bitcast_convert_type(part_ghi[:, 2], jnp.int32),
+            "part_ghi": part_ghi,
             "sc_bins": sb,
             "sc_ghi": sg,
         }
@@ -698,8 +726,11 @@ class SerialTreeLearner:
         feat_used0 = (jnp.zeros((F,), jnp.bool_) if feat_used_init is None
                       else feat_used_init)
 
+        part_ghi0 = jnp.stack(
+            [grad_p, hess_p,
+             jax.lax.bitcast_convert_type(rowid, jnp.float32)], axis=1)
         root_hist = self._psum(self._hist_leaf(
-            part_bins, grad_p, hess_p, jnp.int32(self.row0), jnp.int32(self.N)))
+            part_bins, part_ghi0, jnp.int32(self.row0), jnp.int32(self.N)))
         bag_cnt_g = self._psum_scalar(bag_cnt)
         # in voting mode root_hist stays LOCAL; the leaf totals are global
         sum_g = self._psum_scalar(root_hist[0, :, 0].sum()) \
@@ -712,74 +743,52 @@ class SerialTreeLearner:
             root_hist, sum_g, sum_h, bag_cnt_g, bag_cnt, jnp.int32(0),
             neg_inf, pos_inf, jnp.float32(0.0), root_mask, feat_used0))
 
-        def arr(val, dtype=jnp.float32):
-            return jnp.full((L,), val, dtype=dtype)
+        # one TRASH slot is appended to every leaf/node-indexed buffer:
+        # iterations whose split is invalid (stop, or an abandoned forced
+        # split) still execute the body but write to the trash column, so the
+        # while body needs NO lax.cond — conditionals force whole-state
+        # copies of the multi-MB row buffers every iteration (measured ~60%
+        # of the tree build).
+        root_forced = jnp.int32(0 if self.forced is not None else -1)
+        col0 = jnp.stack([
+            _i2f(self.row0), _i2f(self.N), _i2f(bag_cnt_g),
+            sum_g, sum_h, _i2f(0),
+            jnp.float32(-jnp.inf), jnp.float32(jnp.inf),
+            jnp.float32(0.0), _i2f(-1), _i2f(0),
+            best0.gain, _i2f(best0.feature), _i2f(best0.threshold),
+            best0.default_left.astype(jnp.float32),
+            _i2f(best0.left_count), _i2f(best0.right_count),
+            best0.left_sum_g, best0.left_sum_h,
+            best0.right_sum_g, best0.right_sum_h,
+            best0.left_output, best0.right_output,
+            best0.is_cat.astype(jnp.float32), _i2f(root_forced)])
+        leafmat = jnp.zeros((NLF, L + 1), jnp.float32) \
+            .at[LM_BGAIN].set(jnp.float32(NEG_INF)) \
+            .at[LM_CMIN].set(jnp.float32(-jnp.inf)) \
+            .at[LM_CMAX].set(jnp.float32(jnp.inf)) \
+            .at[LM_PARENT].set(_i2f(jnp.full((L + 1,), -1, jnp.int32))) \
+            .at[LM_FORCED].set(_i2f(jnp.full((L + 1,), -1, jnp.int32))) \
+            .at[:, 0].set(col0)
 
         state = {
             "s": jnp.int32(0),
             "done": jnp.bool_(False),
-            "indices": rowid,
             "part_bins": part_bins,
-            "part_grad": grad_p,
-            "part_hess": hess_p,
+            "part_ghi": part_ghi0,
             "sc_bins": jnp.zeros_like(part_bins),
             "sc_ghi": jnp.zeros((part_bins.shape[0], 3), jnp.float32),
-            "hist": jnp.zeros((L, G, B, 2), dtype=jnp.float32).at[0].set(root_hist),
-            "leaf_start": arr(0, jnp.int32).at[0].set(self.row0),
-            "leaf_cnt": arr(0, jnp.int32).at[0].set(self.N),
-            "leaf_cnt_g": arr(0, jnp.int32).at[0].set(bag_cnt_g),
-            "leaf_sum_g": arr(0.0).at[0].set(sum_g),
-            "leaf_sum_h": arr(0.0).at[0].set(sum_h),
-            "leaf_depth": arr(0, jnp.int32),
-            "leaf_cmin": arr(-jnp.inf),
-            "leaf_cmax": arr(jnp.inf),
+            "hist": jnp.zeros((L + 1, G, B, 2),
+                              dtype=jnp.float32).at[0].set(root_hist),
+            "leafmat": leafmat,
+            "nodemat": jnp.zeros((NND, nodes + 1), jnp.float32),
             "feat_used": feat_used0,
-            "leaf_value": arr(0.0),
-            "leaf_parent_node": arr(-1, jnp.int32),
-            "leaf_parent_side": arr(0, jnp.int32),
-            # per-leaf cached best split
-            "best_gain": arr(NEG_INF).at[0].set(best0.gain),
-            "best_feature": arr(0, jnp.int32).at[0].set(best0.feature),
-            "best_threshold": arr(0, jnp.int32).at[0].set(best0.threshold),
-            "best_dl": arr(False, jnp.bool_).at[0].set(best0.default_left),
-            "best_lcnt": arr(0, jnp.int32).at[0].set(best0.left_count),
-            "best_rcnt": arr(0, jnp.int32).at[0].set(best0.right_count),
-            "best_lsg": arr(0.0).at[0].set(best0.left_sum_g),
-            "best_lsh": arr(0.0).at[0].set(best0.left_sum_h),
-            "best_rsg": arr(0.0).at[0].set(best0.right_sum_g),
-            "best_rsh": arr(0.0).at[0].set(best0.right_sum_h),
-            "best_lout": arr(0.0).at[0].set(best0.left_output),
-            "best_rout": arr(0.0).at[0].set(best0.right_output),
-            "best_is_cat": arr(False, jnp.bool_).at[0].set(best0.is_cat),
-            "best_cat_set": jnp.zeros((L, self.BF), jnp.bool_).at[0].set(
+            "best_cat_set": jnp.zeros((L + 1, self.BF), jnp.bool_).at[0].set(
                 best0.cat_set),
-            # node (internal) arrays
-            "node_feature": jnp.zeros((nodes,), jnp.int32),
-            "node_feature_enum": jnp.zeros((nodes,), jnp.int32),
-            "node_threshold": jnp.zeros((nodes,), jnp.int32),
-            "node_default_left": jnp.zeros((nodes,), jnp.bool_),
-            "node_gain": jnp.zeros((nodes,), jnp.float32),
-            "node_left": jnp.zeros((nodes,), jnp.int32),
-            "node_right": jnp.zeros((nodes,), jnp.int32),
-            "node_internal_value": jnp.zeros((nodes,), jnp.float32),
-            "node_internal_weight": jnp.zeros((nodes,), jnp.float32),
-            "node_internal_count": jnp.zeros((nodes,), jnp.int32),
-            # traversal metadata per node
-            "node_col": jnp.zeros((nodes,), jnp.int32),
-            "node_bin_start": jnp.zeros((nodes,), jnp.int32),
-            "node_is_bundled": jnp.zeros((nodes,), jnp.int32),
-            "node_num_bin": jnp.zeros((nodes,), jnp.int32),
-            "node_default_bin": jnp.zeros((nodes,), jnp.int32),
-            "node_missing_type": jnp.zeros((nodes,), jnp.int32),
-            "node_is_cat": jnp.zeros((nodes,), jnp.bool_),
-            "node_cat_set": jnp.zeros((nodes, self.BF), jnp.bool_),
+            "node_cat_set": jnp.zeros((nodes + 1, self.BF), jnp.bool_),
         }
 
         if self.ic_masks is not None:
-            state["leaf_used"] = jnp.zeros((L, F), jnp.bool_)
-        if self.forced is not None:
-            # leaf -> pending forced-node id (-1 none); root starts forced
-            state["leaf_forced"] = jnp.full((L,), -1, jnp.int32).at[0].set(0)
+            state["leaf_used"] = jnp.zeros((L + 1, F), jnp.bool_)
 
         # uniform vma typing under shard_map: mark the whole state varying
         state = self._pvary(state)
@@ -788,43 +797,58 @@ class SerialTreeLearner:
             return (st["s"] < nodes) & (~st["done"])
 
         def body(st):
-            best_leaf = jnp.argmax(st["best_gain"]).astype(jnp.int32)
-            gain = st["best_gain"][best_leaf]
+            lm = st["leafmat"]
+            bgain_row = lm[LM_BGAIN, :L]
+            best_leaf = jnp.argmax(bgain_row).astype(jnp.int32)
+            gain = bgain_row[best_leaf]
 
             # forced splits take precedence over the free search
             # (reference: ForceSplits, serial_tree_learner.cpp:614)
             forced_ok = jnp.bool_(False)
+            skip_pending = jnp.bool_(False)
             forced_node = jnp.int32(0)
             forced_info = None
             if self.forced is not None:
-                fids = st["leaf_forced"]
+                fids = _f2i(lm[LM_FORCED, :L])
                 f_leaf = jnp.argmax(fids >= 0).astype(jnp.int32)
                 has_f = jnp.any(fids >= 0)
                 forced_node = jnp.maximum(fids[f_leaf], 0)
+                fcol = jax.lax.dynamic_slice(
+                    lm, (0, f_leaf), (NLF, 1))[:, 0]
                 forced_info = self._forced_split_info(
                     st["hist"][f_leaf], self.forced["feature"][forced_node],
                     self.forced["bin"][forced_node],
-                    st["leaf_sum_g"][f_leaf], st["leaf_sum_h"][f_leaf],
-                    st["leaf_cnt_g"][f_leaf])
+                    fcol[LM_SUM_G], fcol[LM_SUM_H], _f2i(fcol[LM_CNT_G]))
                 depth_ok = (self.max_depth <= 0) | \
-                    (st["leaf_depth"][f_leaf] < self.max_depth)
+                    (_f2i(fcol[LM_DEPTH]) < self.max_depth)
                 forced_ok = has_f & forced_info["valid"] & depth_ok
-                # a failed forced split is abandoned; free search resumes
-                st = {**st, "leaf_forced": jnp.where(
-                    has_f & ~forced_ok, fids.at[f_leaf].set(-1), fids)}
+                # a failed forced split is abandoned WITHOUT consuming a
+                # split step; free search resumes next iteration
+                skip_pending = has_f & ~forced_ok
+                st = {**st, "leafmat": jnp.where(
+                    skip_pending,
+                    lm.at[LM_FORCED, f_leaf].set(_i2f(-1)), lm)}
+                lm = st["leafmat"]
                 best_leaf = jnp.where(forced_ok, f_leaf, best_leaf)
                 gain = jnp.where(forced_ok, forced_info["gain"], gain)
 
-            def no_split(st):
-                return self._pvary({**st, "done": jnp.bool_(True)})
+            # an invalid iteration still runs the body but writes to the
+            # TRASH slots and processes 0 rows — no lax.cond, no copies
+            valid = forced_ok | ((gain > 0) & ~skip_pending)
 
-            def do_split(st):
+            # one read of the chosen leaf's packed scalars
+            pcol = jax.lax.dynamic_slice(lm, (0, best_leaf), (NLF, 1))[:, 0]
+
+            if True:
                 s = st["s"]
                 new_leaf = s + 1
-                f_enum = st["best_feature"][best_leaf]
-                thr = st["best_threshold"][best_leaf]
-                dl = st["best_dl"][best_leaf]
-                is_cat = st["best_is_cat"][best_leaf]
+                wr_a = jnp.where(valid, best_leaf, jnp.int32(L))
+                wr_b = jnp.where(valid, new_leaf, jnp.int32(L))
+                wr_s = jnp.where(valid, s, jnp.int32(nodes))
+                f_enum = _f2i(pcol[LM_BFEAT])
+                thr = _f2i(pcol[LM_BTHR])
+                dl = pcol[LM_BDL] > 0.5
+                is_cat = pcol[LM_BISCAT] > 0.5
                 cat_set = st["best_cat_set"][best_leaf]
                 if forced_info is not None:
                     f_enum = jnp.where(forced_ok,
@@ -841,9 +865,9 @@ class SerialTreeLearner:
                 nb = self.ctx.num_bin[f_enum]
                 dbin = self.ctx.default_bin[f_enum]
                 mtype = self.ctx.missing_type[f_enum]
-                start = st["leaf_start"][best_leaf]
-                cnt = st["leaf_cnt"][best_leaf]
-                cnt_g = st["leaf_cnt_g"][best_leaf]
+                start = _f2i(pcol[LM_START])
+                cnt = jnp.where(valid, _f2i(pcol[LM_CNT]), 0)
+                cnt_g = _f2i(pcol[LM_CNT_G])
 
                 moved, left_cnt = self._partition_leaf(
                     st, start, cnt, col,
@@ -852,8 +876,8 @@ class SerialTreeLearner:
                 # bag-aware counts come from the (global) histogram estimate
                 # cached with the best split, not from physical range sizes:
                 # out-of-bag rows live in the ranges with zeroed gradients
-                left_cnt_g = st["best_lcnt"][best_leaf]
-                right_cnt_g = st["best_rcnt"][best_leaf]
+                left_cnt_g = _f2i(pcol[LM_BLCNT])
+                right_cnt_g = _f2i(pcol[LM_BRCNT])
                 if forced_info is not None:
                     left_cnt_g = jnp.where(forced_ok, forced_info["lcnt"],
                                            left_cnt_g)
@@ -869,20 +893,21 @@ class SerialTreeLearner:
                 sm_start = jnp.where(small_is_left, l_start, r_start)
                 sm_cnt = jnp.where(small_is_left, left_cnt, right_cnt)
                 hist_small = self._psum(self._hist_leaf(
-                    moved["part_bins"], moved["part_grad"], moved["part_hess"],
+                    moved["part_bins"], moved["part_ghi"],
                     sm_start, sm_cnt))
                 parent_hist = st["hist"][best_leaf]
                 hist_large = parent_hist - hist_small
                 hist_left = jnp.where(small_is_left, hist_small, hist_large)
                 hist_right = jnp.where(small_is_left, hist_large, hist_small)
-                hist = st["hist"].at[best_leaf].set(hist_left).at[new_leaf].set(hist_right)
+                hist = st["hist"].at[wr_a].set(hist_left).at[wr_b].set(
+                    hist_right)
 
-                lsg = st["best_lsg"][best_leaf]
-                lsh = st["best_lsh"][best_leaf]
-                rsg = st["best_rsg"][best_leaf]
-                rsh = st["best_rsh"][best_leaf]
-                lout = st["best_lout"][best_leaf]
-                rout = st["best_rout"][best_leaf]
+                lsg = pcol[LM_BLSG]
+                lsh = pcol[LM_BLSH]
+                rsg = pcol[LM_BRSG]
+                rsh = pcol[LM_BRSH]
+                lout = pcol[LM_BLOUT]
+                rout = pcol[LM_BROUT]
                 if forced_info is not None:
                     lsg = jnp.where(forced_ok, forced_info["lsg"], lsg)
                     lsh = jnp.where(forced_ok, forced_info["lsh"], lsh)
@@ -890,12 +915,12 @@ class SerialTreeLearner:
                     rsh = jnp.where(forced_ok, forced_info["rsh"], rsh)
                     lout = jnp.where(forced_ok, forced_info["lout"], lout)
                     rout = jnp.where(forced_ok, forced_info["rout"], rout)
-                depth_child = st["leaf_depth"][best_leaf] + 1
+                depth_child = _f2i(pcol[LM_DEPTH]) + 1
 
                 # basic-mode monotone bounds for the children (reference:
                 # BasicLeafConstraints::Update, monotone_constraints.hpp:488)
-                p_cmin = st["leaf_cmin"][best_leaf]
-                p_cmax = st["leaf_cmax"][best_leaf]
+                p_cmin = pcol[LM_CMIN]
+                p_cmax = pcol[LM_CMAX]
                 if self.use_mc:
                     mono_f = self.monotone[f_enum]
                     mid = (lout + rout) * 0.5
@@ -914,38 +939,27 @@ class SerialTreeLearner:
 
                 # record the internal node (reference: Tree::Split, tree.cpp)
                 upd = dict(moved)
-                upd.update({
-                    "node_feature": st["node_feature"].at[s].set(
-                        self.ctx.feature_index[f_enum]),
-                    "node_feature_enum": st["node_feature_enum"].at[s].set(f_enum),
-                    "node_threshold": st["node_threshold"].at[s].set(thr),
-                    "node_default_left": st["node_default_left"].at[s].set(dl),
-                    "node_gain": st["node_gain"].at[s].set(gain),
-                    "node_internal_value": st["node_internal_value"].at[s].set(
-                        st["leaf_value"][best_leaf]),
-                    "node_internal_weight": st["node_internal_weight"].at[s].set(
-                        st["leaf_sum_h"][best_leaf]),
-                    "node_internal_count": st["node_internal_count"].at[s].set(cnt_g),
-                    "node_col": st["node_col"].at[s].set(col),
-                    "node_bin_start": st["node_bin_start"].at[s].set(bstart),
-                    "node_is_bundled": st["node_is_bundled"].at[s].set(isb),
-                    "node_num_bin": st["node_num_bin"].at[s].set(nb),
-                    "node_default_bin": st["node_default_bin"].at[s].set(dbin),
-                    "node_missing_type": st["node_missing_type"].at[s].set(mtype),
-                    "node_is_cat": st["node_is_cat"].at[s].set(is_cat),
-                    "node_cat_set": st["node_cat_set"].at[s].set(cat_set),
-                })
-                node_left = st["node_left"].at[s].set(-(best_leaf + 1))
-                node_right = st["node_right"].at[s].set(-(new_leaf + 1))
-                p = st["leaf_parent_node"][best_leaf]
-                side = st["leaf_parent_side"][best_leaf]
-                sp = jnp.maximum(p, 0)
-                node_left = node_left.at[sp].set(
-                    jnp.where((p >= 0) & (side == 0), s, node_left[sp]))
-                node_right = node_right.at[sp].set(
-                    jnp.where((p >= 0) & (side == 1), s, node_right[sp]))
-                upd["node_left"] = node_left
-                upd["node_right"] = node_right
+                upd["node_cat_set"] = st["node_cat_set"].at[wr_s].set(cat_set)
+                ncol = jnp.stack([
+                    _i2f(self.ctx.feature_index[f_enum]), _i2f(f_enum),
+                    _i2f(thr), dl.astype(jnp.float32), gain,
+                    _i2f(-(best_leaf + 1)), _i2f(-(new_leaf + 1)),
+                    pcol[LM_VALUE], pcol[LM_SUM_H], _i2f(cnt_g),
+                    _i2f(col), _i2f(bstart), _i2f(isb), _i2f(nb),
+                    _i2f(dbin), _i2f(mtype), is_cat.astype(jnp.float32)])
+                nm = st["nodemat"].at[:, wr_s].set(ncol)
+                # fix the parent's child pointer (read-modify-write of ONE
+                # nodemat column)
+                p = _f2i(pcol[LM_PARENT])
+                side = _f2i(pcol[LM_PSIDE])
+                sp = jnp.where(valid, jnp.maximum(p, 0), jnp.int32(nodes))
+                par = jax.lax.dynamic_slice(nm, (0, sp), (NND, 1))[:, 0]
+                par = par.at[ND_LEFT].set(jnp.where(
+                    (p >= 0) & (side == 0), _i2f(s), par[ND_LEFT]))
+                par = par.at[ND_RIGHT].set(jnp.where(
+                    (p >= 0) & (side == 1), _i2f(s), par[ND_RIGHT]))
+                nm = nm.at[:, sp].set(par)
+                upd["nodemat"] = nm
 
                 # child best splits (single traced program via vmap over the
                 # stacked pair — halves the while-body program size)
@@ -980,80 +994,103 @@ class SerialTreeLearner:
                 best_l = self._sync_best(jax.tree.map(lambda a: a[0], both))
                 best_r = self._sync_best(jax.tree.map(lambda a: a[1], both))
 
-                def seta(name, vl, vr):
-                    return st[name].at[best_leaf].set(vl).at[new_leaf].set(vr)
+                if self.forced is not None:
+                    forced_l = jnp.where(forced_ok,
+                                         self.forced["left"][forced_node],
+                                         jnp.int32(-1))
+                    forced_r = jnp.where(forced_ok,
+                                         self.forced["right"][forced_node],
+                                         jnp.int32(-1))
+                else:
+                    forced_l = forced_r = jnp.int32(-1)
+
+                def child_col(cstart, ccnt, ccnt_g, csg, csh, cout, cmin_,
+                              cmax_, side, bs, forced_id):
+                    return jnp.stack([
+                        _i2f(cstart), _i2f(ccnt), _i2f(ccnt_g), csg, csh,
+                        _i2f(depth_child), cmin_, cmax_, cout, _i2f(s),
+                        _i2f(side), bs.gain, _i2f(bs.feature),
+                        _i2f(bs.threshold),
+                        bs.default_left.astype(jnp.float32),
+                        _i2f(bs.left_count), _i2f(bs.right_count),
+                        bs.left_sum_g, bs.left_sum_h,
+                        bs.right_sum_g, bs.right_sum_h,
+                        bs.left_output, bs.right_output,
+                        bs.is_cat.astype(jnp.float32), _i2f(forced_id)])
+
+                col_l = child_col(l_start, left_cnt, left_cnt_g, lsg, lsh,
+                                  lout, l_cmin, l_cmax, 0, best_l, forced_l)
+                col_r = child_col(r_start, right_cnt, right_cnt_g, rsg, rsh,
+                                  rout, r_cmin, r_cmax, 1, best_r, forced_r)
+                lm2 = lm.at[:, wr_a].set(col_l).at[:, wr_b].set(col_r)
 
                 upd.update({
-                    "s": s + 1,
-                    "done": st["done"],
+                    "s": s + valid.astype(jnp.int32),
+                    "done": ~valid & ~skip_pending,
                     "hist": hist,
-                    "leaf_start": seta("leaf_start", l_start, r_start),
-                    "leaf_cnt": seta("leaf_cnt", left_cnt, right_cnt),
-                    "leaf_cnt_g": seta("leaf_cnt_g", left_cnt_g, right_cnt_g),
-                    "leaf_sum_g": seta("leaf_sum_g", lsg, rsg),
-                    "leaf_sum_h": seta("leaf_sum_h", lsh, rsh),
-                    "leaf_depth": seta("leaf_depth", depth_child, depth_child),
-                    "leaf_cmin": seta("leaf_cmin", l_cmin, r_cmin),
-                    "leaf_cmax": seta("leaf_cmax", l_cmax, r_cmax),
-                    "feat_used": feat_used_new,
-                    "leaf_value": seta("leaf_value", lout, rout),
-                    "leaf_parent_node": seta("leaf_parent_node", s, s),
-                    "leaf_parent_side": seta("leaf_parent_side", 0, 1),
+                    "leafmat": lm2,
+                    "feat_used": jnp.where(valid, feat_used_new,
+                                           st["feat_used"]),
                     **({"leaf_used": st["leaf_used"]
-                        .at[best_leaf].set(used_child)
-                        .at[new_leaf].set(used_child)}
+                        .at[wr_a].set(used_child)
+                        .at[wr_b].set(used_child)}
                        if self.ic_masks is not None else {}),
-                    **({"leaf_forced": st["leaf_forced"]
-                        .at[best_leaf].set(jnp.where(
-                            forced_ok, self.forced["left"][forced_node],
-                            jnp.int32(-1)))
-                        .at[new_leaf].set(jnp.where(
-                            forced_ok, self.forced["right"][forced_node],
-                            jnp.int32(-1)))}
-                       if self.forced is not None else {}),
-                    "best_gain": seta("best_gain", best_l.gain, best_r.gain),
-                    "best_feature": seta("best_feature", best_l.feature, best_r.feature),
-                    "best_threshold": seta("best_threshold", best_l.threshold,
-                                           best_r.threshold),
-                    "best_dl": seta("best_dl", best_l.default_left,
-                                    best_r.default_left),
-                    "best_lcnt": seta("best_lcnt", best_l.left_count,
-                                      best_r.left_count),
-                    "best_rcnt": seta("best_rcnt", best_l.right_count,
-                                      best_r.right_count),
-                    "best_lsg": seta("best_lsg", best_l.left_sum_g, best_r.left_sum_g),
-                    "best_lsh": seta("best_lsh", best_l.left_sum_h, best_r.left_sum_h),
-                    "best_rsg": seta("best_rsg", best_l.right_sum_g, best_r.right_sum_g),
-                    "best_rsh": seta("best_rsh", best_l.right_sum_h, best_r.right_sum_h),
-                    "best_lout": seta("best_lout", best_l.left_output, best_r.left_output),
-                    "best_rout": seta("best_rout", best_l.right_output, best_r.right_output),
-                    "best_is_cat": seta("best_is_cat", best_l.is_cat,
-                                        best_r.is_cat),
-                    "best_cat_set": seta("best_cat_set", best_l.cat_set,
-                                         best_r.cat_set),
+                    "best_cat_set": st["best_cat_set"]
+                    .at[wr_a].set(best_l.cat_set)
+                    .at[wr_b].set(best_r.cat_set),
                 })
                 return self._pvary(upd)
 
-            if self.forced is not None:
-                # an invalid pending forced split is abandoned WITHOUT
-                # consuming a split step, so remaining forced leaves are
-                # still tried before any free search (reference applies all
-                # forced splits first, serial_tree_learner.cpp:210)
-                skip_pending = has_f & ~forced_ok
-
-                def not_split(st2):
-                    return jax.lax.cond(skip_pending, lambda s2: s2,
-                                        no_split, st2)
-
-                return jax.lax.cond(
-                    forced_ok | ((gain > 0) & ~skip_pending),
-                    do_split, not_split, st)
-            return jax.lax.cond(gain > 0, do_split, no_split, st)
-
         if self.F == 0:   # no splittable features: the root is the only leaf
-            return state
+            return self._unpack_state(state)
         final = jax.lax.while_loop(cond, body, state)
-        return final
+        return self._unpack_state(final)
+
+    def _unpack_state(self, st: Dict[str, Any]) -> Dict[str, Any]:
+        """Expand the packed leaf/node matrices back into the per-field
+        record the rest of the framework consumes (runs ONCE per tree,
+        outside the while loop)."""
+        L = self.L
+        nodes = self.max_splits
+        lm = st["leafmat"][:, :L]         # drop the trash slots
+        nm = st["nodemat"][:, :nodes]
+        rec = {k: v for k, v in st.items()
+               if k not in ("leafmat", "nodemat")}
+        rec["best_cat_set"] = st["best_cat_set"][:L]
+        rec["node_cat_set"] = st["node_cat_set"][:nodes]
+        rec["hist"] = st["hist"][:L]
+        rec["indices"] = _f2i(st["part_ghi"][:, 2])
+        rec["part_grad"] = st["part_ghi"][:, 0]
+        rec["part_hess"] = st["part_ghi"][:, 1]
+
+        def li(r):
+            return _f2i(lm[r])
+
+        def ni(r):
+            return _f2i(nm[r])
+
+        rec.update({
+            "leaf_start": li(LM_START), "leaf_cnt": li(LM_CNT),
+            "leaf_cnt_g": li(LM_CNT_G), "leaf_sum_g": lm[LM_SUM_G],
+            "leaf_sum_h": lm[LM_SUM_H], "leaf_depth": li(LM_DEPTH),
+            "leaf_value": lm[LM_VALUE], "best_gain": lm[LM_BGAIN],
+            "node_feature": ni(ND_FEATURE),
+            "node_feature_enum": ni(ND_FEATURE_ENUM),
+            "node_threshold": ni(ND_THRESHOLD),
+            "node_default_left": nm[ND_DL] > 0.5,
+            "node_gain": nm[ND_GAIN],
+            "node_left": ni(ND_LEFT), "node_right": ni(ND_RIGHT),
+            "node_internal_value": nm[ND_IVALUE],
+            "node_internal_weight": nm[ND_IWEIGHT],
+            "node_internal_count": ni(ND_ICOUNT),
+            "node_col": ni(ND_COL), "node_bin_start": ni(ND_BIN_START),
+            "node_is_bundled": ni(ND_IS_BUNDLED),
+            "node_num_bin": ni(ND_NUM_BIN),
+            "node_default_bin": ni(ND_DEFAULT_BIN),
+            "node_missing_type": ni(ND_MISSING),
+            "node_is_cat": nm[ND_IS_CAT] > 0.5,
+        })
+        return rec
 
     # ------------------------------------------------------------------
     def _build_impl(self, part_bins0, grad, hess, bag_cnt, feature_mask,

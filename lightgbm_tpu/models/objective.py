@@ -31,6 +31,9 @@ class ObjectiveFunction:
     num_model_per_iteration = 1
     is_constant_hessian = False
     is_renew_tree_output = False
+    # get_gradients is pure jnp and may be traced into a fused training step
+    # (False for objectives with python-level per-iteration state)
+    is_jit_safe = True
 
     def __init__(self, config: Config):
         self.config = config
@@ -551,6 +554,8 @@ class LambdarankNDCG(ObjectiveFunction):
         # position bias state (reference: rank_objective.hpp:43-56)
         self.positions = None
         if metadata.positions is not None:
+            # per-iteration bias updates mutate python state: not fusable
+            self.is_jit_safe = False
             self.positions = jnp.asarray(metadata.positions)
             self.pos_biases = jnp.zeros(len(metadata.position_ids),
                                         dtype=jnp.float32)
@@ -671,6 +676,7 @@ class RankXENDCG(ObjectiveFunction):
     """
 
     name = "rank_xendcg"
+    is_jit_safe = False   # fresh gumbel noise (python-side PRNG state) per iter
 
     def __init__(self, config: Config):
         super().__init__(config)

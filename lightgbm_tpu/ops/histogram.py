@@ -31,55 +31,75 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def leaf_hist_slice(part_bins, grad_p, hess_p, start, cnt, *,
+def leaf_hist_slice(part_bins, part_ghi, start, cnt, *,
                     num_bins: int, row_chunk: int,
                     gblock: int = 0, dtype=jnp.float32, vary=lambda x: x):
     """(G, B, 2) histogram of the contiguous partitioned rows
     [start, start+cnt) of the (N_pad, G) binned matrix with matching
-    (N_pad,) grad/hess; rows beyond ``cnt`` inside the last chunk are
-    masked via zeroed grad/hess.
+    (N_pad, >=2) packed (grad, hess, ...) columns; rows beyond ``cnt``
+    inside the last chunk are masked via zeroed grad/hess.
 
-    The chunk body is a python-unrolled loop over static feature blocks with
-    (C, gblock*B) one-hots sized to stay in VMEM; the only dynamic ops are
-    the row slices.  Layout-changing reshapes happen once, outside the loop.
+    Digit-decomposed one-hot accumulation: onehot_B(x) factors as
+    onehot_hi(x >> 4) (x) onehot_16(x & 15), so the per-chunk histogram is a
+    batched (BH*2, C) @ (C, 16) matmul per feature block — one-hot
+    GENERATION drops from O(C*B) to O(C*(BH+16)) elements per feature,
+    which is what bounds the naive formulation on the VPU (the MXU matmul
+    itself streams at full speed either way).  This is the TPU replacement
+    for the reference's scalar scatter-adds (dense_bin.hpp
+    ConstructHistogram) and CUDA shared-memory atomics
+    (cuda_histogram_constructor.cu).
     """
     Np, G = part_bins.shape
     C = row_chunk
     B = num_bins
+    BH = (B + 15) // 16          # high-digit cardinality
+    Bp = BH * 16
     if gblock <= 0:
-        gblock = max(1, 256 // B)  # keep one-hot ~<=8MB: C * gblock*B * 4
+        # keep the per-block intermediates in VMEM: the low-digit one-hot is
+        # (C, gblock, 16) and the WEIGHTED high-digit buffer is
+        # (C, gblock, 2*BH) — budget both
+        gblock = max(1, (4 * 1024 * 1024) // (C * (16 + 2 * BH) * 4))
     nblk = (G + gblock - 1) // gblock
     Gp = nblk * gblock
     n_chunks = (cnt + C - 1) // C
-    iota_b = jax.lax.broadcasted_iota(jnp.int32, (1, 1, B), 2)
+    iota_hi = jax.lax.broadcasted_iota(jnp.int32, (1, 1, BH), 2)
+    iota_lo = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 16), 2)
 
     def body(ci, accs):
         row0 = start + ci * C
         bins = jax.lax.dynamic_slice(
             part_bins, (row0, 0), (C, G)).astype(jnp.int32)
-        g = jax.lax.dynamic_slice(grad_p, (row0,), (C,))
-        h = jax.lax.dynamic_slice(hess_p, (row0,), (C,))
+        gh3 = jax.lax.dynamic_slice(
+            part_ghi, (row0, 0), (C, part_ghi.shape[1]))
+        g = gh3[:, 0]
+        h = gh3[:, 1]
         if Gp > G:
             bins = jnp.pad(bins, ((0, 0), (0, Gp - G)), constant_values=-1)
         valid = (ci * C + jax.lax.iota(jnp.int32, C)) < cnt
-        gh = jnp.stack([g * valid, h * valid], axis=0).astype(dtype)  # (2, C)
+        gv = (g * valid).astype(dtype)[:, None, None]         # (C, 1, 1)
+        hv = (h * valid).astype(dtype)[:, None, None]
         out = []
         for i in range(nblk):
-            blk = bins[:, i * gblock:(i + 1) * gblock]       # (C, gblock)
-            oh = (blk[:, :, None] == iota_b).astype(dtype)
-            part_h = jax.lax.dot_general(
-                gh, oh.reshape(C, gblock * B),
-                dimension_numbers=(((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)           # (2, gblock*B)
-            out.append(accs[i] + part_h)
+            blk = bins[:, i * gblock:(i + 1) * gblock]        # (C, gblk)
+            hi = blk >> 4
+            lo = blk & 15
+            oh_hi = (hi[:, :, None] == iota_hi).astype(dtype)  # (C, gblk, BH)
+            oh_lo = (lo[:, :, None] == iota_lo).astype(dtype)  # (C, gblk, 16)
+            # weighted high-digit one-hots for (grad, hess) side by side
+            wg = jnp.concatenate([oh_hi * gv, oh_hi * hv], axis=2)
+            part = jax.lax.dot_general(
+                wg, oh_lo,
+                dimension_numbers=(((0,), (0,)), ((1,), (1,))),
+                preferred_element_type=jnp.float32)   # (gblk, 2*BH, 16)
+            out.append(accs[i] + part)
         return tuple(out)
 
-    accs = vary(tuple(jnp.zeros((2, gblock * B), jnp.float32)
+    accs = vary(tuple(jnp.zeros((gblock, 2 * BH, 16), jnp.float32)
                       for _ in range(nblk)))
     accs = jax.lax.fori_loop(0, n_chunks, body, accs)
-    per = jnp.stack(accs)                                    # (nblk, 2, gblock*B)
-    out = jnp.moveaxis(per, 1, 0).reshape(2, Gp, B)
-    return jnp.moveaxis(out[:, :G], 0, 2)                    # (G, B, 2)
+    per = jnp.concatenate(accs, axis=0)                 # (Gp, 2*BH, 16)
+    per = per[:G].reshape(G, 2, Bp)                     # b = hi*16 + lo
+    return jnp.moveaxis(per[:, :, :B], 1, 2)            # (G, B, 2)
 
 
 # ----------------------------------------------------------------------

@@ -1,1 +1,14 @@
-"""Subpackage init."""
+"""Distributed training subpackage: mesh tree builders + multi-host network.
+
+reference analog: src/network/ (collectives + linkers) and the parallel
+tree learners of src/treelearner/parallel_tree_learner.h.
+"""
+
+from .network import (global_array, global_sum, global_sync_by_max,
+                      global_sync_by_mean, global_sync_by_min,
+                      init_network, num_machines, rank)
+from .trainer import ShardedTreeBuilder
+
+__all__ = ["ShardedTreeBuilder", "init_network", "num_machines", "rank",
+           "global_sum", "global_array", "global_sync_by_min",
+           "global_sync_by_max", "global_sync_by_mean"]

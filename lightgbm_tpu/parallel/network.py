@@ -1,0 +1,136 @@
+"""Multi-host network initialization and collectives facade.
+
+TPU-native replacement for the reference's communication backend
+(src/network/: socket TCP mesh / MPI with custom Bruck, recursive-halving
+and ring collectives; include/LightGBM/network.h:89-275 typed helpers;
+`LGBM_NetworkInit` in the C API; application.cpp:171 Network::Init).
+
+On TPU all five collective algorithms collapse into XLA collectives over
+ICI/DCN scheduled by the compiler inside `shard_map`/`pjit`; what remains
+of the reference's Network layer is (a) process-group bootstrap — here
+`jax.distributed.initialize` — and (b) the small set of typed host-level
+reductions used outside the jitted learners (config/seed sync, global
+sums for metrics), provided below over `jax.experimental.multihost_utils`.
+
+The reference's `machines`/`local_listen_port`/`num_machines` parameters
+are accepted and mapped onto the JAX coordinator bootstrap so existing
+configs keep working (rank 0's address becomes the coordinator).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..config import Config
+from ..utils import log
+
+_initialized = False
+
+
+def init_network(machines: Optional[str] = None,
+                 local_listen_port: int = 12400,
+                 num_machines: int = 1,
+                 machine_rank: Optional[int] = None,
+                 time_out: int = 120) -> None:
+    """Initialize multi-host training (reference: Network::Init via
+    `LGBM_NetworkInit`, c_api.cpp; socket mesh construction
+    linkers_socket.cpp:166).
+
+    `machines` is the reference's comma-separated "host:port,host:port,..."
+    list; the FIRST entry is used as the JAX distributed coordinator.  On
+    TPU pods where the runtime already knows the topology, calling with
+    defaults (or not at all) is fine — `jax.distributed.initialize()`
+    auto-detects.
+    """
+    global _initialized
+    if _initialized:
+        return
+    import jax
+    if num_machines <= 1 and not machines:
+        log.info("init_network: single process; nothing to do")
+        _initialized = True
+        return
+    kwargs = {}
+    if machines:
+        hosts = [h.strip() for h in str(machines).split(",") if h.strip()]
+        coordinator = hosts[0]
+        if ":" not in coordinator:
+            coordinator = f"{coordinator}:{local_listen_port}"
+        kwargs["coordinator_address"] = coordinator
+        kwargs["num_processes"] = num_machines if num_machines > 1 \
+            else len(hosts)
+        if machine_rank is not None:
+            kwargs["process_id"] = machine_rank
+    kwargs["initialization_timeout"] = time_out
+    jax.distributed.initialize(**kwargs)
+    _initialized = True
+    log.info("init_network: process %d / %d initialized",
+             jax.process_index(), jax.process_count())
+
+
+def init_from_config(config: Config) -> None:
+    """CLI/application entry (reference: application.cpp:169-179 — network
+    init followed by cross-rank param sync)."""
+    if config.num_machines > 1 or config.machines:
+        init_network(machines=config.machines,
+                     local_listen_port=config.local_listen_port,
+                     num_machines=config.num_machines,
+                     time_out=config.time_out)
+
+
+def num_machines() -> int:
+    import jax
+    return jax.process_count()
+
+
+def rank() -> int:
+    import jax
+    return jax.process_index()
+
+
+# ---------------------------------------------------------------------------
+# Typed host-level reductions (reference: network.h:168-275 GlobalSyncUpBy*)
+# ---------------------------------------------------------------------------
+def _all_reduce(value: np.ndarray, op: str) -> np.ndarray:
+    import jax
+    if jax.process_count() <= 1:
+        return np.asarray(value)
+    from jax.experimental import multihost_utils
+    gathered = multihost_utils.process_allgather(np.asarray(value))
+    if op == "sum":
+        return np.sum(gathered, axis=0)
+    if op == "min":
+        return np.min(gathered, axis=0)
+    if op == "max":
+        return np.max(gathered, axis=0)
+    if op == "mean":
+        return np.mean(gathered, axis=0)
+    raise ValueError(op)
+
+
+def global_sync_by_min(value: float) -> float:
+    return float(_all_reduce(np.asarray(value), "min"))
+
+
+def global_sync_by_max(value: float) -> float:
+    return float(_all_reduce(np.asarray(value), "max"))
+
+
+def global_sync_by_mean(value: float) -> float:
+    return float(_all_reduce(np.asarray(value), "mean"))
+
+
+def global_sum(values: Sequence[float]) -> np.ndarray:
+    return _all_reduce(np.asarray(values, dtype=np.float64), "sum")
+
+
+def global_array(value: float) -> List[float]:
+    """Each rank's value, indexed by rank (reference: Network::GlobalArray)."""
+    import jax
+    if jax.process_count() <= 1:
+        return [float(value)]
+    from jax.experimental import multihost_utils
+    return [float(v) for v in
+            multihost_utils.process_allgather(np.asarray(value))]

@@ -160,3 +160,23 @@ def test_path_smooth_regularizes():
     # smoothing trades a bit of train fit for regularization
     assert mse1 > mse0
     assert mse1 < 0.4 * np.var(y)
+
+
+def test_fused_lag_pipeline_consistency():
+    """Without valid sets the fused path lags tree materialization by one
+    iteration; every model consumer must still see all trees, and stopping
+    at no-more-splits must not duplicate stub trees."""
+    X, y = make_regression(400, 5, seed=11)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                     "min_data_in_leaf": 10, "verbosity": -1}, ds, 12)
+    assert bst.num_trees() == 12
+    assert len(bst.dump_model()["tree_info"]) == 12
+    # exhaustion: tiny data + huge min_data stops early without stub spam
+    Xs, ys = make_regression(40, 3, seed=12)
+    bst2 = lgb.train({"objective": "regression", "num_leaves": 31,
+                      "min_data_in_leaf": 35, "verbosity": -1},
+                     lgb.Dataset(Xs, label=ys), 20)
+    infos = bst2.dump_model()["tree_info"]
+    stubs = sum(1 for t in infos if t["num_leaves"] <= 1)
+    assert stubs <= 1, f"{stubs} stub trees"

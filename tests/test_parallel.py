@@ -105,3 +105,16 @@ def test_voting_parallel_low_top_k_still_learns():
     pred = bst.predict(X)
     mse0 = np.mean((y - y.mean()) ** 2)
     assert np.mean((y - pred) ** 2) < 0.4 * mse0
+
+
+def test_network_module_single_process():
+    """Network facade degrades to no-ops in single-process mode
+    (reference: Network::Init with num_machines=1)."""
+    from lightgbm_tpu.parallel import network
+    network.init_network(num_machines=1)
+    assert network.num_machines() == 1
+    assert network.rank() == 0
+    assert network.global_sync_by_min(3.5) == 3.5
+    assert network.global_sync_by_max(2.0) == 2.0
+    np.testing.assert_allclose(network.global_sum([1.0, 2.0]), [1.0, 2.0])
+    assert network.global_array(7.0) == [7.0]
