@@ -26,7 +26,7 @@ if _os.environ.get("LIGHTGBM_TPU_DISABLE_COMPILE_CACHE", "0") != "1":
     except Exception:  # older jax without these flags
         pass
 
-from .basic import Booster, Dataset, LightGBMError
+from .basic import Booster, Dataset, LightGBMError, Sequence
 from .callback import (EarlyStopException, early_stopping, log_evaluation,
                        record_evaluation, reset_parameter)
 from .engine import CVBooster, cv, train

@@ -22,7 +22,28 @@ from .models.tree import Tree
 from .utils import log
 from .utils.log import LightGBMError
 
-__all__ = ["Dataset", "Booster", "LightGBMError"]
+__all__ = ["Dataset", "Booster", "LightGBMError", "Sequence"]
+
+
+class Sequence:
+    """Generic data access interface for streaming Dataset construction
+    (reference: basic.py Sequence ABC :896).
+
+    Subclass and implement ``__getitem__`` (int -> (F,) row, slice ->
+    (k, F) rows) and ``__len__``; pass one or a list of instances as
+    ``Dataset(data=...)``.  Binning samples individual rows; the binned
+    matrix is then filled chunk-by-chunk of ``batch_size`` rows, so the
+    full raw matrix is never materialized in memory."""
+
+    batch_size = 4096
+
+    def __getitem__(self, idx):
+        raise NotImplementedError("Sequence subclasses must implement "
+                                  "__getitem__")
+
+    def __len__(self):
+        raise NotImplementedError("Sequence subclasses must implement "
+                                  "__len__")
 
 
 def _is_cat_dtype(dt: str) -> bool:
@@ -164,6 +185,32 @@ class Dataset:
             if loaded.feature_names and not isinstance(self.feature_name,
                                                        list):
                 self.feature_name = loaded.feature_names
+        if isinstance(self.data, Sequence) or (
+                isinstance(self.data, (list, tuple)) and self.data
+                and all(isinstance(s, Sequence) for s in self.data)):
+            names = (self.feature_name
+                     if isinstance(self.feature_name, list) else None)
+            cats: List[int] = []
+            if isinstance(self.categorical_feature, (list, tuple)):
+                for c in self.categorical_feature:
+                    if isinstance(c, str) and names and c in names:
+                        cats.append(names.index(c))
+                    elif isinstance(c, int):
+                        cats.append(c)
+            elif cfg.categorical_feature:
+                cats = [int(x) for x in
+                        str(cfg.categorical_feature).split(",")
+                        if x.strip().lstrip("-").isdigit()]
+            ref_inner = None
+            if self.reference is not None:
+                self.reference.construct(extra_params)
+                ref_inner = self.reference._inner
+            self._inner = BinnedDataset.from_sequences(
+                self.data, cfg, label=self.label, weight=self.weight,
+                group=self.group, init_score=self.init_score,
+                feature_names=names, categorical_features=cats,
+                position=self.position, reference=ref_inner)
+            return self
         ref_inner_early = None
         if self.reference is not None:
             self.reference.construct(extra_params)

@@ -174,16 +174,127 @@ class BinnedDataset:
             ds.raw_data = np.ascontiguousarray(data, dtype=np.float32)
         return ds
 
+    @staticmethod
+    def from_sequences(seqs, config: Config, label=None, weight=None,
+                       group=None, init_score=None,
+                       feature_names: Optional[List[str]] = None,
+                       categorical_features: Optional[Sequence[int]] = None,
+                       position=None,
+                       reference: Optional["BinnedDataset"] = None
+                       ) -> "BinnedDataset":
+        """Streaming construction from chunk-accessible sequences
+        (reference: the Sequence ABC path, python-package basic.py:896 +
+        LGBM_DatasetCreateFromSampledColumn/PushRows in c_api.cpp): bin
+        mappers and feature groups are built from a row SAMPLE, then each
+        sequence is binned chunk by chunk — the full raw matrix is never
+        materialized."""
+        if not isinstance(seqs, (list, tuple)):
+            seqs = [seqs]
+        lens = [len(s) for s in seqs]
+        total = int(sum(lens))
+        if total == 0:
+            log.fatal("Cannot construct a Dataset from empty sequences")
+        probe = np.asarray(seqs[0][0:1], dtype=np.float64)
+        F = probe.shape[1]
+        ds = BinnedDataset(config)
+        ds.num_data = total
+        ds.num_total_features = F
+        ds.feature_names = feature_names or [f"Column_{i}" for i in range(F)]
+        ds.metadata = Metadata(total)
+        if label is not None:
+            ds.metadata.set_label(label)
+        ds.metadata.set_weight(weight)
+        ds.metadata.set_group(group)
+        ds.metadata.set_init_score(init_score)
+        ds.metadata.set_position(position)
+
+        if reference is not None:
+            # validation data: reuse the training mappers & grouping so bin
+            # ids live in the SAME space (reference:
+            # LoadFromFileAlignWithOtherDataset, dataset_loader.cpp:299)
+            ds.bin_mappers = reference.bin_mappers
+            ds.used_features = reference.used_features
+            ds.groups = reference.groups
+            ds.feature_names = reference.feature_names
+        else:
+            # sample rows across all sequences for binning; contiguous index
+            # runs are fetched through the slice protocol in blocks so
+            # disk-backed sequences see few large reads, not one per row
+            cfg = config
+            sample_cnt = min(total, cfg.bin_construct_sample_cnt)
+            rng = np.random.RandomState(cfg.data_random_seed)
+            idx = np.sort(rng.choice(total, size=sample_cnt, replace=False)) \
+                if sample_cnt < total else np.arange(total)
+            sample_rows = []
+            offset = 0
+            for s, ln in zip(seqs, lens):
+                sel = idx[(idx >= offset) & (idx < offset + ln)] - offset
+                i = 0
+                while i < len(sel):
+                    j = i
+                    while j + 1 < len(sel) and sel[j + 1] == sel[j] + 1:
+                        j += 1
+                    block = np.asarray(s[int(sel[i]):int(sel[j]) + 1],
+                                       dtype=np.float64)
+                    sample_rows.append(block.reshape(-1, F))
+                    i = j + 1
+                offset += ln
+            sample = np.concatenate(sample_rows, axis=0)
+            ds._construct_mappers_from_sample(sample,
+                                              categorical_features or [])
+            ds._build_groups()
+            # resolve any pending sparse bundling with the SAMPLE columns
+            sample_cols = {f: ds.bin_mappers[f].values_to_bins(sample[:, f])
+                           for f in ds.used_features}
+            ds._finalize_groups(sample_cols)
+
+        # stream: bin each chunk and pack into the preallocated matrix
+        dtype = ds._bin_dtype()
+        out = np.zeros((total, len(ds.groups)), dtype=dtype)
+        raw = (np.zeros((total, F), dtype=np.float32)
+               if config.linear_tree else None)
+        row = 0
+        for s in seqs:
+            bs = getattr(s, "batch_size", 4096) or 4096
+            for startr in range(0, len(s), bs):
+                chunk = np.asarray(s[startr:startr + bs], dtype=np.float64)
+                if chunk.ndim == 1:
+                    chunk = chunk.reshape(1, -1)
+                cols = {f: ds.bin_mappers[f].values_to_bins(chunk[:, f])
+                        for f in ds.used_features}
+                out[row:row + len(chunk)] = ds._pack_groups(
+                    cols, len(chunk)).astype(dtype)
+                if raw is not None:
+                    raw[row:row + len(chunk)] = chunk.astype(np.float32)
+                row += len(chunk)
+        ds.binned = out
+        ds.raw_data = raw
+        return ds
+
+    def _construct_mappers_from_sample(self, sample: np.ndarray,
+                                       categorical_features) -> None:
+        """Build per-feature BinMappers from an already-sampled row matrix
+        (reference: DatasetLoader::ConstructFromSampleData,
+        dataset_loader.cpp:593 — the streaming/in-memory path)."""
+        self._construct_mappers(sample, categorical_features,
+                                _presampled=True)
+
     def _construct_mappers(self, data: np.ndarray,
-                           categorical_features: Sequence[int]) -> None:
+                           categorical_features: Sequence[int],
+                           _presampled: bool = False) -> None:
         cfg = self.config
         n = self.num_data
-        sample_cnt = min(n, cfg.bin_construct_sample_cnt)
-        rng = np.random.RandomState(cfg.data_random_seed)
-        if sample_cnt < n:
-            sample_idx = np.sort(rng.choice(n, size=sample_cnt, replace=False))
+        if _presampled:
+            sample_cnt = len(data)
+            sample_idx = np.arange(sample_cnt)
         else:
-            sample_idx = np.arange(n)
+            sample_cnt = min(n, cfg.bin_construct_sample_cnt)
+            rng = np.random.RandomState(cfg.data_random_seed)
+            if sample_cnt < n:
+                sample_idx = np.sort(
+                    rng.choice(n, size=sample_cnt, replace=False))
+            else:
+                sample_idx = np.arange(n)
         cat_set = set(int(c) for c in categorical_features)
         max_bin_by_feature = None
         if cfg.max_bin_by_feature:
@@ -250,12 +361,10 @@ class BinnedDataset:
         # defer true conflict-graph bundling to _bin_data (needs the columns)
         self._pending_sparse = sparse
 
-    def _bin_data(self, data: np.ndarray) -> None:
-        # bin all used features column-wise first
-        cols: Dict[int, np.ndarray] = {}
-        for f in self.used_features:
-            cols[f] = self.bin_mappers[f].values_to_bins(data[:, f])
-        # finish sparse bundling if pending
+    def _finalize_groups(self, cols: Dict[int, np.ndarray]) -> None:
+        """Resolve pending sparse bundling against binned columns, or fall
+        back to singleton groups (shared by the in-memory and streaming
+        construction paths)."""
         pending = getattr(self, "_pending_sparse", None)
         if pending:
             self._bundle_sparse(pending, cols)
@@ -264,6 +373,13 @@ class BinnedDataset:
             for f in self.used_features:
                 self.groups.append(FeatureGroupInfo(
                     [f], self.bin_mappers[f].num_bin, [0]))
+
+    def _bin_data(self, data: np.ndarray) -> None:
+        # bin all used features column-wise first
+        cols: Dict[int, np.ndarray] = {}
+        for f in self.used_features:
+            cols[f] = self.bin_mappers[f].values_to_bins(data[:, f])
+        self._finalize_groups(cols)
 
         self.binned = self._pack_groups(cols, self.num_data).astype(
             self._bin_dtype())

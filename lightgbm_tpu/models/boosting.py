@@ -519,6 +519,9 @@ class GBDT:
         from ..utils.timer import global_timer
         if grad is None and hess is None and self._fused is not None:
             return self._train_one_iter_fused()
+        # the eager path appends trees directly: any lagged fused records
+        # must land first so model order matches training order
+        self._flush_pending()
         if grad is None or hess is None:
             with global_timer.section("GBDT::Boosting (gradients)"):
                 grad, hess = self._compute_gradients()

@@ -248,3 +248,55 @@ def test_libsvm_qid_group_loading(tmp_path):
     np.testing.assert_array_equal(lf.group, [2, 2, 1])
     assert lf.X.shape == (5, 3)
     assert lf.X[1, 0] == 0.0    # qid never leaks into features
+
+
+class _ChunkSeq(lgb.Sequence):
+    """Test sequence backed by a hidden matrix, chunk-accessible only."""
+
+    batch_size = 128
+
+    def __init__(self, mat):
+        self._m = mat
+
+    def __getitem__(self, idx):
+        return self._m[idx]
+
+    def __len__(self):
+        return len(self._m)
+
+
+def test_sequence_streaming_construction():
+    """Dataset built from Sequences must train identically to the in-memory
+    path (reference: Sequence ABC, basic.py:896)."""
+    rng = np.random.RandomState(21)
+    X = rng.normal(size=(900, 6))
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float64)
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "min_data_in_leaf": 5}
+    seqs = [_ChunkSeq(X[:400]), _ChunkSeq(X[400:])]
+    bst_seq = lgb.train(params, lgb.Dataset(seqs, label=y), 10)
+    bst_mem = lgb.train(params, lgb.Dataset(X, label=y), 10)
+    np.testing.assert_allclose(bst_seq.predict(X), bst_mem.predict(X),
+                               rtol=1e-5)
+
+
+def test_sequence_valid_set_uses_training_mappers():
+    """A valid Dataset built from Sequences with reference= must be binned
+    in the TRAINING bin space (wrong mappers corrupt eval metrics)."""
+    rng = np.random.RandomState(23)
+    X = rng.normal(size=(800, 5))
+    y = (X[:, 0] > 0).astype(np.float64)
+    Xv = rng.normal(size=(300, 5)) * 3.0   # different scale: own mappers differ
+    yv = (Xv[:, 0] > 0).astype(np.float64)
+    ds = lgb.Dataset(_ChunkSeq(X), label=y)
+    vs = lgb.Dataset(_ChunkSeq(Xv), label=yv, reference=ds)
+    evals = {}
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1, "min_data_in_leaf": 5,
+                     "metric": "binary_error"},
+                    ds, 15, valid_sets=[vs], valid_names=["v"],
+                    callbacks=[lgb.record_evaluation(evals)])
+    incr_err = evals["v"]["binary_error"][-1]
+    fresh_err = float(np.mean((bst.predict(Xv) > 0.5) != yv))
+    assert abs(incr_err - fresh_err) < 1e-6
+    assert fresh_err < 0.1
