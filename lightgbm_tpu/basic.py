@@ -9,7 +9,7 @@ ctypes C API.
 from __future__ import annotations
 
 import json
-from typing import Any, Dict, List, Optional, Sequence, Union
+from typing import Any, Dict, List, Optional, Union
 
 import jax.numpy as jnp
 import numpy as np
@@ -112,6 +112,23 @@ def _to_matrix(data, pandas_categorical=None) -> np.ndarray:
     return np.asarray(data, dtype=np.float64)
 
 
+def _resolve_categoricals(categorical_feature, names, cfg) -> List[int]:
+    """Resolve the categorical_feature spec (ints, names, or the config
+    string) to column indices (reference: _LGBMCheckClassificationTargets /
+    categorical handling in basic.py Dataset)."""
+    cats: List[int] = []
+    if isinstance(categorical_feature, (list, tuple)):
+        for c in categorical_feature:
+            if isinstance(c, str) and names and c in names:
+                cats.append(names.index(c))
+            elif isinstance(c, int):
+                cats.append(c)
+    elif cfg.categorical_feature:
+        cats = [int(x) for x in str(cfg.categorical_feature).split(",")
+                if x.strip().lstrip("-").isdigit()]
+    return cats
+
+
 class Dataset:
     """Training data wrapper (reference: basic.py Dataset:1747).
 
@@ -190,17 +207,7 @@ class Dataset:
                 and all(isinstance(s, Sequence) for s in self.data)):
             names = (self.feature_name
                      if isinstance(self.feature_name, list) else None)
-            cats: List[int] = []
-            if isinstance(self.categorical_feature, (list, tuple)):
-                for c in self.categorical_feature:
-                    if isinstance(c, str) and names and c in names:
-                        cats.append(names.index(c))
-                    elif isinstance(c, int):
-                        cats.append(c)
-            elif cfg.categorical_feature:
-                cats = [int(x) for x in
-                        str(cfg.categorical_feature).split(",")
-                        if x.strip().lstrip("-").isdigit()]
+            cats = _resolve_categoricals(self.categorical_feature, names, cfg)
             ref_inner = None
             if self.reference is not None:
                 self.reference.construct(extra_params)
@@ -231,17 +238,11 @@ class Dataset:
             feature_names = list(self.feature_name)
         elif hasattr(self.data, "columns"):
             feature_names = [str(c) for c in self.data.columns]
-        cats: List[int] = []
-        if isinstance(self.categorical_feature, (list, tuple)):
-            for c in self.categorical_feature:
-                if isinstance(c, str) and feature_names and c in feature_names:
-                    cats.append(feature_names.index(c))
-                elif isinstance(c, int):
-                    cats.append(c)
-        elif cfg.categorical_feature:
-            cats = [int(x) for x in str(cfg.categorical_feature).split(",")
-                    if x.strip().lstrip("-").isdigit()]
-        else:
+        cats = _resolve_categoricals(self.categorical_feature,
+                                     feature_names, cfg)
+        if not cats and not isinstance(self.categorical_feature,
+                                       (list, tuple)) \
+                and not cfg.categorical_feature:
             cats = auto_cats   # pandas category dtypes ("auto" mode)
         ref_inner = ref_inner_early
         self._inner = BinnedDataset.from_matrix(

@@ -264,6 +264,15 @@ def model_to_cpp(booster: Booster) -> str:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    # honor an explicit platform pin even when a site plugin force-registers
+    # another backend and overrides the env var during jax init; plugin
+    # platform aliases (e.g. a tunnel) are left for init-time resolution
+    if os.environ.get("JAX_PLATFORMS") in ("cpu", "tpu"):
+        import jax
+        try:
+            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        except Exception:
+            pass
     if argv is None:
         argv = sys.argv[1:]
     app = Application(argv)

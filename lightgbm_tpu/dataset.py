@@ -194,8 +194,9 @@ class BinnedDataset:
         total = int(sum(lens))
         if total == 0:
             log.fatal("Cannot construct a Dataset from empty sequences")
-        probe = np.asarray(seqs[0][0:1], dtype=np.float64)
-        F = probe.shape[1]
+        first_nonempty = next(s for s, ln in zip(seqs, lens) if ln > 0)
+        probe = np.asarray(first_nonempty[0:1], dtype=np.float64)
+        F = probe.reshape(1, -1).shape[1]
         ds = BinnedDataset(config)
         ds.num_data = total
         ds.num_total_features = F
@@ -244,9 +245,14 @@ class BinnedDataset:
                                               categorical_features or [])
             ds._build_groups()
             # resolve any pending sparse bundling with the SAMPLE columns
-            sample_cols = {f: ds.bin_mappers[f].values_to_bins(sample[:, f])
-                           for f in ds.used_features}
-            ds._finalize_groups(sample_cols)
+            # (skip the binning pass entirely when nothing is pending)
+            if getattr(ds, "_pending_sparse", None):
+                sample_cols = {
+                    f: ds.bin_mappers[f].values_to_bins(sample[:, f])
+                    for f in ds.used_features}
+                ds._finalize_groups(sample_cols)
+            else:
+                ds._finalize_groups({})
 
         # stream: bin each chunk and pack into the preallocated matrix
         dtype = ds._bin_dtype()
@@ -410,8 +416,12 @@ class BinnedDataset:
         return out
 
     def _bundle_sparse(self, sparse: List[int], cols: Dict[int, np.ndarray]) -> None:
-        """Greedy conflict-count bundling (reference: dataset.cpp FindGroups)."""
-        n = self.num_data
+        """Greedy conflict-count bundling (reference: dataset.cpp FindGroups).
+
+        ``cols`` may hold fewer rows than the dataset (the streaming path
+        passes SAMPLE columns), so row indices are drawn over the columns'
+        actual length."""
+        n = len(next(iter(cols.values()))) if cols else 0
         max_conflict = int(0.0 * n)  # reference default max_conflict_rate = 0.0
         # sample rows for conflict counting to bound cost
         sample = np.random.RandomState(self.config.data_random_seed).choice(

@@ -306,6 +306,23 @@ class SerialTreeLearner:
         self.N_pad = C + ((self.N + C - 1) // C + 1) * C
         self._use_pallas = (jax.default_backend() == "tpu"
                             and config.tpu_hist_kernel == "pallas")
+        if self._use_pallas:
+            # Mosaic requires lane-aligned tile shapes; probe-compile on the
+            # actual geometry and fall back to the XLA kernel on failure
+            try:
+                bin_dtype = (dataset.binned.dtype
+                             if dataset.binned is not None else jnp.uint8)
+                tiny = jnp.zeros((self.row_chunk * 2, self.G), bin_dtype)
+                ghi0 = jnp.zeros((self.row_chunk * 2, 3), jnp.float32)
+                jax.block_until_ready(leaf_hist_pallas(
+                    tiny, ghi0[:, 0], ghi0[:, 1], jnp.int32(0),
+                    jnp.int32(4), num_bins=self.B,
+                    row_chunk=self.row_chunk))
+            except Exception as exc:
+                log.warning("tpu_hist_kernel=pallas unavailable on this "
+                            "device geometry (%s); using the XLA kernel",
+                            str(exc).split("\n")[0][:120])
+                self._use_pallas = False
 
         # Row layout: the binned matrix (N_pad, G) in its native bin dtype,
         # plus separate (N_pad,) grad/hess/rowid arrays.  The partition moves

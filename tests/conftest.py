@@ -6,8 +6,16 @@ virtual XLA host devices stand in for N TPU chips.
 """
 
 import os
+import tempfile
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Hermetic per-session compilation cache: the machine-shared default cache
+# can contain executables AOT-compiled elsewhere (via the TPU tunnel's
+# compile helper) whose CPU lowering differs from — and in some entries
+# numerically corrupts — locally-compiled code.  A fresh dir keeps every
+# process of this test session (pytest + CLI subprocesses) consistent.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      tempfile.mkdtemp(prefix="jax-cache-tests-"))
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

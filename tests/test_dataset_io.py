@@ -300,3 +300,21 @@ def test_sequence_valid_set_uses_training_mappers():
     fresh_err = float(np.mean((bst.predict(Xv) > 0.5) != yv))
     assert abs(incr_err - fresh_err) < 1e-6
     assert fresh_err < 0.1
+
+
+def test_sequence_streaming_sparse_bundling_large():
+    """Streaming construction with sparse (EFB-bundleable) features and a
+    sample smaller than the dataset must not crash and must match the
+    in-memory path (regression: bundling indexed sample columns with
+    full-dataset row indices)."""
+    rng = np.random.RandomState(31)
+    n = 3000
+    dense = rng.normal(size=(n, 2))
+    sparse = np.where(rng.uniform(size=(n, 4)) < 0.95, 0.0,
+                      np.abs(rng.normal(size=(n, 4))))
+    X = np.column_stack([dense, sparse])
+    y = (X[:, 0] + X[:, 2] > 0.2).astype(np.float64)
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "min_data_in_leaf": 5, "bin_construct_sample_cnt": 500}
+    bst = lgb.train(params, lgb.Dataset(_ChunkSeq(X), label=y), 10)
+    assert np.mean((bst.predict(X) > 0.5) == y) > 0.8
