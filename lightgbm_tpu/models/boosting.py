@@ -128,8 +128,12 @@ class GBDT:
         self.need_bagging = (not self.goss and cfg.bagging_freq > 0
                              and cfg.bagging_fraction < 1.0)
         self._cached_bag = None
-        self.train_binned = self.learner._part0[
-            self.learner.row0: self.learner.row0 + self.num_data]
+        binned_host = train_data.binned
+        if binned_host is None or binned_host.shape[1] < self.learner.G:
+            self.train_binned = self.learner._part0[
+                :, self.learner.row0: self.learner.row0 + self.num_data].T
+        else:
+            self.train_binned = jnp.asarray(binned_host)
 
         self._traverse_train = jax.jit(
             lambda nodes, binned: predict_leaf_binned(binned, nodes))

@@ -298,6 +298,11 @@ class SerialTreeLearner:
             self.N = local_num_data
         self.row_chunk = min(int(config.tpu_row_chunk),
                              max(_pow2ceil(self.N), 256))
+        if self.row_chunk & (self.row_chunk - 1):
+            self.row_chunk = _pow2ceil(self.row_chunk)
+        # the partition packs (dest << bits) | src into one uint32 sort key
+        self.row_chunk = min(self.row_chunk, 1 << 15)
+        self._chunk_bits = self.row_chunk.bit_length() - 1
         C = self.row_chunk
         # layout: [C front-pad rows][N data rows][>=C tail-pad rows]; the
         # front pad keeps the right-aligned partition windows non-negative,
@@ -312,10 +317,10 @@ class SerialTreeLearner:
             try:
                 bin_dtype = (dataset.binned.dtype
                              if dataset.binned is not None else jnp.uint8)
-                tiny = jnp.zeros((self.row_chunk * 2, self.G), bin_dtype)
-                ghi0 = jnp.zeros((self.row_chunk * 2, 3), jnp.float32)
+                tiny = jnp.zeros((self.G, self.row_chunk * 2), bin_dtype)
+                ghi0 = jnp.zeros((3, self.row_chunk * 2), jnp.float32)
                 jax.block_until_ready(leaf_hist_pallas(
-                    tiny, ghi0[:, 0], ghi0[:, 1], jnp.int32(0),
+                    tiny, ghi0[0], ghi0[1], jnp.int32(0),
                     jnp.int32(4), num_bins=self.B,
                     row_chunk=self.row_chunk))
             except Exception as exc:
@@ -324,21 +329,26 @@ class SerialTreeLearner:
                             str(exc).split("\n")[0][:120])
                 self._use_pallas = False
 
-        # Row layout: the binned matrix (N_pad, G) in its native bin dtype,
-        # plus separate (N_pad,) grad/hess/rowid arrays.  The partition moves
-        # rows with vectorized 2-D row-gathers + contiguous window writes
-        # (1-D gathers/scatters serialize on TPU; 2-D row gathers vectorize —
-        # grad/hess/rowid are therefore moved as one stacked (C, 3) matrix).
-        # Rows are never gathered by bag index: bagging/GOSS zero the
-        # out-of-bag gradients instead.
+        # Row layout: the binned matrix TRANSPOSED to (G, N_pad) in its
+        # native bin dtype, plus a packed (3, N_pad) grad/hess/rowid matrix.
+        # Rows live on the MINOR (lane) axis: in (N, G) orientation XLA's
+        # layout heuristic prefers column-major for the multi-MB buffers
+        # (G < 128 would waste 4.5x footprint row-major) while the
+        # partition's row-gather loops demand row-major, and the
+        # disagreement inserted full-buffer transpose copies EVERY split.
+        # (G, N) row-major is bit-identical to (N, G) column-major, so all
+        # consumers now agree.  The partition still moves rows with
+        # vectorized 2-D gathers on chunk-local transposes + contiguous
+        # window writes.  Rows are never gathered by bag index:
+        # bagging/GOSS zero the out-of-bag gradients instead.
         self._part0 = None
         if local_num_data is None:
             binned = np.ascontiguousarray(dataset.binned)
             if binned.shape[1] < self.G:   # zero usable features
                 binned = np.zeros((binned.shape[0], self.G), binned.dtype)
-            front = np.zeros((C, self.G), binned.dtype)
-            tail = np.zeros((self.N_pad - C - self.N, self.G), binned.dtype)
-            self._part0 = jnp.asarray(np.concatenate([front, binned, tail]))
+            pad = np.zeros((self.G, self.N_pad), binned.dtype)
+            pad[:, C:C + self.N] = binned.T
+            self._part0 = jnp.asarray(pad)
 
         # ---- scalars ----
         self.l1 = float(config.lambda_l1)
@@ -359,7 +369,7 @@ class SerialTreeLearner:
     # ------------------------------------------------------------------
     def _hist_leaf(self, part_bins, part_ghi, start, cnt):
         if self._use_pallas:
-            return leaf_hist_pallas(part_bins, part_ghi[:, 0], part_ghi[:, 1],
+            return leaf_hist_pallas(part_bins, part_ghi[0], part_ghi[1],
                                     start, cnt, num_bins=self.B,
                                     row_chunk=self.row_chunk)
         return leaf_hist_slice(part_bins, part_ghi, start, cnt,
@@ -393,43 +403,44 @@ class SerialTreeLearner:
 
         TPUs scatter into HBM one element at a time (scalar-core DMA), so the
         global scatter a literal CUDA port would use is off the table.
-        Each fixed-size chunk is compacted LOCALLY (VMEM-sized argsort /
-        permute) and written with contiguous full-window updates.  This
-        replaces the CUDA bitvector + AggregateBlockOffset + SplitInner
-        kernels (cuda_data_partition.cu:288-907).
+        Each fixed-size chunk is compacted LOCALLY (packed-key sort +
+        row-gather on the chunk transpose) and written with contiguous
+        window updates.  This replaces the CUDA bitvector +
+        AggregateBlockOffset + SplitInner kernels
+        (cuda_data_partition.cu:288-907).
 
-        No window is ever masked against its DESTINATION: a read-modify-
-        write fusion on a loop-carried buffer defeats XLA's in-place
-        aliasing and forces a full copy of that buffer every while-loop
-        iteration (measured as ~half the tree-build time).  Instead lefts
-        and rights are forward-packed UNMASKED into their own scratch
-        regions (each window's garbage tail is overwritten by the next
-        window), boundary slivers of untouched rows are pre-copied into the
-        scratches, and the copy-back composes every destination window
-        purely from the two scratches.
+        Lefts are forward-packed from the range start and rights backward
+        from the range end into the scratch buffers, then the copy-back
+        loop composes every destination window from the scratches.  (An
+        in-place variant that wrote lefts directly into the row buffers —
+        safe because the left frontier never passes the read frontier —
+        measured ~1.7x SLOWER end-to-end: the read-modify-write hazard on
+        the loop-carried row buffers defeats XLA's in-place scheduling.)
         """
         C = self.row_chunk
         G = self.G
         part_bins = st["part_bins"]
-        # grad/hess/rowid live PERMANENTLY as one (N_pad, 3) f32 matrix
-        # (rowid bitcast to f32) so the per-chunk permute is a 2-D row gather
-        # (1-D gathers serialize on TPU) and no per-split pack/unpack of the
-        # full row payload is materialized.
+        # grad/hess/rowid live PERMANENTLY as one (3, N_pad) f32 matrix
+        # (rowid bitcast to f32) so the per-chunk permute is one 2-D gather
+        # on the chunk transpose (1-D gathers serialize on TPU) and no
+        # per-split pack/unpack of the full row payload is materialized.
         part_ghi = st["part_ghi"]
         n_chunks = (cnt + C - 1) // C
 
         def blend(dst, val, off, mask):
-            win = jax.lax.dynamic_slice(dst, (off, 0), val.shape)
+            # (rows-on-lanes window write at column offset ``off``)
+            win = jax.lax.dynamic_slice(dst, (0, off),
+                                        (dst.shape[0], val.shape[1]))
             return jax.lax.dynamic_update_slice(
-                dst, jnp.where(mask[:, None], val, win), (off, 0))
+                dst, jnp.where(mask[None, :], val, win), (0, off))
 
         def scatter_pass(ci, carry):
             nl, nr, sb, sg = carry
             row0 = start + ci * C
-            bch = jax.lax.dynamic_slice(part_bins, (row0, 0), (C, G))
-            gch = jax.lax.dynamic_slice(part_ghi, (row0, 0), (C, 3))
+            bch = jax.lax.dynamic_slice(part_bins, (0, row0), (G, C))
+            gch = jax.lax.dynamic_slice(part_ghi, (0, row0), (3, C))
             colv = jax.lax.dynamic_slice(
-                bch, (jnp.int32(0), col), (C, 1))[:, 0].astype(jnp.int32)
+                bch, (col, jnp.int32(0)), (1, C))[0].astype(jnp.int32)
             valid = (ci * C + jax.lax.iota(jnp.int32, C)) < cnt
             gl = self._goes_left(colv, decision_scalars) & valid
             gr = valid & ~gl
@@ -444,9 +455,20 @@ class SerialTreeLearner:
             # local destination: [lefts | padding | rights(right-aligned)]
             dloc = jnp.where(gl, lrank,
                              jnp.where(gr, C - nrc + rrank, nlc + irank))
-            order = jnp.argsort(dloc)
-            bcomp = jnp.take(bch, order, axis=0)         # ROW gathers
-            gcomp = jnp.take(gch, order, axis=0)
+            # inverse permutation via a SINGLE-operand sort of packed
+            # (dest << log2C) | src keys: XLA's multi-operand sort (what
+            # jnp.argsort lowers to) runs ~50x slower on TPU than the
+            # one-array form, and this sort dominated the whole partition
+            iot0 = jax.lax.iota(jnp.int32, C)
+            packed = ((dloc << self._chunk_bits) | iot0).astype(jnp.uint32)
+            order = (jax.lax.sort(packed) & jnp.uint32(C - 1)).astype(
+                jnp.int32)
+            # permute rows via a row-gather on the chunk TRANSPOSE: the big
+            # buffers only ever see contiguous (G, C) window slices/updates,
+            # so their row-major (G, N) layout is never contested; the
+            # transposes are VMEM-local tile shuffles
+            bcomp = jnp.take(bch.T, order, axis=0).T     # (G, C)
+            gcomp = jnp.take(gch.T, order, axis=0).T     # (3, C)
             iot = jax.lax.iota(jnp.int32, C)
             lmask = iot < nlc
             # rights window [start+cnt-nr-C, +C), mask last nrc rows; the
@@ -465,9 +487,9 @@ class SerialTreeLearner:
             pb, pg = carry
             row0 = start + ci * C
             valid = (ci * C + jax.lax.iota(jnp.int32, C)) < cnt
-            pb = blend(pb, jax.lax.dynamic_slice(sb, (row0, 0), (C, G)),
+            pb = blend(pb, jax.lax.dynamic_slice(sb, (0, row0), (G, C)),
                        row0, valid)
-            pg = blend(pg, jax.lax.dynamic_slice(sg, (row0, 0), (C, 3)),
+            pg = blend(pg, jax.lax.dynamic_slice(sg, (0, row0), (3, C)),
                        row0, valid)
             return pb, pg
 
@@ -745,7 +767,7 @@ class SerialTreeLearner:
 
         part_ghi0 = jnp.stack(
             [grad_p, hess_p,
-             jax.lax.bitcast_convert_type(rowid, jnp.float32)], axis=1)
+             jax.lax.bitcast_convert_type(rowid, jnp.float32)], axis=0)
         root_hist = self._psum(self._hist_leaf(
             part_bins, part_ghi0, jnp.int32(self.row0), jnp.int32(self.N)))
         bag_cnt_g = self._psum_scalar(bag_cnt)
@@ -793,7 +815,7 @@ class SerialTreeLearner:
             "part_bins": part_bins,
             "part_ghi": part_ghi0,
             "sc_bins": jnp.zeros_like(part_bins),
-            "sc_ghi": jnp.zeros((part_bins.shape[0], 3), jnp.float32),
+            "sc_ghi": jnp.zeros((3, part_bins.shape[1]), jnp.float32),
             "hist": jnp.zeros((L + 1, G, B, 2),
                               dtype=jnp.float32).at[0].set(root_hist),
             "leafmat": leafmat,
@@ -1076,9 +1098,9 @@ class SerialTreeLearner:
         rec["best_cat_set"] = st["best_cat_set"][:L]
         rec["node_cat_set"] = st["node_cat_set"][:nodes]
         rec["hist"] = st["hist"][:L]
-        rec["indices"] = _f2i(st["part_ghi"][:, 2])
-        rec["part_grad"] = st["part_ghi"][:, 0]
-        rec["part_hess"] = st["part_ghi"][:, 1]
+        rec["indices"] = _f2i(st["part_ghi"][2])
+        rec["part_grad"] = st["part_ghi"][0]
+        rec["part_hess"] = st["part_ghi"][1]
 
         def li(r):
             return _f2i(lm[r])

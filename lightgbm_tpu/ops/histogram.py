@@ -5,18 +5,28 @@ TPU-native replacement for the reference histogram kernels
 cuda_histogram_constructor.cu).  TPUs have no fast scatter-add, so the
 (rows x groups) -> (groups x bins) accumulation is reformulated as a one-hot
 MXU matmul.  Rows are kept *physically partitioned by leaf* (see
-models/learner.py), so a leaf's histogram reads one contiguous row slice —
+models/learner.py), so a leaf's histogram reads one contiguous column slice —
 no gathers touch HBM on the hot path.
+
+Row-payload layout is TRANSPOSED: the binned matrix is (G, N_pad) and the
+packed (grad, hess, rowid) payload is (3, N_pad), with ROWS ON THE MINOR
+(lane) axis.  With the natural (N, G) orientation XLA prefers column-major
+for the big buffers (G < 128 lanes would waste 4.5x footprint row-major)
+while the partition's row-gather loops demand row-major — the disagreement
+materialized as full-buffer transpose copies inside the tree-build while
+loop, ~60% of its wall clock.  (G, N) row-major is the same physical bytes
+as (N, G) column-major, so every consumer now agrees with the layout XLA
+wants and the copies vanish.
 
 Two implementations with identical semantics:
   * ``leaf_hist_slice``  — pure-XLA chunked einsum (runs everywhere; the
     oracle for tests and the CPU path).
-  * ``leaf_hist_pallas`` — Pallas TPU kernel that DMAs (chunk, G) tiles
+  * ``leaf_hist_pallas`` — Pallas TPU kernel that DMAs (G, chunk) tiles
     straight from HBM with a dynamic trip count and accumulates per-feature
     (2, B) partial histograms in VMEM.
 
 The contraction layout batches ``gblock`` feature groups into the matmul N
-dimension — out[(j),(g,b)] = sum_c gh[j,c] * (bins[c,g]==b) — because the
+dimension — out[(j),(g,b)] = sum_c gh[j,c] * (bins[g,c]==b) — because the
 left operand (grad/hess) is shared across features.  This keeps the MXU's
 N dimension wide instead of the naive per-feature (C,B)@(B,2) shape whose
 N=2 wastes 126/128 lanes.
@@ -35,8 +45,8 @@ def leaf_hist_slice(part_bins, part_ghi, start, cnt, *,
                     num_bins: int, row_chunk: int,
                     gblock: int = 0, dtype=jnp.float32, vary=lambda x: x):
     """(G, B, 2) histogram of the contiguous partitioned rows
-    [start, start+cnt) of the (N_pad, G) binned matrix with matching
-    (N_pad, >=2) packed (grad, hess, ...) columns; rows beyond ``cnt``
+    [start, start+cnt) of the (G, N_pad) binned matrix with matching
+    (>=2, N_pad) packed (grad, hess, ...) rows; rows beyond ``cnt``
     inside the last chunk are masked via zeroed grad/hess.
 
     Digit-decomposed one-hot accumulation: onehot_B(x) factors as
@@ -49,15 +59,15 @@ def leaf_hist_slice(part_bins, part_ghi, start, cnt, *,
     ConstructHistogram) and CUDA shared-memory atomics
     (cuda_histogram_constructor.cu).
     """
-    Np, G = part_bins.shape
+    G, Np = part_bins.shape
     C = row_chunk
     B = num_bins
     BH = (B + 15) // 16          # high-digit cardinality
     Bp = BH * 16
     if gblock <= 0:
         # keep the per-block intermediates in VMEM: the low-digit one-hot is
-        # (C, gblock, 16) and the WEIGHTED high-digit buffer is
-        # (C, gblock, 2*BH) — budget both
+        # (gblock, C, 16) and the WEIGHTED high-digit buffer is
+        # (gblock, C, 2*BH) — budget both
         gblock = max(1, (4 * 1024 * 1024) // (C * (16 + 2 * BH) * 4))
     nblk = (G + gblock - 1) // gblock
     Gp = nblk * gblock
@@ -68,28 +78,28 @@ def leaf_hist_slice(part_bins, part_ghi, start, cnt, *,
     def body(ci, accs):
         row0 = start + ci * C
         bins = jax.lax.dynamic_slice(
-            part_bins, (row0, 0), (C, G)).astype(jnp.int32)
+            part_bins, (0, row0), (G, C)).astype(jnp.int32)
         gh3 = jax.lax.dynamic_slice(
-            part_ghi, (row0, 0), (C, part_ghi.shape[1]))
-        g = gh3[:, 0]
-        h = gh3[:, 1]
+            part_ghi, (0, row0), (part_ghi.shape[0], C))
+        g = gh3[0]
+        h = gh3[1]
         if Gp > G:
-            bins = jnp.pad(bins, ((0, 0), (0, Gp - G)), constant_values=-1)
+            bins = jnp.pad(bins, ((0, Gp - G), (0, 0)), constant_values=-1)
         valid = (ci * C + jax.lax.iota(jnp.int32, C)) < cnt
-        gv = (g * valid).astype(dtype)[:, None, None]         # (C, 1, 1)
-        hv = (h * valid).astype(dtype)[:, None, None]
+        gv = (g * valid).astype(dtype)[None, :, None]         # (1, C, 1)
+        hv = (h * valid).astype(dtype)[None, :, None]
         out = []
         for i in range(nblk):
-            blk = bins[:, i * gblock:(i + 1) * gblock]        # (C, gblk)
+            blk = bins[i * gblock:(i + 1) * gblock, :]        # (gblk, C)
             hi = blk >> 4
             lo = blk & 15
-            oh_hi = (hi[:, :, None] == iota_hi).astype(dtype)  # (C, gblk, BH)
-            oh_lo = (lo[:, :, None] == iota_lo).astype(dtype)  # (C, gblk, 16)
+            oh_hi = (hi[:, :, None] == iota_hi).astype(dtype)  # (gblk, C, BH)
+            oh_lo = (lo[:, :, None] == iota_lo).astype(dtype)  # (gblk, C, 16)
             # weighted high-digit one-hots for (grad, hess) side by side
             wg = jnp.concatenate([oh_hi * gv, oh_hi * hv], axis=2)
             part = jax.lax.dot_general(
                 wg, oh_lo,
-                dimension_numbers=(((0,), (0,)), ((1,), (1,))),
+                dimension_numbers=(((1,), (1,)), ((0,), (0,))),
                 preferred_element_type=jnp.float32)   # (gblk, 2*BH, 16)
             out.append(accs[i] + part)
         return tuple(out)
@@ -110,7 +120,8 @@ def leaf_hist_slice(part_bins, part_ghi, start, cnt, *,
                                              "use_bf16"))
 def leaf_hist_pallas(part_bins, grad_p, hess_p, start, cnt, *,
                      num_bins: int, row_chunk: int, use_bf16: bool = False):
-    """Same contract as ``leaf_hist_slice``, as one Pallas kernel.
+    """Same contract as ``leaf_hist_slice`` (transposed (G, N_pad) binned
+    input), as one Pallas kernel.
 
     A single program (grid=(1,)) walks the leaf's chunks with a dynamic trip
     count, double-buffered DMA from HBM, and per-feature one-hot matmuls
@@ -122,7 +133,7 @@ def leaf_hist_pallas(part_bins, grad_p, hess_p, start, cnt, *,
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    Np, G = part_bins.shape
+    G, Np = part_bins.shape
     C = row_chunk
     B = num_bins
     B128 = ((B + 127) // 128) * 128
@@ -142,7 +153,7 @@ def leaf_hist_pallas(part_bins, grad_p, hess_p, start, cnt, *,
             blk = c0 + ci
             return (
                 pltpu.make_async_copy(
-                    bins_hbm.at[blk], bins_buf.at[slot], sems.at[slot, 0]),
+                    bins_hbm.at[:, blk], bins_buf.at[slot], sems.at[slot, 0]),
                 pltpu.make_async_copy(
                     grad_hbm.at[blk], grad_buf.at[slot], sems.at[slot, 1]),
                 pltpu.make_async_copy(
@@ -169,10 +180,10 @@ def leaf_hist_pallas(part_bins, grad_p, hess_p, start, cnt, *,
             g = jnp.where(valid, grad_buf[slot][None, :], 0.0)
             h = jnp.where(valid, hess_buf[slot][None, :], 0.0)
             gh = jnp.concatenate([g, h], axis=0).astype(dtype)    # (2, C)
-            bins = bins_buf[slot].astype(jnp.int32)               # (C, G)
+            bins = bins_buf[slot].astype(jnp.int32)               # (G, C)
             iota_b = jax.lax.broadcasted_iota(jnp.int32, (C, B128), 1)
             for f in range(G):
-                oh = (bins[:, f:f + 1] == iota_b).astype(dtype)   # (C, B128)
+                oh = (bins[f][:, None] == iota_b).astype(dtype)   # (C, B128)
                 part = jax.lax.dot_general(
                     gh, oh, dimension_numbers=(((1,), (0,)), ((), ())),
                     preferred_element_type=jnp.float32)            # (2, B128)
@@ -188,7 +199,7 @@ def leaf_hist_pallas(part_bins, grad_p, hess_p, start, cnt, *,
         in_specs=[pl.BlockSpec(memory_space=pltpu.HBM)] * 3,
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         scratch_shapes=[
-            pltpu.VMEM((2, C, G), part_bins.dtype),
+            pltpu.VMEM((2, G, C), part_bins.dtype),
             pltpu.VMEM((2, C), jnp.float32),
             pltpu.VMEM((2, C), jnp.float32),
             pltpu.VMEM((2, G, B128), jnp.float32),
@@ -203,6 +214,6 @@ def leaf_hist_pallas(part_bins, grad_p, hess_p, start, cnt, *,
         out_shape=jax.ShapeDtypeStruct((2, G, B128), jnp.float32),
         grid_spec=grid_spec,
     )(jnp.asarray([start], jnp.int32), jnp.asarray([cnt], jnp.int32),
-      part_bins.reshape(nblocks, C, G), grad_p.reshape(nblocks, C),
+      part_bins.reshape(G, nblocks, C), grad_p.reshape(nblocks, C),
       hess_p.reshape(nblocks, C))
     return jnp.moveaxis(out[:, :, :B], 0, 2)

@@ -102,7 +102,7 @@ class ShardedTreeBuilder:
             # binned: (local_n+1, G); grad/hess: (local_n,); cnt: (1,)
             C = lr.row0
             part_bins = jnp.pad(
-                binned, ((C, lr.N_pad - C - binned.shape[0]), (0, 0)))
+                binned.T, ((0, 0), (C, lr.N_pad - C - binned.shape[0])))
             grad_l = grad[: lr.N]
             hess_l = hess[: lr.N]
             if self.mode == "feature":
