@@ -206,7 +206,7 @@ class SerialTreeLearner:
         self.f_is_bundled = jnp.asarray(is_bundled)
         self.has_categorical = bool(np.any(meta["is_categorical"]))
 
-        # ---- monotone constraints (basic mode) ----
+        # ---- monotone constraints ----
         mono_all = parse_monotone_constraints(
             config.monotone_constraints, dataset.num_total_features)
         mono_used = mono_all[meta["feature"]].astype(np.int32)
@@ -214,12 +214,19 @@ class SerialTreeLearner:
         self.use_mc = bool(np.any(mono_used != 0))
         self.monotone = jnp.asarray(mono_used) if self.use_mc else None
         self.monotone_penalty = float(config.monotone_penalty)
-        if self.use_mc and config.monotone_constraints_method not in (
-                "basic",):
-            log.warning(
-                f"monotone_constraints_method="
-                f"{config.monotone_constraints_method} is not implemented; "
-                f"falling back to 'basic'")
+        # `intermediate`/`advanced` select the REGION-EXACT refresh (see
+        # _mc_refresh): per-leaf bin ranges + pairwise comparability replace
+        # the reference's recursive constraint propagation + per-leaf split
+        # recomputation (IntermediateLeafConstraints::Update /
+        # GoUpToFindLeavesToUpdate, monotone_constraints.hpp:516-740).
+        # `advanced` (per-threshold constraint segments) falls back to the
+        # same region-exact mode, which is already a sound tightening.
+        self.mc_mode = "basic"
+        if self.use_mc and config.monotone_constraints_method in (
+                "intermediate", "advanced"):
+            self.mc_mode = "intermediate"
+            self.mono_enums = [int(i) for i in np.where(mono_used != 0)[0]]
+            self.mono_signs = [int(mono_used[i]) for i in self.mono_enums]
         # ---- interaction constraints ----
         ic = parse_interaction_constraints(
             config.interaction_constraints, dataset.num_total_features)
@@ -662,6 +669,79 @@ class SerialTreeLearner:
         gain = jnp.where(depth_ok, best.gain, -jnp.inf)
         return best._replace(gain=gain)
 
+    # ------------------------------------------------------------------
+    def _mc_refresh(self, st, lm, nleaves, feature_mask):
+        """Region-exact `intermediate` monotone mode.
+
+        TPU-native replacement for the reference's recursive
+        constraint-propagation walk (IntermediateLeafConstraints::
+        GoUpToFindLeavesToUpdate + RecomputeBestSplitForLeaf,
+        monotone_constraints.hpp:516-740, serial_tree_learner.cpp): every
+        leaf carries its bin-range box (leaf_lo/leaf_hi over used
+        features); two leaves are COMPARABLE along monotone feature m when
+        their boxes overlap in every other feature and are disjoint along
+        m.  Each split recomputes, from scratch, every leaf's output bounds
+        from the current outputs of all comparable leaves — the sound
+        fixed point the reference's incremental traversal approximates —
+        then re-runs the split search for leaves whose bounds changed.
+        Fully vectorized over (leaf x leaf) pairs; only traced when
+        monotone_constraints_method selects it.
+        """
+        L = self.L
+        lo = st["leaf_lo"][:L]                       # (L, F)
+        hi = st["leaf_hi"][:L]
+        vals = lm[LM_VALUE, :L]
+        exist = jax.lax.iota(jnp.int32, L) < nleaves
+        # pairwise per-feature box intersection: [row Y, col X, feature]
+        inter = ((lo[:, None, :] <= hi[None, :, :]) &
+                 (lo[None, :, :] <= hi[:, None, :]))
+        miss = jnp.sum(~inter, axis=2)               # (L, L)
+        pair_ok = exist[:, None] & exist[None, :]
+        newmin = jnp.full((L,), -jnp.inf, jnp.float32)
+        newmax = jnp.full((L,), jnp.inf, jnp.float32)
+        for m, sign in zip(self.mono_enums, self.mono_signs):
+            only_m = (miss - (~inter[:, :, m]).astype(jnp.int32)) == 0
+            x_below = hi[None, :, m] < lo[:, None, m]    # X entirely below Y
+            x_above = lo[None, :, m] > hi[:, None, m]
+            lower = x_below if sign > 0 else x_above     # out(Y) >= out(X)
+            upper = x_above if sign > 0 else x_below     # out(Y) <= out(X)
+            lmask = only_m & lower & pair_ok
+            umask = only_m & upper & pair_ok
+            newmin = jnp.maximum(newmin, jnp.max(
+                jnp.where(lmask, vals[None, :], -jnp.inf), axis=1))
+            newmax = jnp.minimum(newmax, jnp.min(
+                jnp.where(umask, vals[None, :], jnp.inf), axis=1))
+        changed = exist & ((newmin != lm[LM_CMIN, :L]) |
+                           (newmax != lm[LM_CMAX, :L]))
+        lm = lm.at[LM_CMIN, :L].set(jnp.where(exist, newmin, lm[LM_CMIN, :L]))
+        lm = lm.at[LM_CMAX, :L].set(jnp.where(exist, newmax, lm[LM_CMAX, :L]))
+        # re-run the split search for every changed leaf (the reference
+        # recomputes exactly the affected set; computing all-under-mask is
+        # the vectorized equivalent)
+        best = self._best_split_vmapped(
+            st["hist"][:L], lm[LM_SUM_G, :L], lm[LM_SUM_H, :L],
+            _f2i(lm[LM_CNT_G, :L]), _f2i(lm[LM_CNT, :L]),
+            _f2i(lm[LM_DEPTH, :L]), newmin, newmax, lm[LM_VALUE, :L],
+            jnp.broadcast_to(feature_mask, (L, self.F)), st["feat_used"])
+        overlay = {
+            LM_BGAIN: best.gain,
+            LM_BFEAT: _i2f(best.feature),
+            LM_BTHR: _i2f(best.threshold),
+            LM_BDL: best.default_left.astype(jnp.float32),
+            LM_BLCNT: _i2f(best.left_count),
+            LM_BRCNT: _i2f(best.right_count),
+            LM_BLSG: best.left_sum_g, LM_BLSH: best.left_sum_h,
+            LM_BRSG: best.right_sum_g, LM_BRSH: best.right_sum_h,
+            LM_BLOUT: best.left_output, LM_BROUT: best.right_output,
+            LM_BISCAT: best.is_cat.astype(jnp.float32),
+        }
+        for row, new in overlay.items():
+            lm = lm.at[row, :L].set(jnp.where(changed, new, lm[row, :L]))
+        cat = st["best_cat_set"]
+        cat = cat.at[:L].set(jnp.where(changed[:, None], best.cat_set,
+                                       cat[:L]))
+        return lm, cat
+
     def _leaf_best_split_voting(self, hist_local, sum_g, sum_h, cnt,
                                 local_cnt, depth, cmin, cmax, parent_out,
                                 feature_mask, feat_used=None):
@@ -828,6 +908,12 @@ class SerialTreeLearner:
 
         if self.ic_masks is not None:
             state["leaf_used"] = jnp.zeros((L + 1, F), jnp.bool_)
+
+        if self.use_mc and self.mc_mode == "intermediate":
+            # root box covers every bin of every used feature
+            state["leaf_lo"] = jnp.zeros((L + 1, F), jnp.int32)
+            state["leaf_hi"] = jnp.broadcast_to(
+                self.ctx.num_bin - 1, (L + 1, F)).astype(jnp.int32)
 
         # uniform vma typing under shard_map: mark the whole state varying
         state = self._pvary(state)
@@ -1063,6 +1149,9 @@ class SerialTreeLearner:
                                   rout, r_cmin, r_cmax, 1, best_r, forced_r)
                 lm2 = lm.at[:, wr_a].set(col_l).at[:, wr_b].set(col_r)
 
+                new_cat = st["best_cat_set"] \
+                    .at[wr_a].set(best_l.cat_set) \
+                    .at[wr_b].set(best_r.cat_set)
                 upd.update({
                     "s": s + valid.astype(jnp.int32),
                     "done": ~valid & ~skip_pending,
@@ -1074,10 +1163,32 @@ class SerialTreeLearner:
                         .at[wr_a].set(used_child)
                         .at[wr_b].set(used_child)}
                        if self.ic_masks is not None else {}),
-                    "best_cat_set": st["best_cat_set"]
-                    .at[wr_a].set(best_l.cat_set)
-                    .at[wr_b].set(best_r.cat_set),
+                    "best_cat_set": new_cat,
                 })
+                if self.use_mc and self.mc_mode == "intermediate":
+                    # per-leaf bin-range boxes: children inherit the parent
+                    # box, tightened along the split feature for numerical
+                    # splits (categorical boxes stay whole — conservative)
+                    prow_lo = st["leaf_lo"][best_leaf]
+                    prow_hi = st["leaf_hi"][best_leaf]
+                    f1h = jax.lax.broadcasted_iota(
+                        jnp.int32, (F,), 0) == f_enum
+                    tighten = f1h & ~is_cat
+                    l_hi = jnp.where(tighten, jnp.minimum(prow_hi, thr),
+                                     prow_hi)
+                    r_lo = jnp.where(tighten, jnp.maximum(prow_lo, thr + 1),
+                                     prow_lo)
+                    leaf_lo = st["leaf_lo"].at[wr_a].set(prow_lo) \
+                                           .at[wr_b].set(r_lo)
+                    leaf_hi = st["leaf_hi"].at[wr_a].set(l_hi) \
+                                           .at[wr_b].set(prow_hi)
+                    upd["leaf_lo"] = leaf_lo
+                    upd["leaf_hi"] = leaf_hi
+                    st2 = {**st, **upd}
+                    lm3, cat3 = self._mc_refresh(
+                        st2, lm2, upd["s"] + 1, feature_mask)
+                    upd["leafmat"] = jnp.where(valid, lm3, lm2)
+                    upd["best_cat_set"] = jnp.where(valid, cat3, new_cat)
                 return self._pvary(upd)
 
         if self.F == 0:   # no splittable features: the root is the only leaf

@@ -59,6 +59,31 @@ def test_unconstrained_violates():
     assert not is_increasing(bst, X, 0, +1)
 
 
+@pytest.mark.parametrize("method", ["intermediate", "advanced"])
+def test_monotone_intermediate_enforced(method):
+    """Region-exact intermediate mode keeps the constraint AND fits at
+    least as well as basic (reference: test_monotone_constraints with
+    monotone_constraints_method)."""
+    X, y = make_mono_data()
+    base = {"objective": "regression", "num_leaves": 31,
+            "min_data_in_leaf": 5, "verbosity": -1,
+            "monotone_constraints": "1,-1,0"}
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({**base, "monotone_constraints_method": method},
+                    ds, num_boost_round=40)
+    assert is_increasing(bst, X, 0, +1)
+    assert is_increasing(bst, X, 1, -1)
+    mse_int = np.mean((y - bst.predict(X)) ** 2)
+
+    ds2 = lgb.Dataset(X, label=y)
+    bst_basic = lgb.train({**base, "monotone_constraints_method": "basic"},
+                          ds2, num_boost_round=40)
+    mse_basic = np.mean((y - bst_basic.predict(X)) ** 2)
+    # intermediate's looser (exact) constraints should not fit WORSE than
+    # basic's over-constrained outputs by any meaningful margin
+    assert mse_int <= mse_basic * 1.1
+
+
 def test_monotone_penalty_discourages_splits():
     """With a huge penalty, monotone features should never be split on
     near the root (reference: test_monotone_penalty)."""
