@@ -102,6 +102,39 @@ def _dataframe_to_matrix(df, pandas_categorical=None):
     return mat, auto_cats, pandas_categorical
 
 
+def _is_arrow(data) -> bool:
+    """True for pyarrow Table / RecordBatch / ChunkedArray / Array without
+    importing pyarrow (detected by module, so the dependency stays
+    optional — reference: basic.py _data_from_arrow / arrow ingestion in
+    LGBM_DatasetCreateFromArrow, c_api.cpp)."""
+    mod = type(data).__module__ or ""
+    return mod.split(".")[0] == "pyarrow"
+
+
+def _arrow_column_to_numpy(col) -> np.ndarray:
+    """pyarrow (Chunked)Array -> float64 numpy with nulls as NaN."""
+    try:
+        import pyarrow as pa
+        col = col.cast(pa.float64())
+        return col.to_numpy(zero_copy_only=False)
+    except Exception:
+        return np.asarray(col.to_pandas(), dtype=np.float64)
+
+
+def _arrow_table_to_matrix(table):
+    """pyarrow Table/RecordBatch -> (float64 matrix, column names)."""
+    names = [str(c) for c in table.column_names]
+    cols = [_arrow_column_to_numpy(table.column(i))
+            for i in range(len(names))]
+    return np.column_stack(cols) if cols else np.zeros((0, 0)), names
+
+
+def _arrow_1d_to_numpy(arr) -> np.ndarray:
+    if hasattr(arr, "column_names"):         # single-column table
+        return _arrow_column_to_numpy(arr.column(0))
+    return _arrow_column_to_numpy(arr)
+
+
 def _to_matrix(data, pandas_categorical=None) -> np.ndarray:
     if isinstance(data, np.ndarray):
         return data
@@ -109,6 +142,8 @@ def _to_matrix(data, pandas_categorical=None) -> np.ndarray:
         return _dataframe_to_matrix(data, pandas_categorical)[0]
     if hasattr(data, "toarray"):  # scipy sparse
         return np.asarray(data.toarray(), dtype=np.float64)
+    if _is_arrow(data):
+        return _arrow_table_to_matrix(data)[0]
     return np.asarray(data, dtype=np.float64)
 
 
@@ -167,6 +202,19 @@ class Dataset:
             merged.update(params)
             params = merged
         cfg = Config(params)
+        # Arrow ingestion (reference: tests/python_package_test/test_arrow.py
+        # surface): tables become the feature matrix, arrow arrays become
+        # metadata vectors.  Conversion is lazy/duck-typed so pyarrow stays
+        # an optional dependency.
+        for attr in ("label", "weight", "group", "init_score", "position"):
+            v = getattr(self, attr)
+            if v is not None and _is_arrow(v):
+                setattr(self, attr, _arrow_1d_to_numpy(v))
+        if _is_arrow(self.data):
+            mat, names = _arrow_table_to_matrix(self.data)
+            self.data = mat
+            if not isinstance(self.feature_name, list) and names:
+                self.feature_name = names
         if isinstance(self.data, str):
             # file path: binary fast path (reference: LoadFromBinFile,
             # dataset_loader.cpp:417) or text load

@@ -1,0 +1,97 @@
+"""Arrow ingestion tests (reference model:
+tests/python_package_test/test_arrow.py).
+
+pyarrow is not bundled in every image, so there are two lanes:
+  * real-pyarrow tests, skipped when pyarrow is unavailable;
+  * duck-typed stand-in objects that exercise the same detection and
+    conversion paths `lightgbm_tpu.basic` uses for arrow data.
+"""
+
+import sys
+import types
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.basic import (_arrow_1d_to_numpy, _arrow_table_to_matrix,
+                                _is_arrow, _to_matrix)
+
+try:
+    import pyarrow as pa
+    HAS_PA = True
+except ImportError:
+    pa = None
+    HAS_PA = False
+
+
+# ---------------------------------------------------------------------------
+# duck-typed stand-ins living in a fake "pyarrow" module namespace
+# ---------------------------------------------------------------------------
+
+class _FakeColumn:
+    __module__ = "pyarrow.lib"
+
+    def __init__(self, values):
+        self._v = np.asarray(values, dtype=np.float64)
+
+    def cast(self, *_a, **_k):
+        raise RuntimeError("no real pyarrow")   # force the to_pandas branch
+
+    def to_pandas(self):
+        return self._v
+
+
+class _FakeTable:
+    __module__ = "pyarrow.lib"
+
+    def __init__(self, cols, names):
+        self._cols = [_FakeColumn(c) for c in cols]
+        self.column_names = list(names)
+
+    def column(self, i):
+        return self._cols[i]
+
+
+def _make_data(n=400, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, 4))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    return X, y
+
+
+def test_fake_arrow_detection():
+    X, y = _make_data()
+    t = _FakeTable([X[:, i] for i in range(4)], ["a", "b", "c", "d"])
+    assert _is_arrow(t)
+    assert not _is_arrow(X)
+    mat, names = _arrow_table_to_matrix(t)
+    np.testing.assert_allclose(mat, X)
+    assert names == ["a", "b", "c", "d"]
+    np.testing.assert_allclose(_arrow_1d_to_numpy(_FakeColumn(y)), y)
+    np.testing.assert_allclose(_to_matrix(t), X)
+
+
+def test_fake_arrow_train_predict():
+    X, y = _make_data()
+    t = _FakeTable([X[:, i] for i in range(4)], ["f1", "f2", "f3", "f4"])
+    ds = lgb.Dataset(t, label=_FakeColumn(y))
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1}, ds, num_boost_round=10)
+    assert bst.feature_name() == ["f1", "f2", "f3", "f4"]
+    pred_arrow = bst.predict(_FakeTable([X[:, i] for i in range(4)],
+                                        ["f1", "f2", "f3", "f4"]))
+    pred_np = bst.predict(X)
+    np.testing.assert_allclose(pred_arrow, pred_np)
+    from sklearn.metrics import roc_auc_score
+    assert roc_auc_score(y, pred_np) > 0.85
+
+
+@pytest.mark.skipif(not HAS_PA, reason="pyarrow not installed")
+def test_real_arrow_train():
+    X, y = _make_data()
+    table = pa.table({f"f{i}": X[:, i] for i in range(4)})
+    ds = lgb.Dataset(table, label=pa.chunked_array([y]))
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1}, ds, num_boost_round=10)
+    np.testing.assert_allclose(bst.predict(table), bst.predict(X))
