@@ -118,6 +118,11 @@ class GBDT:
         # model-lifetime CEGB used-feature set (reference:
         # CostEfficientGradientBoosting::is_feature_used_in_split_)
         self._cegb_feat_used = None
+        # model-lifetime cegb-lazy per-(row, feature) used bitset
+        self._cegb_lazy_aux = None
+        if self.learner.cegb_lazy is not None and self.sharded_builder:
+            log.warning("cegb_penalty_feature_lazy is not persisted across "
+                        "iterations by the distributed learners")
         # lagged fused-iteration records awaiting host materialization
         self._pending_recs: List[Dict[str, Any]] = []
 
@@ -150,6 +155,7 @@ class GBDT:
                 and K == 1
                 and not cfg.linear_tree and not self.use_quant
                 and not self.goss and not self.need_bagging
+                and not cfg.cegb_penalty_feature_lazy
                 and not self.objective.is_renew_tree_output):
             self._setup_fused_step()
 
@@ -575,10 +581,15 @@ class GBDT:
                 else:
                     record = self.learner.build_tree(
                         gk, hk, bag_cnt, feature_mask, seed=tree_seed,
-                        feat_used=self._cegb_feat_used)
+                        feat_used=self._cegb_feat_used,
+                        lazy_aux=self._cegb_lazy_aux)
             if self.learner.has_cegb:
                 # coupled penalties persist for the model lifetime
                 self._cegb_feat_used = record["feat_used"]
+                if (not use_sharded
+                        and self.learner.cegb_lazy is not None):
+                    self._cegb_lazy_aux = \
+                        self.learner.lazy_aux_to_original_order(record)
             num_nodes = int(record["s"])
             if num_nodes > 0:
                 should_stop = False

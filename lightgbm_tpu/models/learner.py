@@ -245,11 +245,22 @@ class SerialTreeLearner:
             config.cegb_penalty_feature_coupled, dataset.num_total_features)
         if coupled is not None:
             self.cegb_coupled = jnp.asarray(tradeoff * coupled[meta["feature"]])
-        if config.cegb_penalty_feature_lazy:
-            log.warning("cegb_penalty_feature_lazy requires per-(row,feature)"
-                        " tracking and is not implemented; ignoring")
+        # lazy per-(row, feature) penalties (reference:
+        # CostEfficientGradientBoosting::DetectSplits 'delta' term +
+        # UpdateUsedFeatures, cost_effective_gradient_boosting.hpp): a
+        # packed per-row used-feature BITSET (ceil(F/32) int32 rows) rides
+        # the partition payload; each child split search subtracts
+        # penalty[f] * (#rows in the child whose bit f is still 0)
+        self.cegb_lazy = None
+        self.aux_rows = 0
+        lazy = parse_per_feature_penalty(
+            config.cegb_penalty_feature_lazy, dataset.num_total_features)
+        if lazy is not None and self.F > 0:
+            self.cegb_lazy = jnp.asarray(tradeoff * lazy[meta["feature"]])
+            self.aux_rows = (self.F + 31) // 32
         self.has_cegb = (self.cegb_count_coeff > 0
-                         or self.cegb_coupled is not None)
+                         or self.cegb_coupled is not None
+                         or self.cegb_lazy is not None)
 
         # ---- forced splits ----
         self.forced = None
@@ -368,9 +379,11 @@ class SerialTreeLearner:
         self.top_k = int(config.top_k)
         self.path_smooth = float(config.path_smooth)
 
-        self._best_split_vmapped = jax.vmap(
-            self._leaf_best_split,
-            in_axes=(0, 0, 0, 0, 0, 0, 0, 0, 0, 0, None))
+        axes = (0, 0, 0, 0, 0, 0, 0, 0, 0, 0, None)
+        if self.cegb_lazy is not None:
+            axes = axes + (0,)
+        self._best_split_vmapped = jax.vmap(self._leaf_best_split,
+                                            in_axes=axes)
         self._build = jax.jit(self._build_impl)
 
     # ------------------------------------------------------------------
@@ -441,8 +454,12 @@ class SerialTreeLearner:
             return jax.lax.dynamic_update_slice(
                 dst, jnp.where(mask[None, :], val, win), (0, off))
 
+        part_aux = st.get("part_aux")
+        sc_aux0 = st.get("sc_aux")
+        W = self.aux_rows
+
         def scatter_pass(ci, carry):
-            nl, nr, sb, sg = carry
+            nl, nr, sb, sg, sa = carry
             row0 = start + ci * C
             bch = jax.lax.dynamic_slice(part_bins, (0, row0), (G, C))
             gch = jax.lax.dynamic_slice(part_ghi, (0, row0), (3, C))
@@ -484,30 +501,44 @@ class SerialTreeLearner:
             roff = start + cnt - nr - C
             sb = blend(blend(sb, bcomp, start + nl, lmask), bcomp, roff, rmask)
             sg = blend(blend(sg, gcomp, start + nl, lmask), gcomp, roff, rmask)
-            return nl + nlc, nr + nrc, sb, sg
+            if part_aux is not None:
+                ach = jax.lax.dynamic_slice(part_aux, (0, row0), (W, C))
+                acomp = jnp.take(ach.T, order, axis=0).T
+                sa = blend(blend(sa, acomp, start + nl, lmask), acomp,
+                           roff, rmask)
+            return nl + nlc, nr + nrc, sb, sg, sa
 
+        sa0 = sc_aux0 if sc_aux0 is not None else jnp.zeros((), jnp.int32)
         carry0 = self._pvary((jnp.int32(0), jnp.int32(0), st["sc_bins"],
-                              st["sc_ghi"]))
-        nl, nr, sb, sg = jax.lax.fori_loop(0, n_chunks, scatter_pass, carry0)
+                              st["sc_ghi"], sa0))
+        nl, nr, sb, sg, sa = jax.lax.fori_loop(
+            0, n_chunks, scatter_pass, carry0)
 
         def copyback(ci, carry):
-            pb, pg = carry
+            pb, pg, pa = carry
             row0 = start + ci * C
             valid = (ci * C + jax.lax.iota(jnp.int32, C)) < cnt
             pb = blend(pb, jax.lax.dynamic_slice(sb, (0, row0), (G, C)),
                        row0, valid)
             pg = blend(pg, jax.lax.dynamic_slice(sg, (0, row0), (3, C)),
                        row0, valid)
-            return pb, pg
+            if part_aux is not None:
+                pa = blend(pa, jax.lax.dynamic_slice(sa, (0, row0), (W, C)),
+                           row0, valid)
+            return pb, pg, pa
 
-        part_bins, part_ghi = jax.lax.fori_loop(
-            0, n_chunks, copyback, self._pvary((part_bins, part_ghi)))
+        pa0 = part_aux if part_aux is not None else jnp.zeros((), jnp.int32)
+        part_bins, part_ghi, part_aux = jax.lax.fori_loop(
+            0, n_chunks, copyback, self._pvary((part_bins, part_ghi, pa0)))
         moved = {
             "part_bins": part_bins,
             "part_ghi": part_ghi,
             "sc_bins": sb,
             "sc_ghi": sg,
         }
+        if self.aux_rows:
+            moved["part_aux"] = part_aux
+            moved["sc_aux"] = sa
         return moved, nl
 
     # ------------------------------------------------------------------
@@ -595,6 +626,49 @@ class SerialTreeLearner:
             "rout": split_ops.leaf_output(rg, rh, *args),
         }
 
+    def _lazy_counts(self, part_aux, start, l_cnt, r_cnt):
+        """(2, F) counts of rows whose feature bit is still 0 for the two
+        children ranges [start, start+l_cnt) and [start+l_cnt, +r_cnt)
+        (reference: the per-row feature-used tracking behind
+        cegb_penalty_feature_lazy, cost_effective_gradient_boosting.hpp)."""
+        C = self.row_chunk
+        W = self.aux_rows
+        F = self.F
+        cnt = l_cnt + r_cnt
+        n_chunks = (cnt + C - 1) // C
+
+        def body(ci, acc):
+            row0 = start + ci * C
+            ach = jax.lax.dynamic_slice(part_aux, (0, row0), (W, C))
+            pos = ci * C + jax.lax.iota(jnp.int32, C)
+            valid = pos < cnt
+            is_l = pos < l_cnt
+            bits = jnp.stack([(ach >> k) & 1 for k in range(32)], axis=1)
+            notused = 1 - bits.reshape(W * 32, C)[:F]          # (F, C)
+            accl = acc[0] + jnp.sum(notused * (valid & is_l), axis=1)
+            accr = acc[1] + jnp.sum(notused * (valid & ~is_l), axis=1)
+            return jnp.stack([accl, accr])
+
+        return jax.lax.fori_loop(0, n_chunks, body,
+                                 jnp.zeros((2, F), jnp.int32))
+
+    def _lazy_mark(self, part_aux, start, cnt, f_enum):
+        """Set the used-bit of ``f_enum`` for rows [start, start+cnt)
+        (reference: CostEfficientGradientBoosting::UpdateUsedFeatures)."""
+        C = self.row_chunk
+        word = f_enum // 32
+        bit = jnp.int32(1) << (f_enum % 32)
+        n_chunks = (cnt + C - 1) // C
+
+        def body(ci, pa):
+            row0 = start + ci * C
+            ach = jax.lax.dynamic_slice(pa, (word, row0), (1, C))
+            valid = ((ci * C + jax.lax.iota(jnp.int32, C)) < cnt)[None, :]
+            return jax.lax.dynamic_update_slice(
+                pa, jnp.where(valid, ach | bit, ach), (word, row0))
+
+        return jax.lax.fori_loop(0, n_chunks, body, part_aux)
+
     def _allowed_from_used(self, used):
         """Interaction constraints (reference: col_sampler.hpp GetByNode):
         a node may split on the union of all constraint sets that contain
@@ -612,7 +686,7 @@ class SerialTreeLearner:
 
     def _leaf_best_split(self, hist_group, sum_g, sum_h, cnt, local_cnt,
                          depth, cmin, cmax, parent_out, feature_mask,
-                         feat_used):
+                         feat_used, lazy_cnt=None):
         if self.F == 0:   # no usable features: every tree is a stub
             z = jnp.float32(0.0)
             zi = jnp.int32(0)
@@ -626,11 +700,11 @@ class SerialTreeLearner:
         if self.parallel_mode == "voting" and self.axis_name is not None:
             return self._leaf_best_split_voting(
                 hist_group, sum_g, sum_h, cnt, local_cnt, depth, cmin, cmax,
-                parent_out, feature_mask, feat_used)
+                parent_out, feature_mask, feat_used, lazy_cnt=lazy_cnt)
         feat_hist = self._feat_view(hist_group, sum_g, sum_h)
         best = self._find_best(feat_hist, sum_g, sum_h, cnt, depth,
                                cmin, cmax, feature_mask, feat_used=feat_used,
-                               parent_out=parent_out)
+                               parent_out=parent_out, lazy_cnt=lazy_cnt)
         return self._depth_guard(best, depth)
 
     def _feat_view(self, hist_group, sum_g, sum_h):
@@ -646,10 +720,14 @@ class SerialTreeLearner:
 
     def _find_best(self, feat_hist, sum_g, sum_h, cnt, depth, cmin, cmax,
                    feature_mask, feat_used=None, parent_out=None,
-                   with_feature_gains=False):
+                   with_feature_gains=False, lazy_cnt=None):
         cegb_delta = None
         if self.cegb_coupled is not None and feat_used is not None:
             cegb_delta = jnp.where(feat_used, 0.0, self.cegb_coupled)
+        if self.cegb_lazy is not None and lazy_cnt is not None:
+            lazy_term = self.cegb_lazy * lazy_cnt.astype(jnp.float32)
+            cegb_delta = (lazy_term if cegb_delta is None
+                          else cegb_delta + lazy_term)
         return split_ops.find_best_split(
             feat_hist, self.ctx, sum_g, sum_h, cnt,
             self.l1, self.l2, self.max_delta_step, self.min_gain_to_split,
@@ -718,11 +796,17 @@ class SerialTreeLearner:
         # re-run the split search for every changed leaf (the reference
         # recomputes exactly the affected set; computing all-under-mask is
         # the vectorized equivalent)
+        extra = ()
+        if self.cegb_lazy is not None:
+            # lazy counts are not re-derived on constraint refresh (the
+            # cegb-lazy x intermediate-monotone interplay is not modeled)
+            extra = (jnp.zeros((L, self.F), jnp.int32),)
         best = self._best_split_vmapped(
             st["hist"][:L], lm[LM_SUM_G, :L], lm[LM_SUM_H, :L],
             _f2i(lm[LM_CNT_G, :L]), _f2i(lm[LM_CNT, :L]),
             _f2i(lm[LM_DEPTH, :L]), newmin, newmax, lm[LM_VALUE, :L],
-            jnp.broadcast_to(feature_mask, (L, self.F)), st["feat_used"])
+            jnp.broadcast_to(feature_mask, (L, self.F)), st["feat_used"],
+            *extra)
         overlay = {
             LM_BGAIN: best.gain,
             LM_BFEAT: _i2f(best.feature),
@@ -744,7 +828,7 @@ class SerialTreeLearner:
 
     def _leaf_best_split_voting(self, hist_local, sum_g, sum_h, cnt,
                                 local_cnt, depth, cmin, cmax, parent_out,
-                                feature_mask, feat_used=None):
+                                feature_mask, feat_used=None, lazy_cnt=None):
         """PV-Tree voting split search (reference:
         voting_parallel_tree_learner.cpp): each device votes its top-k
         features by LOCAL gain, the global top-2k features are elected by
@@ -782,7 +866,8 @@ class SerialTreeLearner:
         feat_hist = self._feat_view(hist_glob, sum_g, sum_h)
         best = self._find_best(feat_hist, sum_g, sum_h, cnt, depth,
                                cmin, cmax, feature_mask & elected_mask,
-                               feat_used=feat_used, parent_out=parent_out)
+                               feat_used=feat_used, parent_out=parent_out,
+                               lazy_cnt=lazy_cnt)
         return self._depth_guard(best, depth)
 
     # ------------------------------------------------------------------
@@ -827,7 +912,7 @@ class SerialTreeLearner:
         return jax.tree.map(lambda a: a[winner], gathered)
 
     def _build_tree_impl(self, part_bins, grad_p, hess_p, rowid, bag_cnt,
-                         feature_mask, seed, feat_used_init=None):
+                         feature_mask, seed, feat_used_init=None, aux0=None):
         L, G, B, F = self.L, self.G, self.B, self.F
         nodes = self.max_splits
         rng0 = jax.random.PRNGKey(seed)
@@ -858,9 +943,18 @@ class SerialTreeLearner:
             if self.parallel_mode == "voting" else root_hist[0, :, 1].sum()
         neg_inf = jnp.float32(-jnp.inf)
         pos_inf = jnp.float32(jnp.inf)
+        lazy_extra = ()
+        if self.cegb_lazy is not None:
+            if aux0 is None:
+                aux0 = jnp.zeros((self.aux_rows, part_bins.shape[1]),
+                                 jnp.int32)
+            lazy_extra = (self._lazy_counts(
+                aux0, jnp.int32(self.row0), jnp.int32(self.N),
+                jnp.int32(0))[0],)
         best0 = self._sync_best(self._leaf_best_split(
             root_hist, sum_g, sum_h, bag_cnt_g, bag_cnt, jnp.int32(0),
-            neg_inf, pos_inf, jnp.float32(0.0), root_mask, feat_used0))
+            neg_inf, pos_inf, jnp.float32(0.0), root_mask, feat_used0,
+            *lazy_extra))
 
         # one TRASH slot is appended to every leaf/node-indexed buffer:
         # iterations whose split is invalid (stop, or an abandoned forced
@@ -908,6 +1002,10 @@ class SerialTreeLearner:
 
         if self.ic_masks is not None:
             state["leaf_used"] = jnp.zeros((L + 1, F), jnp.bool_)
+
+        if self.cegb_lazy is not None:
+            state["part_aux"] = aux0
+            state["sc_aux"] = jnp.zeros_like(aux0)
 
         if self.use_mc and self.mc_mode == "intermediate":
             # root box covers every bin of every used feature
@@ -1106,6 +1204,17 @@ class SerialTreeLearner:
                     mask_l = mask_l & self._bynode_mask(kl)
                     mask_r = mask_r & self._bynode_mask(kr)
 
+                lazy_pair = ()
+                if self.cegb_lazy is not None:
+                    # mark the split feature used for the leaf's rows FIRST
+                    # (children then see zero lazy penalty for it), then
+                    # count still-unused rows per feature for both children
+                    aux_m = self._lazy_mark(moved["part_aux"], start, cnt,
+                                            f_enum)
+                    upd["part_aux"] = aux_m
+                    lazy_pair = (self._lazy_counts(
+                        aux_m, start, left_cnt, cnt - left_cnt),)
+
                 both = self._best_split_vmapped(
                     jnp.stack([hist_left, hist_right]),
                     jnp.stack([lsg, rsg]), jnp.stack([lsh, rsh]),
@@ -1115,7 +1224,7 @@ class SerialTreeLearner:
                     jnp.stack([l_cmin, r_cmin]),
                     jnp.stack([l_cmax, r_cmax]),
                     jnp.stack([lout, rout]),
-                    jnp.stack([mask_l, mask_r]), feat_used_new)
+                    jnp.stack([mask_l, mask_r]), feat_used_new, *lazy_pair)
                 best_l = self._sync_best(jax.tree.map(lambda a: a[0], both))
                 best_r = self._sync_best(jax.tree.map(lambda a: a[1], both))
 
@@ -1244,13 +1353,14 @@ class SerialTreeLearner:
 
     # ------------------------------------------------------------------
     def _build_impl(self, part_bins0, grad, hess, bag_cnt, feature_mask,
-                    seed=jnp.int32(0), feat_used_init=None):
+                    seed=jnp.int32(0), feat_used_init=None, aux0=None):
         """Front/tail-pad the per-row arrays and run the tree loop.
 
         ``grad``/``hess`` are (N,) in ORIGINAL row order with out-of-bag rows
         already zeroed by the caller (bagging/GOSS never gather rows — TPU
         row gathers are latency-bound); ``bag_cnt`` is the in-bag row count
-        used for count estimation.
+        used for count estimation.  ``aux0`` is the model-lifetime cegb-lazy
+        used-feature bitset, (aux_rows, N) in ORIGINAL row order.
         """
         C = self.row0
         tail = self.N_pad - C - self.N
@@ -1258,13 +1368,22 @@ class SerialTreeLearner:
         hess_p = jnp.pad(hess, (C, tail))
         iota = jax.lax.iota(jnp.int32, self.N_pad)
         rowid = jnp.where((iota >= C) & (iota < C + self.N), iota - C, self.N)
+        if aux0 is not None:
+            aux0 = jnp.pad(aux0, ((0, 0), (C, tail)))
         return self._build_tree_impl(part_bins0, grad_p, hess_p, rowid,
                                      bag_cnt, feature_mask, seed,
-                                     feat_used_init)
+                                     feat_used_init, aux0)
+
+    def lazy_aux_to_original_order(self, rec) -> jnp.ndarray:
+        """Scatter the partitioned used-feature bitset back to original row
+        order (for carrying across boosting iterations)."""
+        idx = rec["indices"]
+        return jnp.zeros((self.aux_rows, self.N), jnp.int32).at[:, idx].set(
+            rec["part_aux"], mode="drop")
 
     def build_tree(self, grad, hess, bag_cnt=None,
                    feature_mask=None, seed: int = 0,
-                   feat_used=None) -> Dict[str, Any]:
+                   feat_used=None, lazy_aux=None) -> Dict[str, Any]:
         """Train one tree; returns the device state record."""
         if feature_mask is None:
             feature_mask = jnp.ones((self.F,), dtype=bool)
@@ -1274,8 +1393,11 @@ class SerialTreeLearner:
         hess = jnp.asarray(hess, dtype=jnp.float32)
         if bag_cnt is None:
             bag_cnt = self.N
+        if self.cegb_lazy is not None and lazy_aux is None:
+            lazy_aux = jnp.zeros((self.aux_rows, self.N), jnp.int32)
         return self._build(self._part0, grad, hess, jnp.int32(bag_cnt),
-                           feature_mask, jnp.int32(seed), feat_used)
+                           feature_mask, jnp.int32(seed), feat_used,
+                           lazy_aux)
 
     def node_arrays_for_predict(self, st: Dict[str, Any]) -> Dict[str, Any]:
         node = {

@@ -98,6 +98,35 @@ def test_cegb_coupled_feature_penalty_narrows_features():
     assert used <= {0}
 
 
+def test_cegb_lazy_feature_penalty_narrows_features():
+    """cegb_penalty_feature_lazy: a huge lazy penalty on features 1..5 means
+    only feature 0 is ever worth computing (reference: test_cegb — lazy
+    penalties scale with the number of rows that have not used the feature
+    yet)."""
+    X, y = _make_data(1000, 6, seed=2)
+    pen = "0.0," + ",".join(["1e6"] * 5)
+    bst = lgb.train({**BASE, "cegb_tradeoff": 1.0,
+                     "cegb_penalty_feature_lazy": pen},
+                    lgb.Dataset(X, label=y), num_boost_round=10)
+    used = set().union(*_used_features_per_tree(bst))
+    assert used <= {0}
+
+
+def test_cegb_lazy_penalty_changes_trees():
+    """A moderate lazy penalty must alter tree structure vs no penalty, and
+    the model must still learn."""
+    X, y = _make_data(1000, 6, seed=5)
+    bst_free = lgb.train(dict(BASE), lgb.Dataset(X, label=y),
+                         num_boost_round=10)
+    bst_lazy = lgb.train({**BASE, "cegb_tradeoff": 1.0,
+                          "cegb_penalty_feature_lazy":
+                              ",".join(["2.0"] * 6)},
+                         lgb.Dataset(X, label=y), num_boost_round=10)
+    assert bst_lazy.model_to_string() != bst_free.model_to_string()
+    pred = bst_lazy.predict(X)
+    assert np.mean((y - pred) ** 2) < 0.9 * np.var(y)
+
+
 def test_feature_fraction_bynode_trains():
     X, y = _make_data(1000, 10, seed=4)
     bst = lgb.train({**BASE, "feature_fraction_bynode": 0.5},
