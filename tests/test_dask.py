@@ -1,0 +1,132 @@
+"""Dask wrapper tests (reference model: tests/python_package_test/test_dask.py).
+
+dask is not bundled in this image, so the orchestration logic is exercised
+with lightweight fakes implementing the small client/collection surface the
+wrapper uses; real-dask tests run when dask.distributed is installed.
+"""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu.dask as lgb_dask
+from lightgbm_tpu.dask import (DASK_INSTALLED, DaskLGBMClassifier,
+                               DaskLGBMRegressor, _concat_parts)
+
+
+def test_import_without_dask_and_clear_error():
+    est = DaskLGBMRegressor(n_estimators=5)
+    if not DASK_INSTALLED:
+        with pytest.raises(ImportError, match="dask"):
+            est.fit(object(), object())
+
+
+def test_concat_parts():
+    a = np.arange(6).reshape(3, 2)
+    b = np.arange(6, 12).reshape(3, 2)
+    out = _concat_parts([a, b])
+    assert out.shape == (6, 2)
+    v = _concat_parts([np.arange(3), np.arange(3, 5)])
+    np.testing.assert_array_equal(v, np.arange(5))
+
+
+class _FakeFuture:
+    def __init__(self, value, key, worker):
+        self._v = value
+        self.key = key
+        self.worker = worker
+
+    def result(self):
+        return self._v
+
+
+class _FakeClient:
+    def __init__(self, nparts):
+        self.nparts = nparts
+
+    def compute(self, parts):
+        return [_FakeFuture(p._value, f"k{i}", f"w{i % 2}")
+                for i, p in enumerate(parts)]
+
+    def who_has(self, futures):
+        return {f.key: (f.worker,) for f in futures}
+
+    def scheduler_info(self):
+        return {"workers": {"w0": {}, "w1": {}}}
+
+
+class _FakeDelayed:
+    def __init__(self, value):
+        self._value = value
+
+
+class _FakeArray:
+    """Duck-types the slice of the dask.array API the wrapper touches."""
+
+    def __init__(self, arr, nparts=4):
+        self._arr = np.asarray(arr)
+        self.dask = {}
+        self.ndim = self._arr.ndim
+        self._parts = np.array_split(self._arr, nparts, axis=0)
+
+    def to_delayed(self):
+        return np.asarray([_FakeDelayed(p) for p in self._parts],
+                          dtype=object)
+
+    def compute(self):
+        return self._arr
+
+    def map_blocks(self, fn, **_kwargs):
+        return np.concatenate([np.asarray(fn(p)).reshape(-1)
+                               for p in self._parts])
+
+
+@pytest.fixture
+def fake_dask(monkeypatch):
+    monkeypatch.setattr(lgb_dask, "DASK_INSTALLED", True)
+    monkeypatch.setattr(lgb_dask, "default_client", lambda: _FakeClient(4))
+    monkeypatch.setattr(lgb_dask, "wait", lambda futures: None)
+
+
+def _make_data(n=600, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, 5))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.int32)
+    return X, y
+
+
+def test_fake_dask_classifier_roundtrip(fake_dask):
+    X, y = _make_data()
+    dX, dy = _FakeArray(X), _FakeArray(y)
+    est = DaskLGBMClassifier(n_estimators=10, num_leaves=15, verbosity=-1)
+    est.fit(dX, dy, client=_FakeClient(4))
+    pred = est.predict(_FakeArray(X))
+    assert pred.shape == (len(y),)
+    assert np.mean(pred == y) > 0.9
+    # to_local returns a plain estimator that predicts identically
+    local = est.to_local()
+    np.testing.assert_allclose(local.predict(X), pred)
+
+
+def test_fake_dask_regressor(fake_dask):
+    X, y = _make_data()
+    yr = X[:, 0] * 2.0 + X[:, 2]
+    est = DaskLGBMRegressor(n_estimators=15, num_leaves=15, verbosity=-1)
+    est.fit(_FakeArray(X), _FakeArray(yr), client=_FakeClient(4))
+    pred = est.predict(_FakeArray(X))
+    assert np.mean((pred - yr) ** 2) < 0.3 * np.var(yr)
+
+
+@pytest.mark.skipif(not DASK_INSTALLED, reason="dask not installed")
+def test_real_dask_roundtrip():
+    import dask.array as da
+    from distributed import Client, LocalCluster
+    X, y = _make_data()
+    with LocalCluster(n_workers=2, threads_per_worker=1,
+                      processes=False) as cluster, Client(cluster) as client:
+        dX = da.from_array(X, chunks=(150, 5))
+        dy = da.from_array(y, chunks=(150,))
+        est = DaskLGBMClassifier(n_estimators=10, num_leaves=15,
+                                 verbosity=-1)
+        est.fit(dX, dy, client=client)
+        pred = np.asarray(est.predict(dX))
+        assert np.mean(pred == y) > 0.9
