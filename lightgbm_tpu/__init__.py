@@ -40,12 +40,15 @@ __all__ = [
     "EarlyStopException",
     "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
     "plot_importance", "plot_metric", "plot_tree", "create_tree_digraph",
-    "plot_split_value_histogram",
+    "plot_split_value_histogram", "register_logger",
 ]
 
 
 def __getattr__(name):
     # lazy imports to keep base import light
+    if name == "register_logger":
+        from .utils.log import register_logger
+        return register_logger
     if name in ("LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker"):
         from . import sklearn as _sk
         return getattr(_sk, name)

@@ -41,6 +41,28 @@ def register_callback(cb: Optional[Callable[[str], None]]) -> None:
     _callback = cb
 
 
+def register_logger(logger, info_method_name: str = "info",
+                    warning_method_name: str = "warning") -> None:
+    """Route all framework output through a `logging.Logger`-like object
+    (reference: lightgbm.register_logger, basic.py:134-180)."""
+    if not callable(getattr(logger, info_method_name, None)) or \
+            not callable(getattr(logger, warning_method_name, None)):
+        raise TypeError(
+            f"logger must provide callable {info_method_name}() and "
+            f"{warning_method_name}() methods")
+    info_fn = getattr(logger, info_method_name)
+    warn_fn = getattr(logger, warning_method_name)
+
+    def _cb(msg: str) -> None:
+        text = msg.rstrip("\n")
+        if "[Warning]" in text or "[Fatal]" in text:
+            warn_fn(text)
+        else:
+            info_fn(text)
+
+    register_callback(_cb)
+
+
 def _emit(msg: str) -> None:
     if _callback is not None:
         _callback(msg + "\n")
