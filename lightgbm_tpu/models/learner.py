@@ -205,6 +205,20 @@ class SerialTreeLearner:
         self.f_bin_start = jnp.asarray(meta["bin_start"])
         self.f_is_bundled = jnp.asarray(is_bundled)
         self.has_categorical = bool(np.any(meta["is_categorical"]))
+        # per-feature metadata packed as COLUMNS of one matrix so the hot
+        # loop reads all of a feature's scalars with one lane-dynamic slice
+        # (rows: feature_index, group, bin_start, is_bundled, num_bin,
+        # default_bin, missing_type, monotone — see body unpack)
+        self._fmeta_np = np.stack([
+            np.asarray(meta["feature"], np.int32),
+            np.asarray(grp, np.int32),
+            np.asarray(meta["bin_start"], np.int32),
+            is_bundled.astype(np.int32),
+            np.asarray(meta["num_bin"], np.int32),
+            np.asarray(meta["default_bin"], np.int32),
+            np.asarray(meta["missing_type"], np.int32),
+            np.zeros(self.F, np.int32),   # monotone filled below
+        ]) if self.F else np.zeros((8, 1), np.int32)
 
         # ---- monotone constraints ----
         mono_all = parse_monotone_constraints(
@@ -227,6 +241,9 @@ class SerialTreeLearner:
             self.mc_mode = "intermediate"
             self.mono_enums = [int(i) for i in np.where(mono_used != 0)[0]]
             self.mono_signs = [int(mono_used[i]) for i in self.mono_enums]
+        if self.F:
+            self._fmeta_np[7] = mono_used
+        self._fmeta = jnp.asarray(self._fmeta_np)
         # ---- interaction constraints ----
         ic = parse_interaction_constraints(
             config.interaction_constraints, dataset.num_total_features)
@@ -458,13 +475,16 @@ class SerialTreeLearner:
         sc_aux0 = st.get("sc_aux")
         W = self.aux_rows
 
+        col_onehot = (jax.lax.iota(jnp.int32, self.G) == col)[:, None]
+
         def scatter_pass(ci, carry):
             nl, nr, sb, sg, sa = carry
             row0 = start + ci * C
             bch = jax.lax.dynamic_slice(part_bins, (0, row0), (G, C))
             gch = jax.lax.dynamic_slice(part_ghi, (0, row0), (3, C))
-            colv = jax.lax.dynamic_slice(
-                bch, (col, jnp.int32(0)), (1, C))[0].astype(jnp.int32)
+            # split-column extraction via masked reduction: a dynamic_slice
+            # with a runtime SUBLANE offset lowers to a slow per-tile path
+            colv = jnp.sum(bch.astype(jnp.int32) * col_onehot, axis=0)
             valid = (ci * C + jax.lax.iota(jnp.int32, C)) < cnt
             gl = self._goes_left(colv, decision_scalars) & valid
             gr = valid & ~gl
@@ -491,8 +511,12 @@ class SerialTreeLearner:
             # buffers only ever see contiguous (G, C) window slices/updates,
             # so their row-major (G, N) layout is never contested; the
             # transposes are VMEM-local tile shuffles
-            bcomp = jnp.take(bch.T, order, axis=0).T     # (G, C)
-            gcomp = jnp.take(gch.T, order, axis=0).T     # (3, C)
+            both32 = jnp.concatenate(
+                [bch.astype(jnp.int32),
+                 jax.lax.bitcast_convert_type(gch, jnp.int32)], axis=0)
+            bothc = jnp.take(both32, order, axis=1)
+            bcomp = bothc[:G].astype(part_bins.dtype)
+            gcomp = jax.lax.bitcast_convert_type(bothc[G:], jnp.float32)
             iot = jax.lax.iota(jnp.int32, C)
             lmask = iot < nlc
             # rights window [start+cnt-nr-C, +C), mask last nrc rows; the
@@ -656,16 +680,20 @@ class SerialTreeLearner:
         """Set the used-bit of ``f_enum`` for rows [start, start+cnt)
         (reference: CostEfficientGradientBoosting::UpdateUsedFeatures)."""
         C = self.row_chunk
-        word = f_enum // 32
-        bit = jnp.int32(1) << (f_enum % 32)
+        W = self.aux_rows
+        # OR the bit into the matching word row via a broadcast mask — a
+        # dynamic_slice with a runtime SUBLANE offset lowers to a slow
+        # per-tile path
+        word_mask = (jax.lax.iota(jnp.int32, W) == f_enum // 32)[:, None]
+        bit = (jnp.int32(1) << (f_enum % 32)) * word_mask       # (W, 1)
         n_chunks = (cnt + C - 1) // C
 
         def body(ci, pa):
             row0 = start + ci * C
-            ach = jax.lax.dynamic_slice(pa, (word, row0), (1, C))
+            ach = jax.lax.dynamic_slice(pa, (0, row0), (W, C))
             valid = ((ci * C + jax.lax.iota(jnp.int32, C)) < cnt)[None, :]
             return jax.lax.dynamic_update_slice(
-                pa, jnp.where(valid, ach | bit, ach), (word, row0))
+                pa, jnp.where(valid, ach | bit, ach), (0, row0))
 
         return jax.lax.fori_loop(0, n_chunks, body, part_aux)
 
@@ -1072,7 +1100,13 @@ class SerialTreeLearner:
                 thr = _f2i(pcol[LM_BTHR])
                 dl = pcol[LM_BDL] > 0.5
                 is_cat = pcol[LM_BISCAT] > 0.5
-                cat_set = st["best_cat_set"][best_leaf]
+                # row reads/writes on (L, ...) matrices use masked
+                # reductions/selects: dynamic indexing on the SUBLANE axis
+                # lowers to a slow per-tile path (~80us per occurrence,
+                # measured; the masked forms are plain VPU passes)
+                bl_oh = jax.lax.iota(jnp.int32, L + 1) == best_leaf
+                cat_set = jnp.any(st["best_cat_set"] & bl_oh[:, None],
+                                  axis=0)
                 if forced_info is not None:
                     f_enum = jnp.where(forced_ok,
                                        self.forced["feature"][forced_node],
@@ -1082,12 +1116,13 @@ class SerialTreeLearner:
                     is_cat = jnp.where(forced_ok, False, is_cat)
                     cat_set = jnp.where(forced_ok,
                                         jnp.zeros_like(cat_set), cat_set)
-                col = self.f_group[f_enum]
-                bstart = self.f_bin_start[f_enum]
-                isb = self.f_is_bundled[f_enum]
-                nb = self.ctx.num_bin[f_enum]
-                dbin = self.ctx.default_bin[f_enum]
-                mtype = self.ctx.missing_type[f_enum]
+                # ONE lane-dynamic column slice replaces ~8 scalar
+                # dynamic-indexes into the per-feature metadata vectors
+                fcolm = jax.lax.dynamic_slice(
+                    self._fmeta, (0, f_enum), (self._fmeta.shape[0], 1))[:, 0]
+                (orig_feat, col, bstart, isb, nb, dbin, mtype,
+                 mono_f) = (fcolm[0], fcolm[1], fcolm[2], fcolm[3],
+                            fcolm[4], fcolm[5], fcolm[6], fcolm[7])
                 start = _f2i(pcol[LM_START])
                 cnt = jnp.where(valid, _f2i(pcol[LM_CNT]), 0)
                 cnt_g = _f2i(pcol[LM_CNT_G])
@@ -1145,7 +1180,6 @@ class SerialTreeLearner:
                 p_cmin = pcol[LM_CMIN]
                 p_cmax = pcol[LM_CMAX]
                 if self.use_mc:
-                    mono_f = self.monotone[f_enum]
                     mid = (lout + rout) * 0.5
                     num_split = ~is_cat
                     l_cmin = jnp.where(num_split & (mono_f < 0),
@@ -1162,9 +1196,11 @@ class SerialTreeLearner:
 
                 # record the internal node (reference: Tree::Split, tree.cpp)
                 upd = dict(moved)
-                upd["node_cat_set"] = st["node_cat_set"].at[wr_s].set(cat_set)
+                upd["node_cat_set"] = jnp.where(
+                    (jax.lax.iota(jnp.int32, nodes + 1) == wr_s)[:, None],
+                    cat_set[None, :], st["node_cat_set"])
                 ncol = jnp.stack([
-                    _i2f(self.ctx.feature_index[f_enum]), _i2f(f_enum),
+                    _i2f(orig_feat), _i2f(f_enum),
                     _i2f(thr), dl.astype(jnp.float32), gain,
                     _i2f(-(best_leaf + 1)), _i2f(-(new_leaf + 1)),
                     pcol[LM_VALUE], pcol[LM_SUM_H], _i2f(cnt_g),
@@ -1194,7 +1230,8 @@ class SerialTreeLearner:
                                  if self.has_cegb else st["feat_used"])
                 mask_l = mask_r = feature_mask
                 if self.ic_masks is not None:
-                    used_child = st["leaf_used"][best_leaf] | f_onehot
+                    used_child = jnp.any(
+                        st["leaf_used"] & bl_oh[:, None], axis=0) | f_onehot
                     allowed = self._allowed_from_used(used_child)
                     mask_l = mask_l & allowed
                     mask_r = mask_r & allowed
@@ -1258,9 +1295,11 @@ class SerialTreeLearner:
                                   rout, r_cmin, r_cmax, 1, best_r, forced_r)
                 lm2 = lm.at[:, wr_a].set(col_l).at[:, wr_b].set(col_r)
 
-                new_cat = st["best_cat_set"] \
-                    .at[wr_a].set(best_l.cat_set) \
-                    .at[wr_b].set(best_r.cat_set)
+                iot_l1 = jax.lax.iota(jnp.int32, L + 1)
+                new_cat = jnp.where(
+                    (iot_l1 == wr_a)[:, None], best_l.cat_set[None, :],
+                    jnp.where((iot_l1 == wr_b)[:, None],
+                              best_r.cat_set[None, :], st["best_cat_set"]))
                 upd.update({
                     "s": s + valid.astype(jnp.int32),
                     "done": ~valid & ~skip_pending,
@@ -1268,9 +1307,9 @@ class SerialTreeLearner:
                     "leafmat": lm2,
                     "feat_used": jnp.where(valid, feat_used_new,
                                            st["feat_used"]),
-                    **({"leaf_used": st["leaf_used"]
-                        .at[wr_a].set(used_child)
-                        .at[wr_b].set(used_child)}
+                    **({"leaf_used": jnp.where(
+                        ((iot_l1 == wr_a) | (iot_l1 == wr_b))[:, None],
+                        used_child[None, :], st["leaf_used"])}
                        if self.ic_masks is not None else {}),
                     "best_cat_set": new_cat,
                 })
@@ -1278,8 +1317,10 @@ class SerialTreeLearner:
                     # per-leaf bin-range boxes: children inherit the parent
                     # box, tightened along the split feature for numerical
                     # splits (categorical boxes stay whole — conservative)
-                    prow_lo = st["leaf_lo"][best_leaf]
-                    prow_hi = st["leaf_hi"][best_leaf]
+                    prow_lo = jnp.max(
+                        jnp.where(bl_oh[:, None], st["leaf_lo"], 0), axis=0)
+                    prow_hi = jnp.max(
+                        jnp.where(bl_oh[:, None], st["leaf_hi"], 0), axis=0)
                     f1h = jax.lax.broadcasted_iota(
                         jnp.int32, (F,), 0) == f_enum
                     tighten = f1h & ~is_cat
@@ -1287,10 +1328,14 @@ class SerialTreeLearner:
                                      prow_hi)
                     r_lo = jnp.where(tighten, jnp.maximum(prow_lo, thr + 1),
                                      prow_lo)
-                    leaf_lo = st["leaf_lo"].at[wr_a].set(prow_lo) \
-                                           .at[wr_b].set(r_lo)
-                    leaf_hi = st["leaf_hi"].at[wr_a].set(l_hi) \
-                                           .at[wr_b].set(prow_hi)
+                    leaf_lo = jnp.where(
+                        (iot_l1 == wr_a)[:, None], prow_lo[None, :],
+                        jnp.where((iot_l1 == wr_b)[:, None], r_lo[None, :],
+                                  st["leaf_lo"]))
+                    leaf_hi = jnp.where(
+                        (iot_l1 == wr_a)[:, None], l_hi[None, :],
+                        jnp.where((iot_l1 == wr_b)[:, None],
+                                  prow_hi[None, :], st["leaf_hi"]))
                     upd["leaf_lo"] = leaf_lo
                     upd["leaf_hi"] = leaf_hi
                     st2 = {**st, **upd}
