@@ -50,15 +50,24 @@ def main():
     ds = lgb.Dataset(X, label=y)
     ds.construct(params)
 
+    import jax.numpy as jnp
+
+    def sync():
+        # a host materialization is the only reliable completion barrier on
+        # remote-attached TPUs (block_until_ready returns early there)
+        return float(jnp.sum(bst._gbdt.scores))
+
     # warmup: compile the tree builder (1 iteration)
     bst = lgb.Booster(params=params, train_set=ds)
     t0 = time.time()
     bst.update()
+    sync()
     warm = time.time() - t0
 
     t0 = time.time()
     for _ in range(ITERS):
         bst.update()
+    sync()
     wall = time.time() - t0
     per_iter = wall / ITERS
 
