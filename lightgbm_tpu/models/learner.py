@@ -478,7 +478,7 @@ class SerialTreeLearner:
         col_onehot = (jax.lax.iota(jnp.int32, self.G) == col)[:, None]
 
         def scatter_pass(ci, carry):
-            nl, nr, sb, sg, sa = carry
+            nl, nr, sc, sa = carry
             row0 = start + ci * C
             bch = jax.lax.dynamic_slice(part_bins, (0, row0), (G, C))
             gch = jax.lax.dynamic_slice(part_ghi, (0, row0), (3, C))
@@ -514,37 +514,36 @@ class SerialTreeLearner:
             both32 = jnp.concatenate(
                 [bch.astype(jnp.int32),
                  jax.lax.bitcast_convert_type(gch, jnp.int32)], axis=0)
-            bothc = jnp.take(both32, order, axis=1)
-            bcomp = bothc[:G].astype(part_bins.dtype)
-            gcomp = jax.lax.bitcast_convert_type(bothc[G:], jnp.float32)
+            bothc = jnp.take(both32, order, axis=1)      # (G+3, C)
             iot = jax.lax.iota(jnp.int32, C)
             lmask = iot < nlc
             # rights window [start+cnt-nr-C, +C), mask last nrc rows; the
             # front pad rows of the arrays keep this offset non-negative
             rmask = iot >= C - nrc
             roff = start + cnt - nr - C
-            sb = blend(blend(sb, bcomp, start + nl, lmask), bcomp, roff, rmask)
-            sg = blend(blend(sg, gcomp, start + nl, lmask), gcomp, roff, rmask)
+            # the fused (G+3) i32 block feeds ONE scratch, halving the
+            # masked window writes; rows split back only at copy-back
+            sc = blend(blend(sc, bothc, start + nl, lmask), bothc, roff,
+                       rmask)
             if part_aux is not None:
                 ach = jax.lax.dynamic_slice(part_aux, (0, row0), (W, C))
-                acomp = jnp.take(ach.T, order, axis=0).T
+                acomp = jnp.take(ach, order, axis=1)
                 sa = blend(blend(sa, acomp, start + nl, lmask), acomp,
                            roff, rmask)
-            return nl + nlc, nr + nrc, sb, sg, sa
+            return nl + nlc, nr + nrc, sc, sa
 
         sa0 = sc_aux0 if sc_aux0 is not None else jnp.zeros((), jnp.int32)
-        carry0 = self._pvary((jnp.int32(0), jnp.int32(0), st["sc_bins"],
-                              st["sc_ghi"], sa0))
-        nl, nr, sb, sg, sa = jax.lax.fori_loop(
+        carry0 = self._pvary((jnp.int32(0), jnp.int32(0), st["sc32"], sa0))
+        nl, nr, sc, sa = jax.lax.fori_loop(
             0, n_chunks, scatter_pass, carry0)
 
         def copyback(ci, carry):
             pb, pg, pa = carry
             row0 = start + ci * C
             valid = (ci * C + jax.lax.iota(jnp.int32, C)) < cnt
-            pb = blend(pb, jax.lax.dynamic_slice(sb, (0, row0), (G, C)),
-                       row0, valid)
-            pg = blend(pg, jax.lax.dynamic_slice(sg, (0, row0), (3, C)),
+            win = jax.lax.dynamic_slice(sc, (0, row0), (G + 3, C))
+            pb = blend(pb, win[:G].astype(pb.dtype), row0, valid)
+            pg = blend(pg, jax.lax.bitcast_convert_type(win[G:], jnp.float32),
                        row0, valid)
             if part_aux is not None:
                 pa = blend(pa, jax.lax.dynamic_slice(sa, (0, row0), (W, C)),
@@ -557,8 +556,7 @@ class SerialTreeLearner:
         moved = {
             "part_bins": part_bins,
             "part_ghi": part_ghi,
-            "sc_bins": sb,
-            "sc_ghi": sg,
+            "sc32": sc,
         }
         if self.aux_rows:
             moved["part_aux"] = part_aux
@@ -1016,8 +1014,7 @@ class SerialTreeLearner:
             "done": jnp.bool_(False),
             "part_bins": part_bins,
             "part_ghi": part_ghi0,
-            "sc_bins": jnp.zeros_like(part_bins),
-            "sc_ghi": jnp.zeros((3, part_bins.shape[1]), jnp.float32),
+            "sc32": jnp.zeros((G + 3, part_bins.shape[1]), jnp.int32),
             "hist": jnp.zeros((L + 1, G, B, 2),
                               dtype=jnp.float32).at[0].set(root_hist),
             "leafmat": leafmat,

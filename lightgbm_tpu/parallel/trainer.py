@@ -130,7 +130,7 @@ class ShardedTreeBuilder:
             # (L, G, B, 2) tensor would cost a full all-reduce per tree)
             rec = {k: v for k, v in rec.items()
                    if k not in ("indices", "part_bins", "part_grad",
-                                "part_hess", "part_ghi", "sc_bins", "sc_ghi",
+                                "part_hess", "part_ghi", "sc32",
                                 "part_aux", "sc_aux",
                                 "leaf_start", "leaf_cnt", "hist")}
 
