@@ -226,6 +226,7 @@ _PARAMS: List[_Param] = [
     # --- TPU-specific (new in this framework) ---
     _p("tpu_hist_dtype", "float32", str),       # float32 | bfloat16_pair
     _p("tpu_hist_kernel", "xla", str),          # xla | pallas
+    _p("tpu_partition_kernel", "pallas", str),  # pallas | xla
     _p("tpu_row_chunk", 8192, int, (), ">0"),   # rows per histogram matmul chunk
     _p("tpu_feature_block", 64, int, (), ">0"),  # feature groups per histogram block
     _p("tpu_min_bucket_log2", 10, int, (), ">=0"),  # smallest partition bucket

@@ -136,7 +136,8 @@ class GBDT:
         binned_host = train_data.binned
         if binned_host is None or binned_host.shape[1] < self.learner.G:
             self.train_binned = self.learner._part0[
-                :, self.learner.row0: self.learner.row0 + self.num_data].T
+                :self.learner.G,
+                self.learner.row0: self.learner.row0 + self.num_data].T
         else:
             self.train_binned = jnp.asarray(binned_host)
 

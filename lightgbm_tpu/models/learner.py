@@ -364,6 +364,51 @@ class SerialTreeLearner:
                             str(exc).split("\n")[0][:120])
                 self._use_pallas = False
 
+        # ---- Pallas partition kernel ----
+        # The leaf partition dominates the tree build in the XLA
+        # formulation (window ops on few-sublane shapes run at 12-16 GB/s
+        # on this stack, see PERF.md); the Pallas kernel
+        # (ops/partition_pallas.py) streams aligned window DMAs at
+        # ~360 GB/s with in-VMEM shift-network compaction (~4 ms per 1M
+        # rows vs ~500 ms).  Falls back to the XLA path off-TPU, for
+        # categorical splits / cegb-lazy payloads (not yet kernelized),
+        # and when the probe-compile fails.  DMA tiling requires
+        # sublane-padded row buffers: bins to a multiple of 32 (u8 tile),
+        # grad/hess/rowid to 8 f32 rows.
+        self._use_pallas_part = (
+            jax.default_backend() == "tpu"
+            and config.tpu_partition_kernel == "pallas"
+            and not self.has_categorical
+            and self.cegb_lazy is None
+            and parallel_mode == "serial"
+            and self.F > 0
+            and dataset.binned is not None
+            and dataset.binned.dtype == np.uint8)
+        self._pb_rows = self.G
+        self._ghi_rows = 3
+        if self._use_pallas_part:
+            try:
+                from ..ops.partition_pallas import (partition_leaf_pallas,
+                                                    make_scalars)
+                g32 = ((self.G + 31) // 32) * 32
+                cpr = self.row_chunk
+                tiny = 4 * cpr
+                out = partition_leaf_pallas(
+                    jnp.zeros((g32, tiny), jnp.uint8),
+                    jnp.zeros((8, tiny), jnp.float32),
+                    jnp.zeros((g32, tiny), jnp.uint8),
+                    jnp.zeros((8, tiny), jnp.float32),
+                    make_scalars(cpr, cpr, 0, 0, 0, 255, 0, 0, 128, 0),
+                    row_chunk=cpr)
+                jax.block_until_ready(out)
+                self._pb_rows = g32
+                self._ghi_rows = 8
+            except Exception as exc:
+                log.warning("tpu_partition_kernel=pallas unavailable "
+                            "(%s); using the XLA partition",
+                            str(exc).split("\n")[0][:120])
+                self._use_pallas_part = False
+
         # Row layout: the binned matrix TRANSPOSED to (G, N_pad) in its
         # native bin dtype, plus a packed (3, N_pad) grad/hess/rowid matrix.
         # Rows live on the MINOR (lane) axis: in (N, G) orientation XLA's
@@ -381,8 +426,8 @@ class SerialTreeLearner:
             binned = np.ascontiguousarray(dataset.binned)
             if binned.shape[1] < self.G:   # zero usable features
                 binned = np.zeros((binned.shape[0], self.G), binned.dtype)
-            pad = np.zeros((self.G, self.N_pad), binned.dtype)
-            pad[:, C:C + self.N] = binned.T
+            pad = np.zeros((self._pb_rows, self.N_pad), binned.dtype)
+            pad[:self.G, C:C + self.N] = binned.T
             self._part0 = jnp.asarray(pad)
 
         # ---- scalars ----
@@ -408,10 +453,11 @@ class SerialTreeLearner:
         if self._use_pallas:
             return leaf_hist_pallas(part_bins, part_ghi[0], part_ghi[1],
                                     start, cnt, num_bins=self.B,
-                                    row_chunk=self.row_chunk)
+                                    row_chunk=self.row_chunk,
+                                    num_groups=self.G)
         return leaf_hist_slice(part_bins, part_ghi, start, cnt,
                                num_bins=self.B, row_chunk=self.row_chunk,
-                               vary=self._pvary)
+                               vary=self._pvary, num_groups=self.G)
 
     def _goes_left(self, colv, scalars):
         """Per-row decision from raw group-column values.
@@ -454,6 +500,9 @@ class SerialTreeLearner:
         measured ~1.7x SLOWER end-to-end: the read-modify-write hazard on
         the loop-carried row buffers defeats XLA's in-place scheduling.)
         """
+        if self._use_pallas_part:
+            return self._partition_leaf_pallas(st, start, cnt, col,
+                                               decision_scalars)
         C = self.row_chunk
         G = self.G
         part_bins = st["part_bins"]
@@ -562,6 +611,23 @@ class SerialTreeLearner:
             moved["part_aux"] = part_aux
             moved["sc_aux"] = sa
         return moved, nl
+
+    def _partition_leaf_pallas(self, st, start, cnt, col, decision_scalars):
+        """Pallas-kernel leaf partition (see ops/partition_pallas.py):
+        bit-identical layout to the XLA path above at ~30x lower cost on
+        this stack."""
+        from ..ops.partition_pallas import (partition_leaf_pallas,
+                                            make_scalars)
+        bstart, isb, nb, dbin, mtype, thr, dl, is_cat, cat_set = \
+            decision_scalars
+        scalars = make_scalars(start, cnt, col, bstart, isb, nb, dbin,
+                               mtype, thr, dl)
+        pb, pg, sb, sg, nl = partition_leaf_pallas(
+            st["part_bins"], st["part_ghi"], st["sc_bins"], st["sc_ghi"],
+            scalars, row_chunk=self.row_chunk)
+        moved = {"part_bins": pb, "part_ghi": pg,
+                 "sc_bins": sb, "sc_ghi": sg}
+        return moved, nl[0, 0]
 
     # ------------------------------------------------------------------
     def _load_forced_splits(self, filename, dataset, meta):
@@ -959,6 +1025,11 @@ class SerialTreeLearner:
         part_ghi0 = jnp.stack(
             [grad_p, hess_p,
              jax.lax.bitcast_convert_type(rowid, jnp.float32)], axis=0)
+        if self._ghi_rows > 3:    # sublane pad for the Pallas DMA tiling
+            part_ghi0 = jnp.concatenate(
+                [part_ghi0, jnp.zeros((self._ghi_rows - 3,
+                                       part_ghi0.shape[1]), jnp.float32)],
+                axis=0)
         root_hist = self._psum(self._hist_leaf(
             part_bins, part_ghi0, jnp.int32(self.row0), jnp.int32(self.N)))
         bag_cnt_g = self._psum_scalar(bag_cnt)
@@ -1014,7 +1085,6 @@ class SerialTreeLearner:
             "done": jnp.bool_(False),
             "part_bins": part_bins,
             "part_ghi": part_ghi0,
-            "sc32": jnp.zeros((G + 3, part_bins.shape[1]), jnp.int32),
             "hist": jnp.zeros((L + 1, G, B, 2),
                               dtype=jnp.float32).at[0].set(root_hist),
             "leafmat": leafmat,
@@ -1024,6 +1094,12 @@ class SerialTreeLearner:
                 best0.cat_set),
             "node_cat_set": jnp.zeros((nodes + 1, self.BF), jnp.bool_),
         }
+        if self._use_pallas_part:
+            state["sc_bins"] = jnp.zeros(part_bins.shape, part_bins.dtype)
+            state["sc_ghi"] = jnp.zeros(part_ghi0.shape, jnp.float32)
+        else:
+            state["sc32"] = jnp.zeros((G + 3, part_bins.shape[1]),
+                                      jnp.int32)
 
         if self.ic_masks is not None:
             state["leaf_used"] = jnp.zeros((L + 1, F), jnp.bool_)

@@ -43,7 +43,8 @@ import numpy as np
 
 def leaf_hist_slice(part_bins, part_ghi, start, cnt, *,
                     num_bins: int, row_chunk: int,
-                    gblock: int = 0, dtype=jnp.float32, vary=lambda x: x):
+                    gblock: int = 0, dtype=jnp.float32, vary=lambda x: x,
+                    num_groups: int = 0):
     """(G, B, 2) histogram of the contiguous partitioned rows
     [start, start+cnt) of the (G, N_pad) binned matrix with matching
     (>=2, N_pad) packed (grad, hess, ...) rows; rows beyond ``cnt``
@@ -60,6 +61,8 @@ def leaf_hist_slice(part_bins, part_ghi, start, cnt, *,
     (cuda_histogram_constructor.cu).
     """
     G, Np = part_bins.shape
+    if num_groups:      # buffer may be sublane-padded for the Pallas
+        G = num_groups  # partition kernel's DMA tiling; ignore pad rows
     C = row_chunk
     B = num_bins
     BH = (B + 15) // 16          # high-digit cardinality
@@ -117,9 +120,10 @@ def leaf_hist_slice(part_bins, part_ghi, start, cnt, *,
 # ----------------------------------------------------------------------
 
 @functools.partial(jax.jit, static_argnames=("num_bins", "row_chunk",
-                                             "use_bf16"))
+                                             "use_bf16", "num_groups"))
 def leaf_hist_pallas(part_bins, grad_p, hess_p, start, cnt, *,
-                     num_bins: int, row_chunk: int, use_bf16: bool = False):
+                     num_bins: int, row_chunk: int, use_bf16: bool = False,
+                     num_groups: int = 0):
     """Same contract as ``leaf_hist_slice`` (transposed (G, N_pad) binned
     input), as one Pallas kernel.
 
@@ -133,7 +137,8 @@ def leaf_hist_pallas(part_bins, grad_p, hess_p, start, cnt, *,
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    G, Np = part_bins.shape
+    Gbuf, Np = part_bins.shape       # buffer rows (may be sublane-padded)
+    G = num_groups or Gbuf           # real feature groups in the output
     C = row_chunk
     B = num_bins
     B128 = ((B + 127) // 128) * 128
@@ -199,7 +204,7 @@ def leaf_hist_pallas(part_bins, grad_p, hess_p, start, cnt, *,
         in_specs=[pl.BlockSpec(memory_space=pltpu.HBM)] * 3,
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         scratch_shapes=[
-            pltpu.VMEM((2, G, C), part_bins.dtype),
+            pltpu.VMEM((2, Gbuf, C), part_bins.dtype),
             pltpu.VMEM((2, C), jnp.float32),
             pltpu.VMEM((2, C), jnp.float32),
             pltpu.VMEM((2, G, B128), jnp.float32),
@@ -214,6 +219,6 @@ def leaf_hist_pallas(part_bins, grad_p, hess_p, start, cnt, *,
         out_shape=jax.ShapeDtypeStruct((2, G, B128), jnp.float32),
         grid_spec=grid_spec,
     )(jnp.asarray([start], jnp.int32), jnp.asarray([cnt], jnp.int32),
-      part_bins.reshape(G, nblocks, C), grad_p.reshape(nblocks, C),
+      part_bins.reshape(Gbuf, nblocks, C), grad_p.reshape(nblocks, C),
       hess_p.reshape(nblocks, C))
     return jnp.moveaxis(out[:, :, :B], 0, 2)

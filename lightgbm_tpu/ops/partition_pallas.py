@@ -1,0 +1,386 @@
+"""Pallas TPU kernel for the leaf two-way partition.
+
+TPU-native replacement for the reference DataPartition::Split
+(src/treelearner/data_partition.hpp:118-149) and the CUDA
+bitvector + AggregateBlockOffset + SplitInner pipeline
+(src/treelearner/cuda/cuda_data_partition.cu:288-907), built for the
+measured cost structure of this stack (see PERF.md): XLA window ops on
+few-sublane shapes run at 12-16 GB/s, while Pallas aligned window DMAs
+run at ~360 GB/s and an in-VMEM roll-network compaction costs ~3 us per
+(16, 8192) chunk.  The XLA formulation of the same partition
+(models/learner.py:_partition_leaf) is kept as the CPU / fallback path
+and as the correctness oracle — both produce bit-identical layouts
+(lefts forward-packed in original order, rights behind them in original
+order).
+
+Design notes (all constraints below were probed on the live toolchain):
+  * Window DMAs compile only with provably 128-aligned dynamic lane
+    offsets (``i * 128``) and tile-multiple sublane counts (8 for 32-bit
+    types, 32 for u8).  Leaf ranges are arbitrary, so the kernel reads
+    the 128-aligned cover of the range and marks the foreign edge rows:
+    rows before ``start`` ride as unconditional LEFTS, rows at/after
+    ``start + cnt`` as unconditional RIGHTS.  Stable compaction then
+    returns them to exactly their original positions.
+  * No sort / gather / cumsum lower inside Pallas TPU kernels.  Prefix
+    sums are computed with strictly-lower-triangular one-hot matmuls on
+    the MXU; the stable two-way compaction is a 13-step binary shift
+    network built from ``pltpu.roll`` (bool rolls don't lower — all
+    masks stay i32).
+  * Pass 1 streams the cover once: lefts are flushed forward IN PLACE
+    from the cover base (the left write frontier provably trails the
+    read frontier), rights are flushed forward into a scratch buffer.
+    Pass 2 slides the staged rights into their final windows with a
+    two-window roll-select, read-modify-writing only the partial edge
+    windows.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# scalar-operand layout (prefetched i32 vector)
+S_A0B = 0       # start >> 7  (128-block index of the aligned cover base)
+S_REM = 1       # start & 127
+S_CNT = 2       # number of rows in the leaf range
+S_COL = 3       # group row of the split feature in the binned matrix
+S_BSTART = 4    # bundled bin offset
+S_ISB = 5       # feature is bundled (0/1)
+S_NB = 6        # feature num_bin
+S_DBIN = 7      # feature default bin
+S_MTYPE = 8     # missing type (0 none / 1 zero / 2 nan)
+S_THR = 9       # split threshold (bin)
+S_DL = 10       # default_left (0/1)
+N_SCALARS = 11
+
+
+def _excl_prefix_rights(flag_l, C):
+    """Exclusive per-lane prefix count of rights (flag_l == 0), via
+    strictly-lower-triangular one-hot matmuls on the MXU (cumsum does
+    not lower in Pallas TPU)."""
+    nb = C // 128
+    r = (1 - flag_l).astype(jnp.float32).reshape(nb, 128)
+    lt = (jax.lax.broadcasted_iota(jnp.int32, (128, 128), 0) <
+          jax.lax.broadcasted_iota(jnp.int32, (128, 128), 1)
+          ).astype(jnp.float32)
+    within = jax.lax.dot_general(
+        r, lt, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)          # (nb, 128) exclusive
+    tot = jnp.sum(r, axis=1, keepdims=True)          # (nb, 1)
+    ltb = (jax.lax.broadcasted_iota(jnp.int32, (nb, nb), 0) <
+           jax.lax.broadcasted_iota(jnp.int32, (nb, nb), 1)
+           ).astype(jnp.float32)
+    carry = jax.lax.dot_general(
+        tot.reshape(1, nb), ltb, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)          # (1, nb) excl blocks
+    return (within + carry.reshape(nb, 1)).reshape(1, C).astype(jnp.int32)
+
+
+def _compact(payload, flag, shift0, C, logc):
+    """Stable compaction of flagged lanes to the front: binary shift
+    network, moving each flagged lane left by its deficit (the number of
+    unflagged lanes before it).  Monotone deficits make every step
+    collision-free; unflagged lanes are treated as holes."""
+    cur = payload
+    shift = jnp.where(flag != 0, shift0, 0)
+    fl = flag
+    for b in range(logc):
+        bit = 1 << b
+        move = jnp.where((fl != 0) & ((shift & bit) != 0), 1, 0)
+        m_in = pltpu_roll(move, C - bit) != 0
+        cur = jnp.where(m_in, pltpu_roll(cur, C - bit), cur)
+        shift = jnp.where(m_in, pltpu_roll(shift, C - bit), shift)
+        fl = jnp.where(m_in, 1, jnp.where(move != 0, 0, fl))
+    return cur
+
+
+def pltpu_roll(x, shift):
+    from jax.experimental.pallas import tpu as pltpu
+    return pltpu.roll(x, shift, 1)
+
+
+def _cdiv(a, c):
+    return jax.lax.div(a + (c - 1), c)
+
+
+def partition_leaf_pallas(part_bins, part_ghi, sc_bins, sc_ghi, scalars, *,
+                          row_chunk: int):
+    """Two-way stable partition of the leaf range described by
+    ``scalars`` (see the S_* layout above), in place.
+
+    Args:
+      part_bins: (G32, N_pad) u8 binned matrix, G32 a multiple of 32.
+      part_ghi:  (8, N_pad)  f32 packed (grad, hess, rowid-bits, pad...).
+      sc_bins / sc_ghi: same-shape scratch buffers (contents don't
+        survive; they stage the rights between the two passes).
+      scalars: (N_SCALARS,) i32.
+    Returns (part_bins', part_ghi', sc_bins', sc_ghi', nl) with the
+    first four aliased in place; nl is an (8, 128) i32 tile whose [0, 0]
+    element is the left count.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    G32, Np = part_bins.shape
+    GH = part_ghi.shape[0]
+    assert GH == 8 and G32 % 32 == 0, (G32, GH)
+    C = row_chunk
+    assert C >= 256 and (C & (C - 1)) == 0 and Np % 128 == 0
+    logc = C.bit_length() - 1
+    S = G32 + GH        # widened payload sublanes
+
+    def kernel(s_ref, pb_in, pg_in, sb_in, sg_in,
+               pb, pg, sb, sg, nl_ref,
+               rb, rg, stgl, stgr, wb, wg, exb, exg, sems):
+        a0b = s_ref[S_A0B]
+        rem = s_ref[S_REM]
+        cnt = s_ref[S_CNT]
+        col = s_ref[S_COL]
+        total = rem + cnt
+        n_chunks = jnp.where(cnt > 0, _cdiv(total, C), 0)
+
+        lane = jax.lax.broadcasted_iota(jnp.int32, (1, C), 1)
+        sub_oh = (jax.lax.broadcasted_iota(jnp.int32, (G32, 1), 0) == col
+                  ).astype(jnp.int32)
+
+        def start_read(ci, slot):
+            pltpu.make_async_copy(
+                pb_in.at[:, pl.ds(a0b * 128 + ci * C, C)],
+                rb.at[slot], sems.at[slot, 0]).start()
+            pltpu.make_async_copy(
+                pg_in.at[:, pl.ds(a0b * 128 + ci * C, C)],
+                rg.at[slot], sems.at[slot, 1]).start()
+
+        def wait_read(slot):
+            pltpu.make_async_copy(
+                pb_in.at[:, pl.ds(0, C)], rb.at[slot],
+                sems.at[slot, 0]).wait()
+            pltpu.make_async_copy(
+                pg_in.at[:, pl.ds(0, C)], rg.at[slot],
+                sems.at[slot, 1]).wait()
+
+        @pl.when(n_chunks > 0)
+        def _():
+            start_read(0, 0)
+
+        def body(ci, carry):
+            fill_l, fill_r, nfl, nfr, nl_cnt = carry
+            slot = jax.lax.rem(ci, 2)
+
+            @pl.when(ci + 1 < n_chunks)
+            def _():
+                start_read(ci + 1, 1 - slot)
+            wait_read(slot)
+
+            bins_i = rb[slot].astype(jnp.int32)               # (G32, C)
+            ghi_i = jax.lax.bitcast_convert_type(rg[slot], jnp.int32)
+            payload = jnp.concatenate([bins_i, ghi_i], axis=0)  # (S, C)
+
+            # --- decision (numerical splits; see ops/partition.py
+            # split_decision and models/learner.py _goes_left) ---
+            colv = jnp.sum(bins_i * sub_oh, axis=0,
+                           keepdims=True)                      # (1, C)
+            bstart = s_ref[S_BSTART]
+            fb_raw = colv - bstart
+            in_rb = (fb_raw >= 1) & (fb_raw <= s_ref[S_NB] - 1)
+            fb = jnp.where(s_ref[S_ISB] == 1,
+                           jnp.where(in_rb, fb_raw, s_ref[S_DBIN]), colv)
+            mtype = s_ref[S_MTYPE]
+            # all-i32 logic: bool vectors with Python-literal branches
+            # trip an i8->i1 truncation Mosaic can't lower
+            miss_i = jnp.where(
+                mtype == 1, (fb == s_ref[S_DBIN]).astype(jnp.int32),
+                jnp.where(mtype == 2,
+                          (fb == s_ref[S_NB] - 1).astype(jnp.int32), 0))
+            nat_i = (fb <= s_ref[S_THR]).astype(jnp.int32)
+            gl_i = jnp.where(miss_i != 0, s_ref[S_DL], nat_i)
+
+            pos = ci * C + lane                 # cover-relative position
+            before_i = (pos < rem).astype(jnp.int32)
+            inside_i = ((pos >= rem) & (pos < total)).astype(jnp.int32)
+            left = jnp.where((before_i != 0) |
+                             ((inside_i != 0) & (gl_i != 0)), 1, 0)
+
+            pnr = _excl_prefix_rights(left, C)       # rights before lane
+            nlc = jnp.sum(left)
+            nl_cnt = nl_cnt + nlc
+            nrc = C - nlc
+
+            lcomp = _compact(payload, left, pnr, C, logc)
+            rcomp = _compact(payload, 1 - left, lane - pnr, C, logc)
+
+            def append_and_flush(stg, comp, fill, n_add, nf, dst, dst_b0):
+                # place comp[0:n_add) at staging positions [fill, +n_add)
+                rolled = pltpu.roll(comp, fill, 1)
+                m1 = (lane >= fill) & (lane < fill + n_add)
+                stg[:, 0:C] = jnp.where(m1, rolled, stg[:, 0:C])
+                m2 = (lane + C) < (fill + n_add)
+                stg[:, C:2 * C] = jnp.where(m2, rolled, stg[:, C:2 * C])
+                new_fill = fill + n_add
+
+                @pl.when(new_fill >= C)
+                def _():
+                    wb[:] = stg[0:G32, 0:C].astype(jnp.uint8)
+                    wg[:] = jax.lax.bitcast_convert_type(
+                        stg[G32:S, 0:C], jnp.float32)
+                    cb = pltpu.make_async_copy(
+                        wb, dst[0].at[:, pl.ds(dst_b0 * 128 + nf * C, C)],
+                        sems.at[0, 2])
+                    cg = pltpu.make_async_copy(
+                        wg, dst[1].at[:, pl.ds(dst_b0 * 128 + nf * C, C)],
+                        sems.at[1, 2])
+                    cb.start(); cg.start(); cb.wait(); cg.wait()
+                    stg[:, 0:C] = stg[:, C:2 * C]
+                flushed = (new_fill >= C).astype(jnp.int32)
+                return new_fill - flushed * C, nf + flushed
+
+            fill_l, nfl = append_and_flush(stgl, lcomp, fill_l, nlc,
+                                           nfl, (pb, pg), a0b)
+            fill_r, nfr = append_and_flush(stgr, rcomp, fill_r, nrc,
+                                           nfr, (sb, sg), a0b)
+            return fill_l, fill_r, nfl, nfr, nl_cnt
+
+        fill_l, fill_r, nfl, nfr, nl_cnt = jax.lax.fori_loop(
+            0, n_chunks, body,
+            (jnp.int32(0), jnp.int32(0), jnp.int32(0), jnp.int32(0),
+             jnp.int32(0)))
+
+        def final_flush(stg, fill, nf, dst, dst_b0):
+            # Full-window write: the garbage tail beyond ``fill`` is
+            # always rewritten by pass 2 (lefts) or never read (scratch).
+            @pl.when(fill > 0)
+            def _():
+                wb[:] = stg[0:G32, 0:C].astype(jnp.uint8)
+                wg[:] = jax.lax.bitcast_convert_type(
+                    stg[G32:S, 0:C], jnp.float32)
+                cb = pltpu.make_async_copy(
+                    wb, dst[0].at[:, pl.ds(dst_b0 * 128 + nf * C, C)],
+                    sems.at[0, 2])
+                cg = pltpu.make_async_copy(
+                    wg, dst[1].at[:, pl.ds(dst_b0 * 128 + nf * C, C)],
+                    sems.at[1, 2])
+                cb.start(); cg.start(); cb.wait(); cg.wait()
+
+        final_flush(stgl, fill_l, nfl, (pb, pg), a0b)
+        final_flush(stgr, fill_r, nfr, (sb, sg), a0b)
+
+        # drop the foreign prefix; with cnt == 0 the chunk loop never ran
+        # (trash-slot iterations call the partition with an arbitrary,
+        # usually unaligned start), so the count must clamp to 0
+        nl_true = jnp.where(cnt > 0, nl_cnt - rem, 0)
+        nl_ref[:] = jnp.broadcast_to(nl_true, (8, 128)).astype(jnp.int32)
+
+        # ---- pass 2: slide staged rights into [start+nl, aligned_end) ----
+        s_r = n_chunks * C - nl_cnt                  # staged rights total
+        dst_off = rem + nl_true                      # dst0 - a0
+        dwb = a0b + jax.lax.shift_right_logical(dst_off, 7)  # block of dw0
+        # r0 = dst0 - floor128(dst0), in [0, 128)
+        r0 = dst_off - jax.lax.shift_right_logical(dst_off, 7) * 128
+        n_d = jnp.where(s_r > 0, _cdiv(r0 + s_r, C), 0)
+        aligned_total = n_chunks * C                 # cover size
+
+        def body2(j, _):
+            slot = jax.lax.rem(j, 2)
+            # read source window j of the staged rights (front-packed
+            # from the cover base in scratch); the guard keeps the last
+            # (prev-only) destination window from reading past the
+            # staged region
+            read_src = j * C < s_r
+
+            @pl.when(read_src)
+            def _():
+                pltpu.make_async_copy(
+                    sb_in.at[:, pl.ds(a0b * 128 + j * C, C)],
+                    rb.at[slot], sems.at[slot, 0]).start()
+                pltpu.make_async_copy(
+                    sg_in.at[:, pl.ds(a0b * 128 + j * C, C)],
+                    rg.at[slot], sems.at[slot, 1]).start()
+            # destination window bounds (cover-relative)
+            dlo = dst_off - r0 + j * C               # window start
+            lo = jnp.where(j == 0, r0, 0)
+            hi = jnp.minimum(C, aligned_total - dlo)
+            need_rmw = (lo > 0) | (hi < C)
+
+            @pl.when(need_rmw)
+            def _():
+                cb = pltpu.make_async_copy(
+                    pb_in.at[:, pl.ds(dwb * 128 + j * C, C)], exb,
+                    sems.at[0, 3])
+                cg = pltpu.make_async_copy(
+                    pg_in.at[:, pl.ds(dwb * 128 + j * C, C)], exg,
+                    sems.at[1, 3])
+                cb.start(); cg.start(); cb.wait(); cg.wait()
+
+            @pl.when(read_src)
+            def _():
+                wait_read(slot)
+
+            cur_b = rb[slot].astype(jnp.int32)
+            cur_g = jax.lax.bitcast_convert_type(rg[slot], jnp.int32)
+            prv_b = rb[1 - slot].astype(jnp.int32)
+            prv_g = jax.lax.bitcast_convert_type(rg[1 - slot], jnp.int32)
+            take_prev = lane < r0
+            out_b = jnp.where(take_prev, pltpu.roll(prv_b, r0, 1),
+                              pltpu.roll(cur_b, r0, 1))
+            out_g = jnp.where(take_prev, pltpu.roll(prv_g, r0, 1),
+                              pltpu.roll(cur_g, r0, 1))
+            valid = (lane >= lo) & (lane < hi)
+            wb[:] = jnp.where(valid, out_b,
+                              exb[:].astype(jnp.int32)).astype(jnp.uint8)
+            wg[:] = jax.lax.bitcast_convert_type(
+                jnp.where(valid, out_g,
+                          jax.lax.bitcast_convert_type(exg[:], jnp.int32)),
+                jnp.float32)
+            cb = pltpu.make_async_copy(
+                wb, pb.at[:, pl.ds(dwb * 128 + j * C, C)], sems.at[0, 2])
+            cg = pltpu.make_async_copy(
+                wg, pg.at[:, pl.ds(dwb * 128 + j * C, C)], sems.at[1, 2])
+            cb.start(); cg.start(); cb.wait(); cg.wait()
+            return 0
+
+        jax.lax.fori_loop(0, n_d, body2, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(1,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)] * 4,
+        out_specs=[pl.BlockSpec(memory_space=pltpu.ANY)] * 4 +
+                  [pl.BlockSpec(memory_space=pltpu.VMEM)],
+        scratch_shapes=[
+            pltpu.VMEM((2, G32, C), jnp.uint8),      # rb
+            pltpu.VMEM((2, GH, C), jnp.float32),     # rg
+            pltpu.VMEM((S, 2 * C), jnp.int32),       # stgl
+            pltpu.VMEM((S, 2 * C), jnp.int32),       # stgr
+            pltpu.VMEM((G32, C), jnp.uint8),         # wb
+            pltpu.VMEM((GH, C), jnp.float32),        # wg
+            pltpu.VMEM((G32, C), jnp.uint8),         # exb
+            pltpu.VMEM((GH, C), jnp.float32),        # exg
+            pltpu.SemaphoreType.DMA((2, 4)),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=[
+            jax.ShapeDtypeStruct(part_bins.shape, part_bins.dtype),
+            jax.ShapeDtypeStruct(part_ghi.shape, part_ghi.dtype),
+            jax.ShapeDtypeStruct(sc_bins.shape, sc_bins.dtype),
+            jax.ShapeDtypeStruct(sc_ghi.shape, sc_ghi.dtype),
+            jax.ShapeDtypeStruct((8, 128), jnp.int32),
+        ],
+        grid_spec=grid_spec,
+        input_output_aliases={1: 0, 2: 1, 3: 2, 4: 3},
+    )(scalars, part_bins, part_ghi, sc_bins, sc_ghi)
+    return out
+
+
+def make_scalars(start, cnt, col, bstart, isb, nb, dbin, mtype, thr, dl):
+    """Pack the kernel's scalar operand (all traced i32)."""
+    start = jnp.asarray(start, jnp.int32)
+    a0b = jax.lax.shift_right_logical(start, 7)
+    rem = start - a0b * 128
+    vals = [a0b, rem, cnt, col, bstart, isb, nb, dbin, mtype, thr, dl]
+    return jnp.stack([jnp.asarray(v).astype(jnp.int32) for v in vals])

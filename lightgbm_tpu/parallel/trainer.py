@@ -131,6 +131,7 @@ class ShardedTreeBuilder:
             rec = {k: v for k, v in rec.items()
                    if k not in ("indices", "part_bins", "part_grad",
                                 "part_hess", "part_ghi", "sc32",
+                                "sc_bins", "sc_ghi",
                                 "part_aux", "sc_aux",
                                 "leaf_start", "leaf_cnt", "hist")}
 
