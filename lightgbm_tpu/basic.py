@@ -422,9 +422,69 @@ class Booster:
             log.fatal("Booster requires train_set, model_file or model_str")
 
     # ------------------------------------------------------------------
+    def _continue_from(self, init_model) -> "Booster":
+        """Continued training: seed this (fresh, train-set-backed) booster
+        with the trees and scores of ``init_model`` (a Booster, model file
+        path, or model string).  Reference: Application::LoadData builds a
+        Predictor over the input model to initialize scores
+        (application.cpp:94-97); engine.py train(init_model=)."""
+        if isinstance(init_model, Booster):
+            init_bst = init_model
+        elif isinstance(init_model, str) and "\n" in init_model:
+            init_bst = Booster(model_str=init_model)
+        else:
+            init_bst = Booster(model_file=init_model)
+        init_bst._gbdt._flush_pending()
+        g = self._gbdt
+        ig = init_bst._gbdt
+        if not ig.models:
+            return self
+        if ig.num_tree_per_iteration != g.num_tree_per_iteration:
+            raise ValueError(
+                f"init_model has num_tree_per_iteration="
+                f"{ig.num_tree_per_iteration}, training config needs "
+                f"{g.num_tree_per_iteration}")
+        if type(g).__name__ in ("DART", "RF"):
+            log.warning("init_model continuation is not supported for "
+                        "boosting=%s; starting fresh",
+                        type(g).__name__.lower())
+            return self
+        raw = self._raw_matrix(self.train_set, init_bst)
+        if raw is None:
+            raise ValueError(
+                "continued training needs the raw train rows to score the "
+                "init model (reference: application.cpp:94-97); the train "
+                "Dataset no longer holds them")
+        g.continue_from(ig.models, ig.predict_raw(raw))
+        self._init_booster = init_bst
+        return self
+
+    def _raw_matrix(self, dataset: Optional[Dataset], init_bst: "Booster"):
+        if dataset is None:
+            return None
+        data = dataset.data
+        if data is None or isinstance(data, str):
+            inner = getattr(dataset, "_inner", None)
+            return getattr(inner, "raw_data", None)
+        # encode categoricals with the INIT model's own category maps —
+        # the new frame's observed categories can map codes differently
+        # (reference python package predicts with the init booster, whose
+        # predict applies its own pandas_categorical)
+        cats = (init_bst.pandas_categorical
+                if init_bst.pandas_categorical is not None
+                else self.pandas_categorical)
+        return _to_matrix(data, cats)
+
     def add_valid(self, data: Dataset, name: str) -> "Booster":
         data.construct(self.params)
-        self._gbdt.add_valid_data(data._inner)
+        extra = None
+        if getattr(self, "_init_booster", None) is not None:
+            raw = self._raw_matrix(data, self._init_booster)
+            if raw is None:
+                raise ValueError("continued training needs the raw rows of "
+                                 "validation sets to score the init model")
+            extra = self._init_booster._gbdt.predict_raw(raw)
+        self._gbdt.add_valid_data(data._inner, extra_score=extra)
         self._valid_names.append(name)
         self._valid_sets.append(data)
         return self

@@ -121,11 +121,26 @@ class Application:
                     reference=train_set, params=dict(self.raw_params)))
                 valid_names.append(os.path.basename(vf))
         init_model = cfg.input_model or None
+        callbacks = None
+        if cfg.snapshot_freq and cfg.snapshot_freq > 0:
+            # periodic model snapshots (reference: GBDT::Train,
+            # gbdt.cpp:244-248 — "<output_model>.snapshot_iter_<i>")
+            freq = int(cfg.snapshot_freq)
+            out_path = cfg.output_model
+
+            def _snapshot(env):
+                it = env.iteration + 1
+                if it % freq == 0:
+                    env.model.save_model(f"{out_path}.snapshot_iter_{it}")
+
+            _snapshot.order = 100
+            callbacks = [_snapshot]
         booster = _train(dict(self.raw_params), train_set,
                          num_boost_round=cfg.num_iterations,
                          valid_sets=valid_sets or None,
                          valid_names=valid_names or None,
-                         init_model=init_model)
+                         init_model=init_model,
+                         callbacks=callbacks)
         booster.save_model(cfg.output_model)
         log.info("Finished training; model saved to %s", cfg.output_model)
 

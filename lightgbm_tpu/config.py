@@ -339,6 +339,10 @@ class Config:
         self._unknown: Dict[str, Any] = {}
         for key, value in merged.items():
             k = str(key).strip().lower().replace("-", "_")
+            # list/tuple values join to comma-separated strings, like the
+            # reference python package's _param_dict_to_str (basic.py:303)
+            if isinstance(value, (list, tuple)):
+                value = ",".join(str(v) for v in value)
             name = _ALIAS2NAME.get(k)
             if name is None:
                 self._unknown[k] = value

@@ -58,19 +58,22 @@ def _is_dask_collection(x: Any) -> bool:
                                    or hasattr(x, "compute"))
 
 
-def _parts_in_worker_order(collection, client) -> List[Any]:
-    """Materialize a dask collection's partitions grouped by the worker
-    that holds them (the reference's `_split_to_parts` + worker grouping,
-    dask.py:95-160), so row order is deterministic per cluster layout."""
+def _materialize_parts(collection, client) -> List[Any]:
     parts = collection.to_delayed()
     parts = list(np.asarray(parts).ravel())
     futures = client.compute(parts)
     wait(futures)
+    return futures
+
+
+def _worker_order(futures, client) -> List[int]:
+    """Partition permutation grouped by the worker holding each part
+    (the reference's `_split_to_parts` + worker grouping, dask.py:95-160),
+    so row order is deterministic per cluster layout."""
     who_has = client.who_has(futures)
-    order = sorted(
+    return sorted(
         range(len(futures)),
         key=lambda i: (sorted(who_has.get(futures[i].key, ())), i))
-    return [futures[i].result() for i in order]
 
 
 def _concat_parts(parts: List[Any]) -> np.ndarray:
@@ -93,21 +96,34 @@ class _DaskLGBMModel:
         client = client or default_client()
         if not _is_dask_collection(X):
             raise TypeError("X must be a dask Array or DataFrame")
-        X_parts = _parts_in_worker_order(X, client)
-        y_parts = _parts_in_worker_order(y, client)
-        X_local = _concat_parts(X_parts)
-        y_local = _concat_parts(y_parts)
+        # ONE placement permutation, derived from X and applied to every
+        # aligned collection: ordering each collection by its OWN placement
+        # silently misaligns rows and labels whenever corresponding
+        # partitions land on different workers (work stealing, rebalance).
+        # The reference zips (data, label, weight) into single per-part
+        # tuples for the same reason (dask.py:553-571).
+        X_fut = _materialize_parts(X, client)
+        order = _worker_order(X_fut, client)
+
+        def aligned(collection, name):
+            fut = _materialize_parts(collection, client)
+            if len(fut) != len(X_fut):
+                raise ValueError(
+                    f"{name} has {len(fut)} partitions but X has "
+                    f"{len(X_fut)}; repartition them identically")
+            return _concat_parts([fut[i].result() for i in order])
+
+        X_local = _concat_parts([X_fut[i].result() for i in order])
+        y_local = aligned(y, "y")
         w_local = (None if sample_weight is None else
-                   _concat_parts(_parts_in_worker_order(sample_weight,
-                                                        client)))
-        g_local = (None if group is None else
-                   _concat_parts(_parts_in_worker_order(group, client)))
+                   aligned(sample_weight, "sample_weight"))
+        g_local = (None if group is None else aligned(group, "group"))
         n_workers = len(client.scheduler_info()["workers"])
         if n_workers > 1:
             log.info("lightgbm_tpu.dask: gathered %d partitions from %d "
                      "workers; training on the TPU mesh (rows sharded over "
                      "devices, reference analog: one socket rank per "
-                     "worker)", len(X_parts), n_workers)
+                     "worker)", len(X_fut), n_workers)
         fit_kwargs = {}
         if w_local is not None:
             fit_kwargs["sample_weight"] = w_local
@@ -125,10 +141,24 @@ class _DaskLGBMModel:
         def block(part):
             return fn(self, part, **kwargs)
 
+        # a column-chunked array would hand partial-feature blocks to the
+        # model; collapse axis 1 to one chunk first (reference does the
+        # same via map_blocks over row partitions only)
+        if getattr(X, "ndim", 1) > 1 and hasattr(X, "rechunk"):
+            try:
+                if len(X.chunks[1]) > 1:
+                    X = X.rechunk({1: X.shape[1]})
+            except Exception:
+                pass
+        returns_2d = (method == "predict_proba"
+                      or kwargs.get("pred_contrib")
+                      or kwargs.get("pred_leaf"))
+        if returns_2d:
+            meta = np.empty((0, 0), dtype=np.float64)
+            return X.map_blocks(block, meta=meta)
         meta = np.empty((0,), dtype=np.float64)
         return X.map_blocks(block, meta=meta, drop_axis=(
-            [1] if getattr(X, "ndim", 1) > 1 and method == "predict"
-            and not kwargs.get("pred_contrib") else None))
+            [1] if getattr(X, "ndim", 1) > 1 else None))
 
     def _lgb_dask_to_local(self, model_cls):
         """Return the equivalent non-dask estimator (reference:

@@ -40,8 +40,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
 
     booster = Booster(params=params, train_set=train_set)
     if init_model is not None:
-        log.warning("init_model continuation is not yet implemented; "
-                    "starting fresh")
+        booster._continue_from(init_model)
 
     valid_contain_train = False
     name_valid_sets = []

@@ -38,6 +38,7 @@ class GBDT:
         self.objective = objective
         self.models: List[Tree] = []
         self.device_trees: List[Dict[str, Any]] = []  # node arrays + leaf values
+        self._continued = False        # set by continue_from
         self.iter = 0
         self.shrinkage_rate = float(config.learning_rate)
         self.num_tree_per_iteration = (objective.num_model_per_iteration
@@ -286,7 +287,49 @@ class GBDT:
             self._drain_pending(0)
 
     # ------------------------------------------------------------------
-    def add_valid_data(self, valid_data: BinnedDataset) -> None:
+    def continue_from(self, trees, train_pred: np.ndarray) -> None:
+        """Continued training from a loaded model (reference:
+        application.cpp:94-97 — a Predictor over the input model seeds the
+        scores — plus GBDT::MergeFrom, gbdt.h:70, and the python engine's
+        ``train(init_model=)``, python-package/lightgbm/engine.py:150-186).
+
+        ``trees`` become the head of the model list; train scores are
+        rebuilt as (dataset init_score) + ``train_pred`` (the init model's
+        raw prediction over the RAW train rows — bin-space evaluation
+        would be wrong whenever this dataset's bin boundaries differ from
+        the loaded model's thresholds).  The caller (Booster) owns the raw
+        matrices and computes the predictions.
+        """
+        import copy as _copy
+        self._flush_pending()
+        if self.models:
+            raise ValueError("continue_from requires a fresh booster")
+        K = self.num_tree_per_iteration
+        self.models = [_copy.deepcopy(t) for t in trees]
+        # loaded trees carry real-valued thresholds only — no device (bin)
+        # node arrays.  Rollback past the continuation boundary is refused.
+        self.device_trees = [None] * len(self.models)
+        self.iter = len(self.models) // K
+        self._continued = True
+        # the loaded model's boost_from_average lives in its first tree
+        # (folded at materialization), so the fresh booster's must not
+        # apply on top
+        self.init_scores = [0.0] * K
+
+        n = self.num_data
+        shape = (n,) if K == 1 else (n, K)
+        base = np.zeros(shape, dtype=np.float32)
+        meta = self.train_data.metadata
+        if meta.init_score is not None:
+            init = np.asarray(meta.init_score, dtype=np.float32)
+            if K > 1:
+                init = init.reshape(K, n).T
+            base = init.reshape(shape)
+        pred = np.asarray(train_pred, dtype=np.float32)
+        self.scores = jnp.asarray(base + pred.reshape(shape))
+
+    def add_valid_data(self, valid_data: BinnedDataset,
+                       extra_score=None) -> None:
         metrics = create_metrics(
             self.config, self.objective.name if self.objective else None)
         for m in metrics:
@@ -307,6 +350,15 @@ class GBDT:
                         score = score + self.init_scores[k]
                     else:
                         score = score.at[:, k].add(self.init_scores[k])
+        if extra_score is not None:
+            # continued training: the loaded model's contribution (its own
+            # average-boost folded into tree 0) rides on top of init_score
+            extra = np.asarray(extra_score, dtype=np.float32)
+            score = score + jnp.asarray(extra.reshape(score.shape))
+        elif self._continued:
+            raise ValueError("validation sets added to a continued booster "
+                             "need the init model's predictions "
+                             "(Booster.add_valid computes them)")
         self.valid_sets.append((valid_data, metrics, binned))
         self.valid_scores.append(score)
 
@@ -842,6 +894,10 @@ class GBDT:
         if self.iter <= 0:
             return
         K = self.num_tree_per_iteration
+        if any(self.device_trees[-k] is None for k in range(1, K + 1)):
+            log.warning("cannot roll back past the init_model boundary "
+                        "(loaded trees have no device arrays)")
+            return
         for k in range(K):
             dt = self.device_trees.pop()
             tree = self.models.pop()
@@ -891,50 +947,78 @@ class DART(GBDT):
         # IMMEDIATELY after its iteration; the fused path's lag breaks that
         self._fused = None
         self.drop_rng = np.random.RandomState(config.drop_seed)
-        self.tree_weights: List[float] = []  # per model tree
+        self.tree_weights: List[float] = []  # per iteration (dart.hpp:196)
+        self.sum_weight = 0.0
 
     def train_one_iter(self, grad=None, hess=None) -> bool:
-        # select trees to drop (reference: dart.hpp DroppingTrees:97)
+        # select trees to drop (reference: dart.hpp DroppingTrees:97 —
+        # per-tree Bernoulli draws; non-uniform mode weights each tree by
+        # its stored weight relative to the average, capped by max_drop)
         self._flush_pending()
         cfg = self.config
         K = self.num_tree_per_iteration
         n_iters = len(self.models) // K
+        base_lr = float(cfg.learning_rate)
         drop_iters: List[int] = []
         if n_iters > 0 and self.drop_rng.rand() >= cfg.skip_drop:
+            drop_rate = float(cfg.drop_rate)
+            max_drop = int(cfg.max_drop)
             if cfg.uniform_drop:
-                mask = self.drop_rng.rand(n_iters) < cfg.drop_rate
-                drop_iters = [i for i in range(n_iters) if mask[i]]
+                if max_drop > 0:
+                    drop_rate = min(drop_rate, max_drop / n_iters)
+                for i in range(n_iters):
+                    if self.drop_rng.rand() < drop_rate:
+                        drop_iters.append(i)
+                        if max_drop > 0 and len(drop_iters) >= max_drop:
+                            break
             else:
-                k_drop = max(int(n_iters * cfg.drop_rate), 1)
-                k_drop = min(k_drop, cfg.max_drop if cfg.max_drop > 0 else k_drop)
-                drop_iters = sorted(self.drop_rng.choice(
-                    n_iters, size=min(k_drop, n_iters), replace=False).tolist())
-        # remove dropped trees' contributions from scores
+                inv_avg = (len(self.tree_weights) / self.sum_weight
+                           if self.sum_weight > 0 else 0.0)
+                if max_drop > 0 and self.sum_weight > 0:
+                    drop_rate = min(drop_rate,
+                                    max_drop * inv_avg / self.sum_weight)
+                for i in range(n_iters):
+                    p = drop_rate * self.tree_weights[i] * inv_avg
+                    if self.drop_rng.rand() < p:
+                        drop_iters.append(i)
+                        if max_drop > 0 and len(drop_iters) >= max_drop:
+                            break
+        k_drop = len(drop_iters)
+        # remove dropped trees' contributions from the TRAIN scores only
+        # (validation scores are corrected in the normalize step, exactly
+        # like the reference's Shrinkage(-1)+AddScore / Normalize dance)
         for it in drop_iters:
             for k in range(K):
-                t_idx = it * K + k
-                self._add_tree_to_scores(t_idx, -1.0)
+                self._add_tree_to_scores(it * K + k, -1.0, valid=False)
+        # the NEW tree trains at reduced shrinkage so its score update and
+        # stored values agree from the start (dart.hpp:131-146)
+        if cfg.xgboost_dart_mode:
+            self.shrinkage_rate = (base_lr if k_drop == 0
+                                   else base_lr / (base_lr + k_drop))
+        else:
+            self.shrinkage_rate = base_lr / (1.0 + k_drop)
         stop = super().train_one_iter(grad, hess)
-        # normalize (reference: dart.hpp Normalize)
-        n_drop = len(drop_iters)
-        if n_drop > 0:
-            if cfg.xgboost_dart_mode:
-                new_w = self.shrinkage_rate / (n_drop + self.shrinkage_rate)
-                old_factor = n_drop / (n_drop + self.shrinkage_rate)
-            else:
-                new_w = 1.0 / (n_drop + 1)
-                old_factor = n_drop / (n_drop + 1.0)
-            # scale the new trees
-            for k in range(K):
-                t_idx = len(self.models) - K + k
-                scale = new_w / self.shrinkage_rate
-                self._scale_tree(t_idx, scale)
-            # scale dropped trees and re-add
+        # normalize dropped trees (reference: dart.hpp Normalize:158):
+        # each dropped tree's final weight is old * k/(k+1) (non-xgboost)
+        # or old * k/(k+lr) (xgboost mode); train scores lost the full
+        # tree, valid scores lost nothing yet
+        if k_drop > 0:
+            kf = float(k_drop)
+            final = (kf / (kf + 1.0) if not cfg.xgboost_dart_mode
+                     else kf / (kf + base_lr))
             for it in drop_iters:
                 for k in range(K):
                     t_idx = it * K + k
-                    self._scale_tree(t_idx, old_factor)
-                    self._add_tree_to_scores(t_idx, 1.0)
+                    self._add_tree_to_scores(t_idx, final, valid=False)
+                    self._add_tree_to_scores(t_idx, final - 1.0, train=False)
+                    self._scale_tree(t_idx, final)
+                if not cfg.uniform_drop:
+                    self.tree_weights[it] *= final
+            if not cfg.uniform_drop:
+                self.sum_weight = sum(self.tree_weights)
+        if not cfg.uniform_drop:
+            self.tree_weights.append(self.shrinkage_rate)
+            self.sum_weight += self.shrinkage_rate
         return stop
 
     def _scale_tree(self, t_idx: int, factor: float) -> None:
@@ -943,19 +1027,23 @@ class DART(GBDT):
         dt = self.device_trees[t_idx]
         dt["leaf_value"] = dt["leaf_value"] * factor
 
-    def _add_tree_to_scores(self, t_idx: int, sign: float) -> None:
+    def _add_tree_to_scores(self, t_idx: int, factor: float,
+                            train: bool = True, valid: bool = True) -> None:
         dt = self.device_trees[t_idx]
         K = self.num_tree_per_iteration
         k = t_idx % K
-        leaf_train = self._traverse_train(dt["nodes"], self.train_binned)
-        delta = jnp.take(dt["leaf_value"], leaf_train) * sign
-        if K == 1:
-            self.scores = self.scores + delta
-        else:
-            self.scores = self.scores.at[:, k].add(delta)
+        if train:
+            leaf_train = self._traverse_train(dt["nodes"], self.train_binned)
+            delta = jnp.take(dt["leaf_value"], leaf_train) * factor
+            if K == 1:
+                self.scores = self.scores + delta
+            else:
+                self.scores = self.scores.at[:, k].add(delta)
+        if not valid:
+            return
         for vi, (vd, metrics, binned) in enumerate(self.valid_sets):
             leaf_v = predict_leaf_binned(binned, dt["nodes"])
-            dv = jnp.take(dt["leaf_value"], leaf_v) * sign
+            dv = jnp.take(dt["leaf_value"], leaf_v) * factor
             if K == 1:
                 self.valid_scores[vi] = self.valid_scores[vi] + dv
             else:

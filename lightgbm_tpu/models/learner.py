@@ -737,8 +737,16 @@ class SerialTreeLearner:
             accr = acc[1] + jnp.sum(notused * (valid & ~is_l), axis=1)
             return jnp.stack([accl, accr])
 
-        return jax.lax.fori_loop(0, n_chunks, body,
-                                 jnp.zeros((2, F), jnp.int32))
+        counts = jax.lax.fori_loop(0, n_chunks, body,
+                                   jnp.zeros((2, F), jnp.int32))
+        # data/voting parallel: counts are shard-local but _sync_best is a
+        # no-op there (devices rely on identical psum'd inputs to pick
+        # identical splits) — the lazy penalty must therefore be GLOBAL or
+        # the replicated tree state silently diverges
+        if self.axis_name is not None and self.parallel_mode in ("data",
+                                                                 "voting"):
+            counts = jax.lax.psum(counts, self.axis_name)
+        return counts
 
     def _lazy_mark(self, part_aux, start, cnt, f_enum):
         """Set the used-bit of ``f_enum`` for rows [start, start+cnt)
@@ -893,11 +901,22 @@ class SerialTreeLearner:
             # lazy counts are not re-derived on constraint refresh (the
             # cegb-lazy x intermediate-monotone interplay is not modeled)
             extra = (jnp.zeros((L, self.F), jnp.int32),)
+        # per-leaf effective masks: interaction-constraint/bynode masks are
+        # stored per leaf; under feature-parallel the device-local feature
+        # shards are UNIONed so every device recomputes the identical
+        # refresh (no _sync_best needed for a replicated computation)
+        mask0 = feature_mask
+        if self.axis_name is not None and self.parallel_mode == "feature":
+            mask0 = jax.lax.pmax(
+                feature_mask.astype(jnp.int32), self.axis_name) > 0
+        masks = jnp.broadcast_to(mask0, (L, self.F))
+        if "leaf_fmask" in st:
+            masks = masks & st["leaf_fmask"][:L]
         best = self._best_split_vmapped(
             st["hist"][:L], lm[LM_SUM_G, :L], lm[LM_SUM_H, :L],
             _f2i(lm[LM_CNT_G, :L]), _f2i(lm[LM_CNT, :L]),
             _f2i(lm[LM_DEPTH, :L]), newmin, newmax, lm[LM_VALUE, :L],
-            jnp.broadcast_to(feature_mask, (L, self.F)), st["feat_used"],
+            masks, st["feat_used"],
             *extra)
         overlay = {
             LM_BGAIN: best.gain,
@@ -1113,6 +1132,11 @@ class SerialTreeLearner:
             state["leaf_lo"] = jnp.zeros((L + 1, F), jnp.int32)
             state["leaf_hi"] = jnp.broadcast_to(
                 self.ctx.num_bin - 1, (L + 1, F)).astype(jnp.int32)
+            if self.ic_masks is not None or self.has_bynode:
+                # per-leaf effective feature masks so the constraint
+                # refresh re-search honors interaction/bynode restrictions
+                state["leaf_fmask"] = jnp.broadcast_to(
+                    root_mask, (L + 1, F)).astype(jnp.bool_)
 
         # uniform vma typing under shard_map: mark the whole state varying
         state = self._pvary(state)
@@ -1386,6 +1410,12 @@ class SerialTreeLearner:
                        if self.ic_masks is not None else {}),
                     "best_cat_set": new_cat,
                 })
+                if (self.use_mc and self.mc_mode == "intermediate"
+                        and "leaf_fmask" in st):
+                    upd["leaf_fmask"] = jnp.where(
+                        (iot_l1 == wr_a)[:, None], mask_l[None, :],
+                        jnp.where((iot_l1 == wr_b)[:, None],
+                                  mask_r[None, :], st["leaf_fmask"]))
                 if self.use_mc and self.mc_mode == "intermediate":
                     # per-leaf bin-range boxes: children inherit the parent
                     # box, tightened along the split feature for numerical
@@ -1397,9 +1427,20 @@ class SerialTreeLearner:
                     f1h = jax.lax.broadcasted_iota(
                         jnp.int32, (F,), 0) == f_enum
                     tighten = f1h & ~is_cat
-                    l_hi = jnp.where(tighten, jnp.minimum(prow_hi, thr),
-                                     prow_hi)
-                    r_lo = jnp.where(tighten, jnp.maximum(prow_lo, thr + 1),
+                    # rows in the default/missing bin follow default_left
+                    # regardless of the threshold: when that bin falls on
+                    # the far side, the default-direction child's box must
+                    # stay un-tightened along the split feature or the
+                    # pairwise comparability test would wrongly exclude
+                    # rows the child actually contains
+                    d_eff = jnp.where(mtype == 2, nb - 1, dbin)
+                    has_miss = mtype != 0
+                    miss_l = has_miss & dl & (d_eff > thr)
+                    miss_r = has_miss & (~dl) & (d_eff <= thr)
+                    l_hi = jnp.where(tighten & ~miss_l,
+                                     jnp.minimum(prow_hi, thr), prow_hi)
+                    r_lo = jnp.where(tighten & ~miss_r,
+                                     jnp.maximum(prow_lo, thr + 1),
                                      prow_lo)
                     leaf_lo = jnp.where(
                         (iot_l1 == wr_a)[:, None], prow_lo[None, :],
