@@ -395,6 +395,18 @@ class BinnedDataset:
                               default=2)
         return np.uint8 if max_bin_overall <= 256 else np.uint16
 
+    def bin_matrix(self, data: np.ndarray) -> np.ndarray:
+        """Bin NEW raw rows with this dataset's mappers into the packed
+        (n, num_groups) layout — the same transform validation sets get
+        (reference: LoadFromFileAlignWithOtherDataset).  For trees trained
+        against this dataset, bin-space traversal of the result is EXACT
+        (split thresholds are bin uppers)."""
+        data = np.asarray(data)
+        cols = {f: self.bin_mappers[f].values_to_bins(data[:, f])
+                for f in self.used_features}
+        return self._pack_groups(cols, data.shape[0]).astype(
+            self._bin_dtype())
+
     def _pack_groups(self, cols: Dict[int, np.ndarray], n: int) -> np.ndarray:
         """Pack per-feature bin columns into the (n, num_groups) matrix."""
         out = np.zeros((n, len(self.groups)), dtype=np.int32)

@@ -467,6 +467,25 @@ class GBDT:
         ih = jnp.ones_like(hess) if const_h else jnp.trunc(hess / hs + rh)
         return ig * gs, ih * hs
 
+    def _leaf_rows(self, record, num_nodes: int):
+        """Per-leaf train row lookup via device traversal of the built tree.
+
+        Partition-record-independent (the sharded learners never replicate
+        their per-shard partition arrays off the mesh), so renewal / linear
+        fitting work identically for serial and distributed training.
+        Returns ``rows(leaf) -> np.ndarray`` of original row ids.
+        """
+        nodes = self.learner.node_arrays_for_predict(record)
+        leaf_idx = np.asarray(self._traverse_train(nodes, self.train_binned))
+        order = np.argsort(leaf_idx, kind="stable")
+        bounds = np.searchsorted(leaf_idx[order],
+                                 np.arange(num_nodes + 2))
+
+        def rows(leaf: int) -> np.ndarray:
+            return order[bounds[leaf]:bounds[leaf + 1]]
+
+        return rows
+
     def _renew_quant_leaf_outputs(self, record, num_nodes: int, grad, hess):
         """Recompute leaf outputs from the TRUE (un-quantized) gradient sums
         (reference: GradientDiscretizer::RenewIntGradTreeOutput,
@@ -474,18 +493,14 @@ class GBDT:
         from ..ops.split import leaf_output
         cfg = self.config
         num_leaves = num_nodes + 1
-        indices = np.asarray(record["indices"])
-        leaf_start = np.asarray(record["leaf_start"])
-        leaf_cnt = np.asarray(record["leaf_cnt"])
+        leaf_rows = self._leaf_rows(record, num_nodes)
         g = np.asarray(grad)
         h = np.asarray(hess)
         new_values = np.asarray(record["leaf_value"]).copy()
         for leaf in range(num_leaves):
-            s, c = int(leaf_start[leaf]), int(leaf_cnt[leaf])
-            if c <= 0:
+            rows = leaf_rows(leaf)
+            if len(rows) == 0:
                 continue
-            rows = indices[s:s + c]
-            rows = rows[rows < len(g)]
             sum_g = float(g[rows].sum())
             sum_h = float(h[rows].sum())
             new_values[leaf] = float(leaf_output(
@@ -519,9 +534,7 @@ class GBDT:
                         paths[~child] = feats
                     else:
                         stack.append((child, feats))
-        indices = np.asarray(record["indices"])
-        ls = np.asarray(record["leaf_start"])
-        lc = np.asarray(record["leaf_cnt"])
+        leaf_rows = self._leaf_rows(record, num_nodes)
         g = np.asarray(grad, dtype=np.float64)
         h = np.asarray(hess, dtype=np.float64)
         lam = float(cfg.linear_lambda)
@@ -529,9 +542,7 @@ class GBDT:
         tree.is_linear = True
         for leaf in range(num_leaves):
             feats = list(dict.fromkeys(paths[leaf]))
-            s, c = int(ls[leaf]), int(lc[leaf])
-            rows = indices[s:s + c]
-            rows = rows[rows < len(g)]
+            rows = leaf_rows(leaf)
             tree.leaf_features[leaf] = []
             tree.leaf_coeff[leaf] = []
             tree.leaf_const[leaf] = float(tree.leaf_value[leaf])
@@ -609,11 +620,11 @@ class GBDT:
 
         use_sharded = self.sharded_builder is not None
         bag_mask = bag_cnt = None
-        if use_sharded:
-            if self.goss or self.need_bagging:
-                log.warning("bagging/GOSS row sampling is not yet supported by "
-                            "the distributed tree learners; using all rows")
-        elif self.goss:
+        # sampling is a full-length row predicate + gradient masking, so it
+        # composes with the sharded learners exactly as with the serial one
+        # (reference: bagging.hpp:13 / goss.hpp:18 compose with every
+        # parallel learner); only the per-shard in-bag counts differ
+        if self.goss:
             grad, hess, bag_mask, bag_cnt = self._goss_sample(
                 grad, hess, self.iter)
         else:
@@ -642,7 +653,8 @@ class GBDT:
                 if use_sharded:
                     record = self.sharded_builder.build_tree(
                         gk, hk, feature_mask, seed=tree_seed,
-                        feat_used=self._cegb_feat_used)
+                        feat_used=self._cegb_feat_used,
+                        bag_mask=self._bag_mask_host)
                 else:
                     record = self.learner.build_tree(
                         gk, hk, bag_cnt, feature_mask, seed=tree_seed,
@@ -661,30 +673,15 @@ class GBDT:
             leaf_value_dev = record["leaf_value"]
             if (self.use_quant and self.config.quant_train_renew_leaf
                     and num_nodes > 0):
-                if use_sharded:
-                    log.warning("quant_train_renew_leaf is not yet supported "
-                                "by the distributed learners")
-                else:
-                    leaf_value_dev = self._renew_quant_leaf_outputs(
-                        record, num_nodes, gk_true, hk_true)
+                leaf_value_dev = self._renew_quant_leaf_outputs(
+                    record, num_nodes, gk_true, hk_true)
             if (self.objective is not None
                     and self.objective.is_renew_tree_output and num_nodes > 0):
-                if use_sharded:
-                    log.warning("leaf-output renewal (%s objective) is not yet "
-                                "supported by the distributed learners",
-                                self.objective.name)
-                else:
-                    leaf_value_dev = self._renew_tree_output(record, num_nodes, k)
+                leaf_value_dev = self._renew_tree_output(record, num_nodes, k)
             # device score update via traversal
             nodes = self.learner.node_arrays_for_predict(record)
             delta_leaf = leaf_value_dev * self.shrinkage_rate
-            use_linear = self.config.linear_tree and not use_sharded
-            if self.config.linear_tree and use_sharded:
-                if not getattr(self, "_warned_linear_sharded", False):
-                    log.warning("linear_tree is not yet supported by the "
-                                "distributed learners; training constant "
-                                "leaves")
-                    self._warned_linear_sharded = True
+            use_linear = self.config.linear_tree
             if not use_linear:
                 with global_timer.section("GBDT::UpdateScore",
                                           sync=lambda: self.scores):
@@ -744,9 +741,7 @@ class GBDT:
         alpha = self.objective.renew_leaf_alpha()
         weights = self.objective.renew_weights()
         num_leaves = num_nodes + 1
-        indices = np.asarray(record["indices"])
-        leaf_start = np.asarray(record["leaf_start"])
-        leaf_cnt = np.asarray(record["leaf_cnt"])
+        leaf_rows = self._leaf_rows(record, num_nodes)
         label = np.asarray(self.objective.label)
         score = np.asarray(self.scores if self.num_tree_per_iteration == 1
                            else self.scores[:, k])
@@ -754,11 +749,9 @@ class GBDT:
         new_values = np.asarray(record["leaf_value"]).copy()
         from .objective import _weighted_percentile_host
         for leaf in range(num_leaves):
-            s, c = int(leaf_start[leaf]), int(leaf_cnt[leaf])
-            if c <= 0:
+            rows = leaf_rows(leaf)
+            if len(rows) == 0:
                 continue
-            rows = indices[s:s + c]
-            rows = rows[rows < self.num_data]
             bm = getattr(self, "_bag_mask_host", None)
             if bm is not None:
                 rows = rows[bm[rows]]
@@ -818,6 +811,71 @@ class GBDT:
     def current_iteration(self) -> int:
         return self.iter
 
+    def _predict_raw_device(self, data: np.ndarray, start_iteration: int,
+                            end_iter: int):
+        """Batch prediction on device: bin the rows with the TRAINING
+        mappers (exact for in-session trees — thresholds are bin uppers)
+        and traverse all trees in one jitted vmap (the TPU replacement
+        for the reference's OpenMP batch predictor, predictor.hpp:30).
+        Returns None when this model can't take the device path (loaded
+        trees, linear leaves, no train data)."""
+        K = self.num_tree_per_iteration
+        if (self.train_data is None or self.config.linear_tree
+                or getattr(self.train_data, "bin_mappers", None) is None
+                or end_iter <= start_iteration):
+            return None
+        # the stacked traversal compiles per tree COUNT; only batches big
+        # enough to amortize that (and the binning) take the device path
+        if np.asarray(data).shape[0] < 4096:
+            return None
+        dts = self.device_trees[start_iteration * K:end_iter * K]
+        if len(dts) != (end_iter - start_iteration) * K or \
+                any(d is None for d in dts):
+            return None
+        try:
+            binned = self.train_data.bin_matrix(np.asarray(data))
+        except Exception:
+            return None
+        binned_dev = jnp.asarray(binned)
+        if not hasattr(self, "_stacked_predict"):
+            def stacked(nodes, deltas, b):
+                leaves = jax.vmap(
+                    lambda nd: predict_leaf_binned(b, nd))(nodes)   # (T, n)
+                vals = jax.vmap(jnp.take)(deltas, leaves)           # (T, n)
+                return jnp.sum(vals, axis=0)
+            self._stacked_predict = jax.jit(stacked)
+        # stack the per-tree node arrays on the HOST with ONE device_get
+        # (per-tree jnp.stack dispatches hundreds of tiny tunnel ops) and
+        # cache per (range, model length)
+        cache = getattr(self, "_stack_cache", None)
+        ckey = (start_iteration, end_iter, len(self.models))
+        if cache is None or cache[0] != ckey:
+            sel_all = self.device_trees[start_iteration * K:end_iter * K]
+            host = jax.device_get([(d["nodes"], d["leaf_value"])
+                                   for d in sel_all])
+            per_k = []
+            for k in range(K):
+                hk = host[k::K]
+                nodes = jax.tree.map(lambda *a: jnp.asarray(np.stack(a)),
+                                     *[h[0] for h in hk])
+                deltas = jnp.asarray(np.stack([h[1] for h in hk]))
+                per_k.append((nodes, deltas))
+            cache = (ckey, per_k)
+            self._stack_cache = cache
+        n = data.shape[0]
+        out = np.zeros((n, K), dtype=np.float64)
+        for k in range(K):
+            nodes, deltas = cache[1][k]
+            col = np.asarray(self._stacked_predict(nodes, deltas,
+                                                   binned_dev),
+                             dtype=np.float64)
+            # boost-from-average is folded into the first HOST tree only;
+            # the device deltas exclude it
+            if start_iteration == 0 and abs(self.init_scores[k]) > K_EPSILON:
+                col = col + self.init_scores[k]
+            out[:, k] = col
+        return out
+
     def predict_raw(self, data: np.ndarray, start_iteration: int = 0,
                     num_iteration: int = -1,
                     pred_early_stop: bool = False,
@@ -846,6 +904,12 @@ class GBDT:
                                  and self.objective.name in
                                  ("binary", "cross_entropy",
                                   "cross_entropy_lambda"))))
+        if not use_es:
+            dev = self._predict_raw_device(data, start_iteration, end_iter)
+            if dev is not None:
+                if self.average_output and end_iter > start_iteration:
+                    dev /= (end_iter - start_iteration)
+                return dev[:, 0] if K == 1 else dev
         active = np.ones(n, dtype=bool) if use_es else None
         any_stopped = False
         for it in range(start_iteration, end_iter):

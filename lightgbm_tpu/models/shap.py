@@ -157,6 +157,133 @@ def _expected_value(tree) -> float:
                         tree.leaf_count[:tree.num_leaves]) / total)
 
 
+# ---------------------------------------------------------------------------
+# Row-batched TreeSHAP: the recursion's control flow (DFS order, ancestor
+# same-feature unwinds, zero fractions = count ratios) is row-INDEPENDENT;
+# only one_fraction / pweight carry per-row data.  Vectorizing those as
+# (n,) arrays runs the exact tree.cpp recursion once per tree instead of
+# once per (row, tree).
+# ---------------------------------------------------------------------------
+
+class _BPath:
+    __slots__ = ("fi", "zf", "of", "pw")
+
+    def __init__(self, fi=-1, zf=0.0, of=None, pw=None):
+        self.fi = fi        # feature index (scalar)
+        self.zf = zf        # zero fraction (scalar: count ratio)
+        self.of = of        # one fraction (n,)
+        self.pw = pw        # pweight (n,)
+
+
+def _b_extend(path, ud, zf, of, fi, n):
+    path[ud] = _BPath(fi, zf, of,
+                      np.ones(n) if ud == 0 else np.zeros(n))
+    for i in range(ud - 1, -1, -1):
+        path[i + 1].pw = path[i + 1].pw + of * path[i].pw * (i + 1) / (ud + 1)
+        path[i].pw = zf * path[i].pw * (ud - i) / (ud + 1)
+
+
+def _b_unwind(path, ud, pi):
+    of = path[pi].of
+    zf = path[pi].zf
+    nz = of != 0
+    next_one = path[ud].pw.copy()
+    for i in range(ud - 1, -1, -1):
+        tmp = path[i].pw
+        with np.errstate(divide="ignore", invalid="ignore"):
+            pw_a = next_one * (ud + 1) / ((i + 1) * of)
+        pw_b = tmp * (ud + 1) / (zf * (ud - i))
+        path[i].pw = np.where(nz, pw_a, pw_b)
+        next_one = np.where(nz, tmp - path[i].pw * zf * (ud - i) / (ud + 1),
+                            next_one)
+    for i in range(pi, ud):
+        path[i] = _BPath(path[i + 1].fi, path[i + 1].zf,
+                         path[i + 1].of, path[i].pw)
+
+
+def _b_unwound_sum(path, ud, pi):
+    of = path[pi].of
+    zf = path[pi].zf
+    nz = of != 0
+    next_one = path[ud].pw
+    total = np.zeros_like(next_one)
+    for i in range(ud - 1, -1, -1):
+        with np.errstate(divide="ignore", invalid="ignore"):
+            tmp = next_one * (ud + 1) / ((i + 1) * of)
+        alt = path[i].pw / (zf * (ud - i) / (ud + 1))
+        total = total + np.where(nz, tmp, alt)
+        next_one = np.where(nz,
+                            path[i].pw - tmp * zf * (ud - i) / (ud + 1),
+                            next_one)
+    return total
+
+
+def _b_decision(tree, node, col_vals):
+    """(n,) goes-left decisions at one node (reference: tree.h Decision,
+    incl. the categorical bitset arm the per-row path also uses)."""
+    dtp = int(tree.decision_type[node])
+    if dtp & K_CATEGORICAL_MASK:
+        nid = np.full(len(col_vals), node, dtype=np.int64)
+        return tree._categorical_decision(nid, col_vals)
+    default_left = bool(dtp & K_DEFAULT_LEFT_MASK)
+    mtype = (dtp >> 2) & 3
+    nan_mask = np.isnan(col_vals)
+    fv = np.where(nan_mask & (mtype != MISSING_NAN), 0.0, col_vals)
+    is_missing = ((mtype == MISSING_ZERO) &
+                  (np.abs(fv) <= K_ZERO_THRESHOLD)) | \
+                 ((mtype == MISSING_NAN) & nan_mask)
+    return np.where(is_missing, default_left, fv <= tree.threshold[node])
+
+
+def _tree_shap_batch(tree, X, phi):
+    """Accumulate this tree's SHAP values for every row of ``X`` into
+    ``phi`` ((n, F+1)); exact port of the per-row recursion above with
+    (n,)-vector one_fractions/pweights."""
+    n = X.shape[0]
+
+    def recurse(node, ud, parent_path, pzf, pof, pfi):
+        path = [_BPath(p.fi, p.zf, p.of, None if p.pw is None
+                       else p.pw.copy()) for p in parent_path[:ud]]
+        path += [_BPath() for _ in range(2)]
+        _b_extend(path, ud, pzf, pof, pfi, n)
+
+        if node < 0:
+            leaf = ~node
+            lv = float(tree.leaf_value[leaf])
+            for i in range(1, ud + 1):
+                w = _b_unwound_sum(path, ud, i)
+                el = path[i]
+                phi[:, el.fi] += w * (el.of - el.zf) * lv
+            return
+
+        f = int(tree.split_feature[node])
+        goes_left = np.asarray(_b_decision(tree, node,
+                                           X[:, f].astype(np.float64)))
+        lc, rc = int(tree.left_child[node]), int(tree.right_child[node])
+        w_node = _node_weight(tree, node)
+        zf_l = _child_weight(tree, lc) / w_node
+        zf_r = _child_weight(tree, rc) / w_node
+        inc_zf = 1.0
+        inc_of = np.ones(n)
+        pi = 0
+        while pi <= ud:
+            if path[pi].fi == f:
+                break
+            pi += 1
+        if pi != ud + 1:
+            inc_zf = path[pi].zf
+            inc_of = path[pi].of.copy()
+            _b_unwind(path, ud, pi)
+            ud -= 1
+
+        recurse(lc, ud + 1, path, zf_l * inc_zf,
+                np.where(goes_left, inc_of, 0.0), f)
+        recurse(rc, ud + 1, path, zf_r * inc_zf,
+                np.where(goes_left, 0.0, inc_of), f)
+
+    recurse(0, 0, [], 1.0, np.ones(n), -1)
+
+
 def predict_contrib(gbdt, data: np.ndarray, start_iteration: int = 0,
                     num_iteration: int = -1) -> np.ndarray:
     """SHAP values with the expected-value bias in the last column
@@ -168,7 +295,7 @@ def predict_contrib(gbdt, data: np.ndarray, start_iteration: int = 0,
     end_iter = total_iters if num_iteration < 0 else min(
         total_iters, start_iteration + num_iteration)
     out = np.zeros((n, K, num_features + 1), dtype=np.float64)
-    max_leaves = max((t.num_leaves for t in gbdt.models), default=2)
+    data = np.asarray(data, dtype=np.float64)
     for it in range(start_iteration, end_iter):
         for k in range(K):
             tree = gbdt.models[it * K + k]
@@ -176,13 +303,10 @@ def predict_contrib(gbdt, data: np.ndarray, start_iteration: int = 0,
                 out[:, k, -1] += tree.leaf_value[0] if len(tree.leaf_value) else 0.0
                 continue
             expected = _expected_value(tree)
-            maxd = tree.num_leaves + 2
-            parent_path = [_PathElement() for _ in range(maxd + 1)]
-            for r in range(n):
-                phi = np.zeros(num_features + 1)
-                _tree_shap(tree, data[r], phi, 0, 0, parent_path, 1.0, 1.0, -1)
-                out[r, k, :-1] += phi[:-1]
-                out[r, k, -1] += expected
+            phi = np.zeros((n, num_features + 1))
+            _tree_shap_batch(tree, data, phi)
+            out[:, k, :-1] += phi[:, :-1]
+            out[:, k, -1] += expected
     if K == 1:
         return out[:, 0, :]
     return out.reshape(n, K * (num_features + 1))

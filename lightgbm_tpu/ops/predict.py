@@ -30,6 +30,13 @@ def predict_leaf_binned(binned: jnp.ndarray, node: dict,
     n = binned.shape[0]
     num_nodes = node["num_nodes"]
     cur = jnp.zeros((n,), dtype=jnp.int32)
+    # rows on the LANE axis: the per-row column read becomes a masked
+    # reduction over G (a per-row take_along_axis over a few-lane axis
+    # runs ~400x slower on TPU — same pathology as the partition's
+    # split-column read, see PERF.md)
+    binned_t = binned.T.astype(jnp.int32)            # (G, n)
+    g_iota = jax.lax.broadcasted_iota(jnp.int32, binned_t.shape, 0)
+
     # empty tree (single leaf): everything is leaf 0
     def empty(_):
         return jnp.full((n,), 0, dtype=jnp.int32)
@@ -44,8 +51,8 @@ def predict_leaf_binned(binned: jnp.ndarray, node: dict,
             active = c >= 0
             nid = jnp.maximum(c, 0)
             col = node["col"][nid]
-            gb = jnp.take_along_axis(
-                binned, col[:, None].astype(jnp.int32), axis=1)[:, 0].astype(jnp.int32)
+            gb = jnp.sum(jnp.where(g_iota == col[None, :], binned_t, 0),
+                         axis=0)
             # bundled features: recover the feature-local bin
             fb_raw = gb - node["bin_start"][nid]
             nb = node["num_bin"][nid]

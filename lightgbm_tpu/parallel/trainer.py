@@ -97,9 +97,10 @@ class ShardedTreeBuilder:
 
         lr = self.learner
 
-        def build_shard(binned, grad, hess, cnt, feature_mask, seed,
+        def build_shard(binned, grad, hess, bag_cnt, feature_mask, seed,
                         feat_used):
-            # binned: (local_n+1, G); grad/hess: (local_n,); cnt: (1,)
+            # binned: (local_n+1, G); grad/hess: (local_n,); bag_cnt: (1,)
+            # local in-bag rows (== local valid rows without sampling)
             C = lr.row0
             part_bins = jnp.pad(
                 binned.T, ((0, 0), (C, lr.N_pad - C - binned.shape[0])))
@@ -114,14 +115,15 @@ class ShardedTreeBuilder:
                 mine = (fidx >= d * per) & (fidx < (d + 1) * per)
                 feature_mask = feature_mask & mine
             return lr._build_impl(part_bins, grad_l, hess_l,
-                                  cnt[0], feature_mask, seed, feat_used)
+                                  bag_cnt[0], feature_mask, seed, feat_used)
 
         row_spec = P() if self.mode == "feature" else P(AXIS)
         in_specs = (row_spec, row_spec, row_spec, P(AXIS), P(), P(), P())
 
-        def wrapper(binned, grad, hess, cnt, feature_mask, seed, feat_used):
-            rec = build_shard(binned, grad, hess, cnt, feature_mask, seed,
-                              feat_used)
+        def wrapper(binned, grad, hess, bag_cnt, feature_mask, seed,
+                    feat_used):
+            rec = build_shard(binned, grad, hess, bag_cnt, feature_mask,
+                              seed, feat_used)
             # drop per-shard-varying state (partition arrays and LOCAL leaf
             # offsets/counts) — only globally-identical values may be
             # replicated out; consumers must use leaf_cnt_g
@@ -160,12 +162,28 @@ class ShardedTreeBuilder:
         return jax.device_put(arr, NamedSharding(self.mesh, P(AXIS)))
 
     def build_tree(self, grad, hess, feature_mask=None,
-                   seed: int = 0, feat_used=None) -> Dict[str, Any]:
+                   seed: int = 0, feat_used=None,
+                   bag_mask=None) -> Dict[str, Any]:
         lr = self.learner
         if feature_mask is None:
             feature_mask = jnp.ones((lr.F,), dtype=bool)
         if feat_used is None:
             feat_used = jnp.zeros((lr.F,), dtype=bool)
+        if bag_mask is None:
+            bag_counts = self.local_counts
+        else:
+            # bagging/GOSS masks are full-length row predicates; each shard
+            # needs ITS in-bag count for count estimation (the reference's
+            # bagging composes with every parallel learner, bagging.hpp:13)
+            m = np.asarray(bag_mask).astype(bool)
+            if self.mode == "feature":
+                counts = [int(m.sum())] * self.ndev
+            else:
+                counts = [int(m[d * self.local_n:(d + 1) * self.local_n]
+                              .sum()) for d in range(self.ndev)]
+            bag_counts = jax.device_put(
+                np.asarray(counts, np.int32),
+                NamedSharding(self.mesh, P(AXIS)))
         return self._build_sharded(self.binned_sharded, self.pad_rows(grad),
-                                   self.pad_rows(hess), self.local_counts,
+                                   self.pad_rows(hess), bag_counts,
                                    feature_mask, jnp.int32(seed), feat_used)
