@@ -444,11 +444,12 @@ class Booster:
                 f"init_model has num_tree_per_iteration="
                 f"{ig.num_tree_per_iteration}, training config needs "
                 f"{g.num_tree_per_iteration}")
-        if type(g).__name__ in ("DART", "RF"):
-            log.warning("init_model continuation is not supported for "
-                        "boosting=%s; starting fresh",
-                        type(g).__name__.lower())
-            return self
+        if type(g).__name__ == "RF":
+            # the reference RF rebuilds fixed-score gradients that a loaded
+            # model cannot reproduce; failing loudly beats silently training
+            # a different model than the pipeline requested
+            raise ValueError(
+                "init_model continuation is not supported for boosting=rf")
         raw = self._raw_matrix(self.train_set, init_bst)
         if raw is None:
             raise ValueError(

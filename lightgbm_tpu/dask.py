@@ -150,11 +150,34 @@ class _DaskLGBMModel:
                     X = X.rechunk({1: X.shape[1]})
             except Exception:
                 pass
-        returns_2d = (method == "predict_proba"
-                      or kwargs.get("pred_contrib")
-                      or kwargs.get("pred_leaf"))
-        if returns_2d:
+        # Output width: without explicit chunks dask assumes output chunks
+        # equal input chunks, declaring n_features columns while real
+        # blocks have num_class / num_trees / contrib columns.  ncols=None
+        # means the per-block result is 1-D.  raw_score (and a callable
+        # custom objective, whose probabilities can't be computed —
+        # sklearn.py predict_proba) return raw margins: 1-D for
+        # binary/regression, (rows, num_class) for multiclass.
+        nclass = max(int(getattr(self, "_n_classes", 1)), 1)
+        multiclass = nclass > 2
+        raw_like = bool(kwargs.get("raw_score")) or (
+            method == "predict_proba"
+            and callable(getattr(self, "_objective", None)))
+        if kwargs.get("pred_leaf"):
+            try:
+                ncols = int(self._Booster.num_trees())
+            except Exception:
+                ncols = -1          # 2-D, width unknown
+        elif kwargs.get("pred_contrib"):
+            ncols = (int(X.shape[1]) + 1) * (nclass if multiclass else 1)
+        elif method == "predict_proba":
+            ncols = nclass if multiclass else (None if raw_like else 2)
+        else:
+            ncols = nclass if (multiclass and raw_like) else None
+        if ncols is not None:
             meta = np.empty((0, 0), dtype=np.float64)
+            if ncols > 0 and getattr(X, "chunks", None) is not None:
+                return X.map_blocks(block, meta=meta,
+                                    chunks=(X.chunks[0], (ncols,)))
             return X.map_blocks(block, meta=meta)
         meta = np.empty((0,), dtype=np.float64)
         return X.map_blocks(block, meta=meta, drop_axis=(

@@ -39,6 +39,10 @@ class GBDT:
         self.models: List[Tree] = []
         self.device_trees: List[Dict[str, Any]] = []  # node arrays + leaf values
         self._continued = False        # set by continue_from
+        # bumped on every structural model change (append/pop/scale) so
+        # derived caches (the stacked device-predict arrays) can never
+        # serve a stale model of the same length
+        self._model_version = 0
         self.iter = 0
         self.shrinkage_rate = float(config.learning_rate)
         self.num_tree_per_iteration = (objective.num_model_per_iteration
@@ -278,7 +282,11 @@ class GBDT:
             else:
                 tree.leaf_value = np.asarray([self.init_scores[0]])
         self.models.append(tree)
-        self.device_trees.append({"nodes": nodes, "leaf_value": delta_leaf})
+        self.device_trees.append({
+            "nodes": nodes, "leaf_value": delta_leaf,
+            "has_cat_split": bool(
+                np.any(host_record["node_is_cat"][:num_nodes]))})
+        self._model_version += 1
         return num_nodes == 0
 
     def _flush_pending(self) -> None:
@@ -310,6 +318,12 @@ class GBDT:
         # node arrays.  Rollback past the continuation boundary is refused.
         self.device_trees = [None] * len(self.models)
         self.iter = len(self.models) // K
+        self._model_version += 1
+        # DART continuation: init-model trees are excluded from dropping
+        # (reference: dart.hpp:108-122 draws over the session's iter_ only,
+        # offset by num_init_iteration_)
+        if hasattr(self, "init_iters"):
+            self.init_iters = self.iter
         self._continued = True
         # the loaded model's boost_from_average lives in its first tree
         # (folded at materialization), so the fresh booster's must not
@@ -712,8 +726,10 @@ class GBDT:
                     if tree.is_linear:
                         tree.leaf_const = np.asarray([self.init_scores[k]])
             self.models.append(tree)
-            self.device_trees.append({"nodes": nodes,
-                                      "leaf_value": delta_leaf})
+            self.device_trees.append({
+                "nodes": nodes, "leaf_value": delta_leaf,
+                "has_cat_split": bool(
+                    np.any(host_record["node_is_cat"][:num_nodes]))})
         self.iter += 1
         if should_stop:
             log.warning("Stopped training because there are no more leaves "
@@ -832,6 +848,17 @@ class GBDT:
         if len(dts) != (end_iter - start_iteration) * K or \
                 any(d is None for d in dts):
             return None
+        # trees with categorical SPLITS: the bin-space traversal maps
+        # unseen categories (and NaN) to bin 0 — the most-frequent
+        # category — while the host walk and the reference predictor
+        # (tree.h CategoricalDecision) send them to the default side;
+        # refuse the device path rather than silently diverge on
+        # out-of-vocabulary data.  Trees that merely COULD have split
+        # categorically (the "is_cat" key exists whenever the dataset
+        # declares a categorical column) keep the fast path.
+        if any(d.get("has_cat_split", "is_cat" in d["nodes"])
+               for d in dts):
+            return None
         try:
             binned = self.train_data.bin_matrix(np.asarray(data))
         except Exception:
@@ -848,7 +875,8 @@ class GBDT:
         # (per-tree jnp.stack dispatches hundreds of tiny tunnel ops) and
         # cache per (range, model length)
         cache = getattr(self, "_stack_cache", None)
-        ckey = (start_iteration, end_iter, len(self.models))
+        ckey = (start_iteration, end_iter, len(self.models),
+                self._model_version)
         if cache is None or cache[0] != ckey:
             sel_all = self.device_trees[start_iteration * K:end_iter * K]
             host = jax.device_get([(d["nodes"], d["leaf_value"])
@@ -962,6 +990,7 @@ class GBDT:
             log.warning("cannot roll back past the init_model boundary "
                         "(loaded trees have no device arrays)")
             return
+        self._model_version += 1
         for k in range(K):
             dt = self.device_trees.pop()
             tree = self.models.pop()
@@ -1011,8 +1040,11 @@ class DART(GBDT):
         # IMMEDIATELY after its iteration; the fused path's lag breaks that
         self._fused = None
         self.drop_rng = np.random.RandomState(config.drop_seed)
-        self.tree_weights: List[float] = []  # per iteration (dart.hpp:196)
+        self.tree_weights: List[float] = []  # per SESSION iteration (dart.hpp:196)
         self.sum_weight = 0.0
+        # continuation boundary: trees below this iteration came from an
+        # init model and are never dropped (dart.hpp num_init_iteration_)
+        self.init_iters = 0
 
     def train_one_iter(self, grad=None, hess=None) -> bool:
         # select trees to drop (reference: dart.hpp DroppingTrees:97 —
@@ -1021,18 +1053,20 @@ class DART(GBDT):
         self._flush_pending()
         cfg = self.config
         K = self.num_tree_per_iteration
-        n_iters = len(self.models) // K
+        # only the session's own iterations are droppable; init-model trees
+        # sit below the boundary (dart.hpp:108-122, num_init_iteration_)
+        n_droppable = len(self.models) // K - self.init_iters
         base_lr = float(cfg.learning_rate)
         drop_iters: List[int] = []
-        if n_iters > 0 and self.drop_rng.rand() >= cfg.skip_drop:
+        if n_droppable > 0 and self.drop_rng.rand() >= cfg.skip_drop:
             drop_rate = float(cfg.drop_rate)
             max_drop = int(cfg.max_drop)
             if cfg.uniform_drop:
                 if max_drop > 0:
-                    drop_rate = min(drop_rate, max_drop / n_iters)
-                for i in range(n_iters):
+                    drop_rate = min(drop_rate, max_drop / n_droppable)
+                for i in range(n_droppable):
                     if self.drop_rng.rand() < drop_rate:
-                        drop_iters.append(i)
+                        drop_iters.append(self.init_iters + i)
                         if max_drop > 0 and len(drop_iters) >= max_drop:
                             break
             else:
@@ -1041,10 +1075,10 @@ class DART(GBDT):
                 if max_drop > 0 and self.sum_weight > 0:
                     drop_rate = min(drop_rate,
                                     max_drop * inv_avg / self.sum_weight)
-                for i in range(n_iters):
+                for i in range(n_droppable):
                     p = drop_rate * self.tree_weights[i] * inv_avg
                     if self.drop_rng.rand() < p:
-                        drop_iters.append(i)
+                        drop_iters.append(self.init_iters + i)
                         if max_drop > 0 and len(drop_iters) >= max_drop:
                             break
         k_drop = len(drop_iters)
@@ -1077,7 +1111,7 @@ class DART(GBDT):
                     self._add_tree_to_scores(t_idx, final - 1.0, train=False)
                     self._scale_tree(t_idx, final)
                 if not cfg.uniform_drop:
-                    self.tree_weights[it] *= final
+                    self.tree_weights[it - self.init_iters] *= final
             if not cfg.uniform_drop:
                 self.sum_weight = sum(self.tree_weights)
         if not cfg.uniform_drop:
@@ -1085,11 +1119,22 @@ class DART(GBDT):
             self.sum_weight += self.shrinkage_rate
         return stop
 
+    def rollback_one_iter(self) -> None:
+        # keep the non-uniform drop bookkeeping aligned: the rolled-back
+        # iteration's weight must leave tree_weights/sum_weight or every
+        # later selection and normalize step reads a shifted entry
+        n_before = len(self.models)
+        super().rollback_one_iter()
+        if (len(self.models) < n_before and not self.config.uniform_drop
+                and self.tree_weights):
+            self.sum_weight -= self.tree_weights.pop()
+
     def _scale_tree(self, t_idx: int, factor: float) -> None:
         self.models[t_idx].leaf_value *= factor
         self.models[t_idx].internal_value *= factor
         dt = self.device_trees[t_idx]
         dt["leaf_value"] = dt["leaf_value"] * factor
+        self._model_version += 1
 
     def _add_tree_to_scores(self, t_idx: int, factor: float,
                             train: bool = True, valid: bool = True) -> None:

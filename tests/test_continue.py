@@ -132,3 +132,55 @@ def test_cli_input_model_continues(rng, tmp_path):
                        f"output_model={m2}"])
     bst = lgb.Booster(model_file=str(m2))
     assert bst.num_trees() == 7
+
+
+def test_continue_dart(rng):
+    """DART continuation: init trees are kept, never dropped, and training
+    proceeds (reference: dart.hpp num_init_iteration_ offsets)."""
+    X, y = _data(rng)
+    params = dict(PARAMS, boosting="dart", drop_rate=0.5, drop_seed=7)
+    first = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
+    p_head_before = first.predict(X[:100])
+    cont = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5,
+                     init_model=first)
+    assert cont.num_trees() == 10
+    # the head-only prediction equals the init model exactly: init trees
+    # were never dropped/renormalized
+    p_head_after = cont.predict(X[:100], num_iteration=5)
+    np.testing.assert_allclose(p_head_after, p_head_before, rtol=1e-6)
+    assert _l2(cont, X, y) < _l2(first, X, y)
+
+
+def test_continue_rf_raises(rng):
+    X, y = _data(rng)
+    params = dict(PARAMS, boosting="rf", bagging_freq=1,
+                  bagging_fraction=0.8)
+    first = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=3)
+    with pytest.raises(ValueError, match="boosting=rf"):
+        lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=3,
+                  init_model=first)
+
+
+def test_rollback_invalidates_device_predict_cache(rng):
+    """rollback + retrain restores the same model LENGTH with a different
+    last tree; the stacked device-predict cache must not serve the stale
+    arrays (advisor finding, round 2)."""
+    X, y = _data(rng, n=5000)   # >= 4096 rows so the device path engages
+    bst = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=5)
+    g = bst._gbdt
+    p1 = bst.predict(X)          # populates the stacked cache
+    g.rollback_one_iter()
+    # retrain on custom (perturbed) gradients so the replacement tree
+    # genuinely differs from the rolled-back one (deterministic
+    # retraining would otherwise reproduce it exactly)
+    resid = np.asarray(g.scores) - (y + 0.5 * X[:, 3])
+    g.train_one_iter(resid.astype(np.float32),
+                     np.ones_like(resid, dtype=np.float32))
+    g._flush_pending()
+    p2 = bst.predict(X)
+    # oracle: per-tree host traversal
+    host = np.zeros(len(X))
+    for t in g.models:
+        host += t.predict(X)
+    np.testing.assert_allclose(p2, host, rtol=1e-4, atol=1e-5)
+    assert not np.allclose(p1, p2)
