@@ -2,10 +2,10 @@
 
 API-parity module for the reference's python-package/lightgbm/plotting.py
 (plot_importance:37, plot_split_value_histogram:171, plot_metric:287,
-create_tree_digraph:614, plot_tree:740), re-implemented from scratch:
+create_tree_digraph:614, plot_tree:740).  Signatures and rendered content
+match the reference; the implementations are matplotlib-native:
 
-  * importance / metric / split-value plots use matplotlib directly;
-  * ``plot_tree`` draws the tree natively with matplotlib (no graphviz
+  * ``plot_tree`` draws the tree directly with matplotlib (no graphviz
     binary required — unlike the reference, which shells out to dot);
   * ``create_tree_digraph`` returns a ``graphviz.Digraph`` when the optional
     ``graphviz`` package is importable, else raises ImportError.
@@ -21,9 +21,11 @@ import numpy as np
 from .basic import Booster, LightGBMError
 
 
-def _check_not_tuple_of_2_elements(obj, obj_name: str) -> None:
-    if not isinstance(obj, tuple) or len(obj) != 2:
-        raise TypeError(f"{obj_name} must be a tuple of 2 elements.")
+def _window(pair, name: str):
+    """Validate an (lo, hi) axis-window argument."""
+    if not (isinstance(pair, tuple) and len(pair) == 2):
+        raise TypeError(f"{name} must be a tuple of 2 elements.")
+    return pair
 
 
 def _to_booster(model) -> Booster:
@@ -40,6 +42,13 @@ def _import_matplotlib():
         return plt
     except ImportError as e:  # pragma: no cover
         raise ImportError("You must install matplotlib to use plotting") from e
+
+
+def _new_axes(plt, figsize, dpi):
+    if figsize is not None:
+        _window(figsize, "figsize")
+    _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    return ax
 
 
 def plot_importance(booster, ax=None, height: float = 0.2,
@@ -59,41 +68,39 @@ def plot_importance(booster, ax=None, height: float = 0.2,
     booster = _to_booster(booster)
     if importance_type == "auto":
         importance_type = "split"
-    importance = booster.feature_importance(importance_type=importance_type)
-    feature_name = booster.feature_name()
-    if not len(importance):
+    imp = np.asarray(
+        booster.feature_importance(importance_type=importance_type),
+        dtype=np.float64)
+    if imp.size == 0:
         raise ValueError("Booster's feature_importance is empty.")
+    names = np.asarray(booster.feature_name(), dtype=object)
 
-    tuples = sorted(zip(feature_name, importance), key=lambda x: x[1])
+    # ascending by importance so the biggest bar lands on top
+    order = np.argsort(imp, kind="stable")
     if ignore_zero:
-        tuples = [x for x in tuples if x[1] > 0]
+        order = order[imp[order] > 0]
     if max_num_features is not None and max_num_features > 0:
-        tuples = tuples[-max_num_features:]
-    labels, values = zip(*tuples) if tuples else ((), ())
+        order = order[len(order) - max_num_features:]
+    shown = imp[order]
+    rows = np.arange(shown.size)
 
     if ax is None:
-        if figsize is not None:
-            _check_not_tuple_of_2_elements(figsize, "figsize")
-        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
-    ylocs = np.arange(len(values))
-    ax.barh(ylocs, values, align="center", height=height, **kwargs)
-    for x, y in zip(values, ylocs):
-        is_int = importance_type == "split" or float(x).is_integer()
-        txt = f"{int(x)}" if is_int else (
-            f"{x:.{precision}f}" if precision is not None else f"{x}")
-        ax.text(x + 1 if is_int else x, y, txt, va="center")
-    ax.set_yticks(ylocs)
-    ax.set_yticklabels(labels)
-    if xlim is not None:
-        _check_not_tuple_of_2_elements(xlim, "xlim")
-    else:
-        xlim = (0, max(values) * 1.1 if values else 1)
-    ax.set_xlim(xlim)
-    if ylim is not None:
-        _check_not_tuple_of_2_elements(ylim, "ylim")
-    else:
-        ylim = (-1, len(values))
-    ax.set_ylim(ylim)
+        ax = _new_axes(plt, figsize, dpi)
+    ax.barh(rows, shown, align="center", height=height, **kwargs)
+    counts_only = importance_type == "split"
+    for r, v in enumerate(shown):
+        if counts_only or v.is_integer():
+            ax.text(v + 1, r, f"{int(v)}", va="center")
+        elif precision is None:
+            ax.text(v, r, f"{v}", va="center")
+        else:
+            ax.text(v, r, f"{v:.{precision}f}", va="center")
+    ax.set_yticks(rows)
+    ax.set_yticklabels(names[order])
+    ax.set_xlim(_window(xlim, "xlim") if xlim is not None
+                else (0, 1.1 * shown.max() if shown.size else 1))
+    ax.set_ylim(_window(ylim, "ylim") if ylim is not None
+                else (-1, shown.size))
     if title is not None:
         ax.set_title(title)
     if xlabel is not None:
@@ -145,19 +152,15 @@ def plot_split_value_histogram(booster, feature, bins=None, ax=None,
             f"because feature {feature} was not used in splitting")
     hist, bin_edges = np.histogram(values, bins=bins or "auto")
     if ax is None:
-        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+        ax = _new_axes(plt, figsize, dpi)
     centers = (bin_edges[:-1] + bin_edges[1:]) / 2.0
     width = width_coef * (bin_edges[1] - bin_edges[0]) \
         if len(bin_edges) > 1 else width_coef
     ax.bar(centers, hist, width=width, **kwargs)
     if xlim is not None:
-        _check_not_tuple_of_2_elements(xlim, "xlim")
-        ax.set_xlim(xlim)
-    if ylim is not None:
-        _check_not_tuple_of_2_elements(ylim, "ylim")
-    else:
-        ylim = (0, max(hist) * 1.1)
-    ax.set_ylim(ylim)
+        ax.set_xlim(_window(xlim, "xlim"))
+    ax.set_ylim(_window(ylim, "ylim") if ylim is not None
+                else (0, hist.max() * 1.1))
     if title is not None:
         title = title.replace("@feature@", str(feature)) \
                      .replace("@index/name@", ftype)
@@ -182,63 +185,44 @@ def plot_metric(booster, metric: Optional[str] = None,
     plt = _import_matplotlib()
     if isinstance(booster, dict):
         eval_results = deepcopy(booster)
-    elif isinstance(booster, Booster) or hasattr(booster, "evals_result_"):
-        if hasattr(booster, "evals_result_"):
-            eval_results = deepcopy(booster.evals_result_)
-        else:
-            raise TypeError(
-                "booster must be a dict from record_evaluation or a fitted "
-                "LGBMModel with evals_result_")
+    elif hasattr(booster, "evals_result_"):
+        eval_results = deepcopy(booster.evals_result_)
     else:
-        raise TypeError("booster must be dict or LGBMModel")
+        raise TypeError(
+            "booster must be a dict from record_evaluation or a fitted "
+            "LGBMModel with evals_result_")
     if not eval_results:
         raise ValueError("eval results cannot be empty.")
 
-    if ax is None:
-        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
-
-    if dataset_names is None:
-        dataset_names_iter = iter(eval_results.keys())
-    else:
-        dataset_names_iter = iter(dataset_names)
-
-    name = next(dataset_names_iter)
-    metrics_for_one = eval_results[name]
-    num_metric = len(metrics_for_one)
+    names = (list(eval_results.keys()) if dataset_names is None
+             else list(dataset_names))
     if metric is None:
-        if num_metric > 1:
+        first = eval_results[names[0]]
+        if len(first) > 1:
             raise ValueError("more than one metric available, pick one")
-        metric, results = dict(metrics_for_one).popitem()
-    else:
-        if metric not in metrics_for_one:
+        metric = next(iter(first))
+    curves = []
+    for name in names:
+        per_metric = eval_results[name]
+        if metric not in per_metric:
             raise ValueError("No given metric in eval results.")
-        results = metrics_for_one[metric]
-    num_iteration = len(results)
-    max_result = max(results)
-    min_result = min(results)
-    x_ = range(num_iteration)
-    ax.plot(x_, results, label=name)
+        curves.append((name, per_metric[metric]))
 
-    for name in dataset_names_iter:
-        metrics_for_one = eval_results[name]
-        results = metrics_for_one[metric]
-        max_result = max(*results, max_result)
-        min_result = min(*results, min_result)
-        ax.plot(x_, results, label=name)
-
+    if ax is None:
+        ax = _new_axes(plt, figsize, dpi)
+    for name, series in curves:
+        ax.plot(range(len(series)), series, label=name)
     ax.legend(loc="best")
-    if xlim is not None:
-        _check_not_tuple_of_2_elements(xlim, "xlim")
-    else:
-        xlim = (0, num_iteration)
-    ax.set_xlim(xlim)
-    if ylim is not None:
-        _check_not_tuple_of_2_elements(ylim, "ylim")
-    else:
-        range_result = max_result - min_result
-        ylim = (min_result - range_result * 0.2,
-                max_result + range_result * 0.2)
-    ax.set_ylim(ylim)
+
+    if xlim is None:
+        xlim = (0, max(len(s) for _, s in curves))
+    if ylim is None:
+        lo = min(min(s) for _, s in curves)
+        hi = max(max(s) for _, s in curves)
+        pad = (hi - lo) * 0.2
+        ylim = (lo - pad, hi + pad)
+    ax.set_xlim(_window(xlim, "xlim"))
+    ax.set_ylim(_window(ylim, "ylim"))
     if title is not None:
         ax.set_title(title)
     if xlabel is not None:
