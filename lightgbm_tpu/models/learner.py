@@ -323,6 +323,12 @@ class SerialTreeLearner:
         self.feat_gather = jnp.asarray(gather)
         self.fix_mask = jnp.asarray(fix_mask)
         self.default_pos = jnp.asarray(default_pos)
+        # identity feature->group mapping (no bundling): the (F, BF, 2)
+        # view is a plain slice — no gather, no default-bin reconstruction
+        # (bins >= num_bin never occur, so those hist cells are zero)
+        self._plain_view = (self.F == self.G
+                            and not np.any(is_bundled)
+                            and np.array_equal(grp, np.arange(self.F)))
 
         # ---- row geometry ----
         if local_num_data is None:
@@ -440,6 +446,14 @@ class SerialTreeLearner:
         self.max_depth = int(config.max_depth)
         self.top_k = int(config.top_k)
         self.path_smooth = float(config.path_smooth)
+
+        # lean split search: the per-split fixed cost is op-dispatch-bound
+        # (PERF.md); plain configs take the op-packed formulation whose
+        # f32 count cumsum is exact below 2^24 rows
+        self._fast_search = (not self.has_categorical and not self.use_mc
+                             and not self.has_cegb
+                             and self.path_smooth <= 0.0
+                             and self.N < (1 << 24))
 
         axes = (0, 0, 0, 0, 0, 0, 0, 0, 0, 0, None)
         if self.cegb_lazy is not None:
@@ -811,6 +825,8 @@ class SerialTreeLearner:
         """(G, B, 2) group histogram -> (F, BF, 2) per-feature view with the
         default-bin stats of bundled features reconstructed from the leaf
         totals (reference: FixHistogram, cuda_histogram_constructor.cu:738)."""
+        if self._plain_view:
+            return hist_group[:, :self.BF]
         flat = hist_group.reshape(self.G * self.B, 2)
         flat = jnp.concatenate([flat, jnp.zeros((1, 2), dtype=flat.dtype)], axis=0)
         feat_hist = jnp.take(flat, self.feat_gather, axis=0)  # (F, BF, 2)
@@ -828,6 +844,13 @@ class SerialTreeLearner:
             lazy_term = self.cegb_lazy * lazy_cnt.astype(jnp.float32)
             cegb_delta = (lazy_term if cegb_delta is None
                           else cegb_delta + lazy_term)
+        if (self._fast_search and cegb_delta is None
+                and not with_feature_gains):
+            return split_ops.find_best_split_fast(
+                feat_hist, self.ctx, sum_g, sum_h, cnt,
+                self.l1, self.l2, self.max_delta_step,
+                self.min_gain_to_split, self.min_data_in_leaf,
+                self.min_sum_hessian, feature_mask)
         return split_ops.find_best_split(
             feat_hist, self.ctx, sum_g, sum_h, cnt,
             self.l1, self.l2, self.max_delta_step, self.min_gain_to_split,
@@ -932,6 +955,8 @@ class SerialTreeLearner:
         }
         for row, new in overlay.items():
             lm = lm.at[row, :L].set(jnp.where(changed, new, lm[row, :L]))
+        if not self.has_categorical:
+            return lm, None
         cat = st["best_cat_set"]
         cat = cat.at[:L].set(jnp.where(changed[:, None], best.cat_set,
                                        cat[:L]))
@@ -1109,10 +1134,12 @@ class SerialTreeLearner:
             "leafmat": leafmat,
             "nodemat": jnp.zeros((NND, nodes + 1), jnp.float32),
             "feat_used": feat_used0,
-            "best_cat_set": jnp.zeros((L + 1, self.BF), jnp.bool_).at[0].set(
-                best0.cat_set),
-            "node_cat_set": jnp.zeros((nodes + 1, self.BF), jnp.bool_),
         }
+        if self.has_categorical:
+            state["best_cat_set"] = jnp.zeros(
+                (L + 1, self.BF), jnp.bool_).at[0].set(best0.cat_set)
+            state["node_cat_set"] = jnp.zeros((nodes + 1, self.BF),
+                                              jnp.bool_)
         if self._use_pallas_part:
             state["sc_bins"] = jnp.zeros(part_bins.shape, part_bins.dtype)
             state["sc_ghi"] = jnp.zeros(part_ghi0.shape, jnp.float32)
@@ -1202,8 +1229,11 @@ class SerialTreeLearner:
                 # lowers to a slow per-tile path (~80us per occurrence,
                 # measured; the masked forms are plain VPU passes)
                 bl_oh = jax.lax.iota(jnp.int32, L + 1) == best_leaf
-                cat_set = jnp.any(st["best_cat_set"] & bl_oh[:, None],
-                                  axis=0)
+                if self.has_categorical:
+                    cat_set = jnp.any(st["best_cat_set"] & bl_oh[:, None],
+                                      axis=0)
+                else:
+                    cat_set = jnp.zeros((1,), jnp.bool_)
                 if forced_info is not None:
                     f_enum = jnp.where(forced_ok,
                                        self.forced["feature"][forced_node],
@@ -1293,9 +1323,10 @@ class SerialTreeLearner:
 
                 # record the internal node (reference: Tree::Split, tree.cpp)
                 upd = dict(moved)
-                upd["node_cat_set"] = jnp.where(
-                    (jax.lax.iota(jnp.int32, nodes + 1) == wr_s)[:, None],
-                    cat_set[None, :], st["node_cat_set"])
+                if self.has_categorical:
+                    upd["node_cat_set"] = jnp.where(
+                        (jax.lax.iota(jnp.int32, nodes + 1) == wr_s)[:, None],
+                        cat_set[None, :], st["node_cat_set"])
                 ncol = jnp.stack([
                     _i2f(orig_feat), _i2f(f_enum),
                     _i2f(thr), dl.astype(jnp.float32), gain,
@@ -1393,10 +1424,6 @@ class SerialTreeLearner:
                 lm2 = lm.at[:, wr_a].set(col_l).at[:, wr_b].set(col_r)
 
                 iot_l1 = jax.lax.iota(jnp.int32, L + 1)
-                new_cat = jnp.where(
-                    (iot_l1 == wr_a)[:, None], best_l.cat_set[None, :],
-                    jnp.where((iot_l1 == wr_b)[:, None],
-                              best_r.cat_set[None, :], st["best_cat_set"]))
                 upd.update({
                     "s": s + valid.astype(jnp.int32),
                     "done": ~valid & ~skip_pending,
@@ -1408,8 +1435,14 @@ class SerialTreeLearner:
                         ((iot_l1 == wr_a) | (iot_l1 == wr_b))[:, None],
                         used_child[None, :], st["leaf_used"])}
                        if self.ic_masks is not None else {}),
-                    "best_cat_set": new_cat,
                 })
+                if self.has_categorical:
+                    new_cat = jnp.where(
+                        (iot_l1 == wr_a)[:, None], best_l.cat_set[None, :],
+                        jnp.where((iot_l1 == wr_b)[:, None],
+                                  best_r.cat_set[None, :],
+                                  st["best_cat_set"]))
+                    upd["best_cat_set"] = new_cat
                 if (self.use_mc and self.mc_mode == "intermediate"
                         and "leaf_fmask" in st):
                     upd["leaf_fmask"] = jnp.where(
@@ -1456,7 +1489,9 @@ class SerialTreeLearner:
                     lm3, cat3 = self._mc_refresh(
                         st2, lm2, upd["s"] + 1, feature_mask)
                     upd["leafmat"] = jnp.where(valid, lm3, lm2)
-                    upd["best_cat_set"] = jnp.where(valid, cat3, new_cat)
+                    if cat3 is not None:
+                        upd["best_cat_set"] = jnp.where(valid, cat3,
+                                                        upd["best_cat_set"])
                 return self._pvary(upd)
 
         if self.F == 0:   # no splittable features: the root is the only leaf
@@ -1474,8 +1509,9 @@ class SerialTreeLearner:
         nm = st["nodemat"][:, :nodes]
         rec = {k: v for k, v in st.items()
                if k not in ("leafmat", "nodemat")}
-        rec["best_cat_set"] = st["best_cat_set"][:L]
-        rec["node_cat_set"] = st["node_cat_set"][:nodes]
+        if "best_cat_set" in st:
+            rec["best_cat_set"] = st["best_cat_set"][:L]
+            rec["node_cat_set"] = st["node_cat_set"][:nodes]
         rec["hist"] = st["hist"][:L]
         rec["indices"] = _f2i(st["part_ghi"][2])
         rec["part_grad"] = st["part_ghi"][0]

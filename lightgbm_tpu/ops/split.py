@@ -260,6 +260,149 @@ def find_best_split_categorical(feat_hist: jnp.ndarray, ctx: SplitContext,
     return gain_c, member, lg_c, lh_c, lc_c, l2_eff
 
 
+def find_best_split_fast(feat_hist: jnp.ndarray, ctx: SplitContext,
+                         sum_g, sum_h, num_data,
+                         l1: float, l2: float, max_delta_step: float,
+                         min_gain_to_split: float, min_data_in_leaf: int,
+                         min_sum_hessian: float,
+                         feature_mask: jnp.ndarray | None = None):
+    """Lean all-numerical best-split search.
+
+    Bit-identical to ``find_best_split`` for plain configs (no
+    categorical / monotone / CEGB / path smoothing / voting gains), but
+    restructured for HLO op count — the per-split fixed cost of the tree
+    loop on TPU is op-dispatch-bound (PERF.md), not FLOP-bound:
+
+      * ONE stacked cumulative sum over a (6, F, BF) tensor replaces the
+        six per-stat scans;
+      * the reference's scan-order tie-breaking
+        (FindBestThresholdSequentially, feature_histogram.hpp:830 — the
+        reverse scan first, larger thresholds winning reverse ties,
+        smaller forward ties, smaller feature index across features)
+        is encoded into the candidate ORDER of one (F, 2*BF) gain
+        matrix — per feature the reverse scan's thresholds descending,
+        then the forward scan's ascending — so a single flat arg-max
+        replaces the per-feature/per-direction arg-max cascade;
+      * the winner's statistics ride one packed (4, F*2*BF) matrix read
+        with a single lane-dynamic slice.
+
+    Counts ride the f32 cumsum (exact for leaves below 2^24 rows; the
+    caller gates on dataset size).
+    """
+    F, BF, _ = feat_hist.shape
+    G = feat_hist[..., 0]
+    H = feat_hist[..., 1]
+    sum_h_tot = sum_h + 2 * K_EPSILON
+    num_data = num_data.astype(jnp.float32) if hasattr(num_data, "astype") \
+        else jnp.float32(num_data)
+    cnt_factor = num_data / sum_h_tot
+
+    bins = jax.lax.broadcasted_iota(jnp.int32, (F, BF), 1)
+    nb = ctx.num_bin[:, None]
+    in_range = bins < nb
+    missing = ctx.missing_type[:, None]
+    dflt = ctx.default_bin[:, None]
+    is_zero_miss = missing == MISSING_ZERO
+    is_nan_miss = missing == MISSING_NAN
+    two_scan = (nb > 2) & (missing != MISSING_NONE)
+    cnt_bin = jnp.floor(H * cnt_factor + 0.5) * in_range      # f32, exact
+
+    mask_f = in_range & ~(is_zero_miss & (bins == dflt))
+    bmax = nb - 1 - (is_nan_miss & two_scan).astype(jnp.int32)
+    mask_r = (in_range & ~(two_scan & is_zero_miss & (bins == dflt)) &
+              (bins <= bmax))
+
+    z = jnp.float32(0.0)
+    cs = jnp.cumsum(jnp.stack([
+        jnp.where(mask_f, G, z), jnp.where(mask_f, H, z),
+        jnp.where(mask_f, cnt_bin, z),
+        jnp.where(mask_r, G, z), jnp.where(mask_r, H, z),
+        jnp.where(mask_r, cnt_bin, z)]), axis=2)              # (6, F, BF)
+
+    left_g_f = cs[0]
+    left_h_f = cs[1] + K_EPSILON
+    left_c_f = cs[2]
+    right_g_f = sum_g - left_g_f
+    right_h_f = sum_h_tot - left_h_f
+    right_c_f = num_data - left_c_f
+
+    right_g_r = cs[3, :, -1:] - cs[3]
+    right_h_r = cs[4, :, -1:] - cs[4] + K_EPSILON
+    right_c_r = cs[5, :, -1:] - cs[5]
+    left_g_r = sum_g - right_g_r
+    left_h_r = sum_h_tot - right_h_r
+    left_c_r = num_data - right_c_r
+
+    gain_f = (leaf_gain(left_g_f, left_h_f, l1, l2, max_delta_step) +
+              leaf_gain(right_g_f, right_h_f, l1, l2, max_delta_step))
+    gain_r = (leaf_gain(left_g_r, left_h_r, l1, l2, max_delta_step) +
+              leaf_gain(right_g_r, right_h_r, l1, l2, max_delta_step))
+
+    gain_shift = leaf_gain(sum_g, sum_h_tot, l1, l2, max_delta_step)
+    min_gain_shift = gain_shift + min_gain_to_split
+    mdl = jnp.float32(min_data_in_leaf)
+
+    def common_valid(lc, rc, lh, rh):
+        return ((lc >= mdl) & (rc >= mdl) &
+                (lh >= min_sum_hessian) & (rh >= min_sum_hessian))
+
+    valid_f = (two_scan & in_range & (bins <= nb - 2) &
+               ~(is_zero_miss & (bins == dflt)) &
+               common_valid(left_c_f, right_c_f, left_h_f, right_h_f) &
+               (gain_f > min_gain_shift))
+    valid_r = (in_range & (bins <= bmax - 1) &
+               ~(two_scan & is_zero_miss & (bins == dflt - 1)) &
+               common_valid(left_c_r, right_c_r, left_h_r, right_h_r) &
+               (gain_r > min_gain_shift))
+    if feature_mask is not None:
+        valid_f &= feature_mask[:, None]
+        valid_r &= feature_mask[:, None]
+
+    neg = jnp.float32(K_MIN_SCORE)
+    # candidate order encodes the tie-breaking (see docstring)
+    gains = jnp.concatenate([jnp.where(valid_r, gain_r, neg)[:, ::-1],
+                             jnp.where(valid_f, gain_f, neg)], axis=1)
+    # default_left: reverse scan => True, except single-scan NaN features
+    dl_r = jnp.broadcast_to((two_scan | ~is_nan_miss).astype(jnp.float32),
+                            (F, BF))
+    stats = jnp.stack([
+        jnp.concatenate([left_g_r[:, ::-1], left_g_f], axis=1),
+        jnp.concatenate([left_h_r[:, ::-1], left_h_f], axis=1),
+        jnp.concatenate([left_c_r[:, ::-1], left_c_f], axis=1),
+        jnp.concatenate([dl_r, jnp.zeros((F, BF), jnp.float32)], axis=1),
+    ]).reshape(4, F * 2 * BF)
+
+    flat = gains.reshape(F * 2 * BF)
+    widx = jnp.argmax(flat).astype(jnp.int32)
+    best_gain = flat[widx]
+    picked = jax.lax.dynamic_slice(stats, (0, widx), (4, 1))[:, 0]
+    lg, lh, lc_f32, dl = picked[0], picked[1], picked[2], picked[3]
+
+    per_f = 2 * BF
+    best_f = widx // per_f
+    r = widx - best_f * per_f
+    best_t = jnp.where(r < BF, BF - 1 - r, r - BF)
+
+    rg = sum_g - lg
+    rh = sum_h_tot - lh
+    rc = num_data - lc_f32
+    args = (l1, l2, max_delta_step)
+    return BestSplit(
+        gain=jnp.where(best_gain > neg, best_gain - min_gain_shift, neg),
+        feature=best_f.astype(jnp.int32),
+        threshold=best_t.astype(jnp.int32),
+        default_left=dl > 0.5,
+        left_sum_g=lg, left_sum_h=lh - K_EPSILON,
+        right_sum_g=rg, right_sum_h=rh - K_EPSILON,
+        left_count=lc_f32.astype(jnp.int32),
+        right_count=rc.astype(jnp.int32),
+        left_output=leaf_output(lg, lh, *args),
+        right_output=leaf_output(rg, rh, *args),
+        is_cat=jnp.bool_(False),
+        cat_set=jnp.zeros((1,), jnp.bool_),
+    )
+
+
 def find_best_split(feat_hist: jnp.ndarray, ctx: SplitContext,
                     sum_g, sum_h, num_data,
                     l1: float, l2: float, max_delta_step: float,

@@ -1,0 +1,59 @@
+"""find_best_split_fast must match find_best_split bit-for-bit on plain
+configs (it is the compiled hot path for all-numerical trees)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from lightgbm_tpu.ops import split as so
+
+
+def _ctx(F, BF, rng):
+    num_bin = rng.randint(3, BF + 1, size=F).astype(np.int32)
+    missing = rng.randint(0, 3, size=F).astype(np.int32)
+    default_bin = np.where(missing == so.MISSING_ZERO,
+                           rng.randint(0, 3, size=F), 0).astype(np.int32)
+    return so.SplitContext(
+        num_bin=jnp.asarray(num_bin),
+        missing_type=jnp.asarray(missing),
+        default_bin=jnp.asarray(default_bin),
+        is_categorical=jnp.zeros(F, jnp.int32),
+        feature_index=jnp.arange(F, dtype=jnp.int32))
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_fast_matches_reference_search(seed):
+    rng = np.random.RandomState(seed)
+    F, BF = 7, 31
+    ctx = _ctx(F, BF, rng)
+    nb = np.asarray(ctx.num_bin)
+    hist = np.zeros((F, BF, 2), np.float32)
+    for f in range(F):
+        hist[f, :nb[f], 0] = rng.normal(size=nb[f])
+        hist[f, :nb[f], 1] = rng.uniform(0.01, 2.0, size=nb[f])
+    sum_g = jnp.float32(hist[0, :, 0].sum())
+    sum_h = jnp.float32(hist[0, :, 1].sum())
+    cnt = jnp.int32(1000)
+    mask = jnp.asarray(rng.rand(F) > 0.2)
+    args = (jnp.asarray(hist), ctx, sum_g, sum_h, cnt,
+            0.0 if seed % 2 else 0.5, 1e-3, 0.0, 0.0, 5, 1e-3, mask)
+    slow = so.find_best_split(*args)
+    fast = so.find_best_split_fast(*args)
+    for name in ("gain", "feature", "threshold", "default_left",
+                 "left_sum_g", "left_sum_h", "right_sum_g", "right_sum_h",
+                 "left_count", "right_count", "left_output", "right_output"):
+        s = np.asarray(getattr(slow, name))
+        fv = np.asarray(getattr(fast, name))
+        assert np.array_equal(s, fv) or np.allclose(s, fv, rtol=0, atol=0), \
+            (name, s, fv)
+
+
+def test_fast_no_valid_split():
+    rng = np.random.RandomState(9)
+    F, BF = 3, 8
+    ctx = _ctx(F, BF, rng)
+    hist = np.zeros((F, BF, 2), np.float32)   # empty: nothing to split
+    out = so.find_best_split_fast(
+        jnp.asarray(hist), ctx, jnp.float32(0), jnp.float32(0),
+        jnp.int32(0), 0.0, 1e-3, 0.0, 0.0, 5, 1e-3, None)
+    assert np.asarray(out.gain) == -np.inf
