@@ -395,15 +395,14 @@ class SerialTreeLearner:
         if self._use_pallas_part:
             try:
                 from ..ops.partition_pallas import (partition_leaf_pallas,
-                                                    make_scalars)
+                                                    make_scalars, SC_ROWS)
                 g32 = ((self.G + 31) // 32) * 32
                 cpr = self.row_chunk
                 tiny = 4 * cpr
                 out = partition_leaf_pallas(
                     jnp.zeros((g32, tiny), jnp.uint8),
                     jnp.zeros((8, tiny), jnp.float32),
-                    jnp.zeros((g32, tiny), jnp.uint8),
-                    jnp.zeros((8, tiny), jnp.float32),
+                    jnp.zeros((SC_ROWS, tiny), jnp.int32),
                     make_scalars(cpr, cpr, 0, 0, 0, 255, 0, 0, 128, 0),
                     row_chunk=cpr)
                 jax.block_until_ready(out)
@@ -636,11 +635,10 @@ class SerialTreeLearner:
             decision_scalars
         scalars = make_scalars(start, cnt, col, bstart, isb, nb, dbin,
                                mtype, thr, dl)
-        pb, pg, sb, sg, nl = partition_leaf_pallas(
-            st["part_bins"], st["part_ghi"], st["sc_bins"], st["sc_ghi"],
+        pb, pg, sp, nl = partition_leaf_pallas(
+            st["part_bins"], st["part_ghi"], st["sc_packed"],
             scalars, row_chunk=self.row_chunk)
-        moved = {"part_bins": pb, "part_ghi": pg,
-                 "sc_bins": sb, "sc_ghi": sg}
+        moved = {"part_bins": pb, "part_ghi": pg, "sc_packed": sp}
         return moved, nl[0, 0]
 
     # ------------------------------------------------------------------
@@ -1141,8 +1139,9 @@ class SerialTreeLearner:
             state["node_cat_set"] = jnp.zeros((nodes + 1, self.BF),
                                               jnp.bool_)
         if self._use_pallas_part:
-            state["sc_bins"] = jnp.zeros(part_bins.shape, part_bins.dtype)
-            state["sc_ghi"] = jnp.zeros(part_ghi0.shape, jnp.float32)
+            from ..ops.partition_pallas import SC_ROWS
+            state["sc_packed"] = jnp.zeros((SC_ROWS, part_bins.shape[1]),
+                                           jnp.int32)
         else:
             state["sc32"] = jnp.zeros((G + 3, part_bins.shape[1]),
                                       jnp.int32)

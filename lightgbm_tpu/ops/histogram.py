@@ -78,7 +78,7 @@ def leaf_hist_slice(part_bins, part_ghi, start, cnt, *,
     iota_hi = jax.lax.broadcasted_iota(jnp.int32, (1, 1, BH), 2)
     iota_lo = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 16), 2)
 
-    def body(ci, accs):
+    def body(ci, acc):
         row0 = start + ci * C
         bins = jax.lax.dynamic_slice(
             part_bins, (0, row0), (G, C)).astype(jnp.int32)
@@ -100,18 +100,18 @@ def leaf_hist_slice(part_bins, part_ghi, start, cnt, *,
             oh_lo = (lo[:, :, None] == iota_lo).astype(dtype)  # (gblk, C, 16)
             # weighted high-digit one-hots for (grad, hess) side by side
             wg = jnp.concatenate([oh_hi * gv, oh_hi * hv], axis=2)
-            part = jax.lax.dot_general(
+            out.append(jax.lax.dot_general(
                 wg, oh_lo,
                 dimension_numbers=(((1,), (1,)), ((0,), (0,))),
-                preferred_element_type=jnp.float32)   # (gblk, 2*BH, 16)
-            out.append(accs[i] + part)
-        return tuple(out)
+                preferred_element_type=jnp.float32))  # (gblk, 2*BH, 16)
+        # ONE loop-carried array (a tuple of nblk carries costs nblk
+        # body-level fusions per split in the outer tree loop)
+        return acc + jnp.stack(out)
 
-    accs = vary(tuple(jnp.zeros((gblock, 2 * BH, 16), jnp.float32)
-                      for _ in range(nblk)))
-    accs = jax.lax.fori_loop(0, n_chunks, body, accs)
-    per = jnp.concatenate(accs, axis=0)                 # (Gp, 2*BH, 16)
-    per = per[:G].reshape(G, 2, Bp)                     # b = hi*16 + lo
+    acc = vary(jnp.zeros((nblk, gblock, 2 * BH, 16), jnp.float32))
+    acc = jax.lax.fori_loop(0, n_chunks, body, acc)
+    per = acc.reshape(Gp, 2 * BH, 16)[:G]               # block-major == G
+    per = per.reshape(G, 2, Bp)                         # b = hi*16 + lo
     return jnp.moveaxis(per[:, :, :B], 1, 2)            # (G, B, 2)
 
 

@@ -26,12 +26,19 @@ Design notes (all constraints below were probed on the live toolchain):
     the MXU; the stable two-way compaction is a 13-step binary shift
     network built from ``pltpu.roll`` (bool rolls don't lower — all
     masks stay i32).
-  * Pass 1 streams the cover once: lefts are flushed forward IN PLACE
-    from the cover base (the left write frontier provably trails the
-    read frontier), rights are flushed forward into a scratch buffer.
-    Pass 2 slides the staged rights into their final windows with a
-    two-window roll-select, read-modify-writing only the partial edge
-    windows.
+  * The compaction payload is PACKED: 4 u8 bin rows ride per i32 row
+    (row r of the packed block holds storage rows {r, W+r, 2W+r, 3W+r},
+    W = G32/4) and only the 3 live grad/hess/rowid rows of the f32
+    payload are carried, so the shift network moves (W+3, C) lanes
+    instead of (G32+8, C) — the network's cost is proportional to
+    sublane count and dominated the unpacked kernel (~4x the data).
+  * Pass 1 streams the cover once: lefts are unpacked and flushed
+    forward IN PLACE from the cover base (the left write frontier
+    provably trails the read frontier), rights are flushed forward
+    STILL PACKED into a (16, N_pad) i32 scratch.  Pass 2 slides the
+    staged rights into their final windows with a two-window
+    roll-select on the packed payload, unpacking only at the final
+    write and read-modify-writing only the partial edge windows.
 """
 
 from __future__ import annotations
@@ -56,6 +63,8 @@ S_MTYPE = 8     # missing type (0 none / 1 zero / 2 nan)
 S_THR = 9       # split threshold (bin)
 S_DL = 10       # default_left (0/1)
 N_SCALARS = 11
+
+SC_ROWS = 16    # packed-scratch sublanes (32-bit DMA tile multiple)
 
 
 def _excl_prefix_rights(flag_l, C):
@@ -107,7 +116,7 @@ def _cdiv(a, c):
     return jax.lax.div(a + (c - 1), c)
 
 
-def partition_leaf_pallas(part_bins, part_ghi, sc_bins, sc_ghi, scalars, *,
+def partition_leaf_pallas(part_bins, part_ghi, sc_packed, scalars, *,
                           row_chunk: int):
     """Two-way stable partition of the leaf range described by
     ``scalars`` (see the S_* layout above), in place.
@@ -115,12 +124,14 @@ def partition_leaf_pallas(part_bins, part_ghi, sc_bins, sc_ghi, scalars, *,
     Args:
       part_bins: (G32, N_pad) u8 binned matrix, G32 a multiple of 32.
       part_ghi:  (8, N_pad)  f32 packed (grad, hess, rowid-bits, pad...).
-      sc_bins / sc_ghi: same-shape scratch buffers (contents don't
-        survive; they stage the rights between the two passes).
+        Only rows 0..2 are preserved through the partition; the pad rows
+        come back as garbage.
+      sc_packed: (SC_ROWS, N_pad) i32 scratch staging the packed rights
+        between the two passes (contents don't survive).
       scalars: (N_SCALARS,) i32.
-    Returns (part_bins', part_ghi', sc_bins', sc_ghi', nl) with the
-    first four aliased in place; nl is an (8, 128) i32 tile whose [0, 0]
-    element is the left count.
+    Returns (part_bins', part_ghi', sc_packed', nl) with the first three
+    aliased in place; nl is an (8, 128) i32 tile whose [0, 0] element is
+    the left count.
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -128,14 +139,27 @@ def partition_leaf_pallas(part_bins, part_ghi, sc_bins, sc_ghi, scalars, *,
     G32, Np = part_bins.shape
     GH = part_ghi.shape[0]
     assert GH == 8 and G32 % 32 == 0, (G32, GH)
+    assert sc_packed.shape == (SC_ROWS, Np) and sc_packed.dtype == jnp.int32
     C = row_chunk
     assert C >= 256 and (C & (C - 1)) == 0 and Np % 128 == 0
     logc = C.bit_length() - 1
-    S = G32 + GH        # widened payload sublanes
+    W = G32 // 4        # packed bin words
+    P = W + 3           # packed payload sublanes (bins + g, h, rowid)
+    assert P <= SC_ROWS
 
-    def kernel(s_ref, pb_in, pg_in, sb_in, sg_in,
-               pb, pg, sb, sg, nl_ref,
-               rb, rg, stgl, stgr, wb, wg, exb, exg, sems):
+    def pack_bins(bins_i32):
+        """(G32, C) i32 byte values -> (W, C) packed words."""
+        return (bins_i32[0:W] | (bins_i32[W:2 * W] << 8) |
+                (bins_i32[2 * W:3 * W] << 16) | (bins_i32[3 * W:4 * W] << 24))
+
+    def unpack_bins(packed):
+        """(W, C) packed words -> (G32, C) i32 byte values."""
+        return jnp.concatenate(
+            [packed & 255, (packed >> 8) & 255,
+             (packed >> 16) & 255, (packed >> 24) & 255], axis=0)
+
+    def kernel(s_ref, pb_in, pg_in, sp_in, pb, pg, sp, nl_ref,
+               rb, rg, rs, stgl, stgr, wb, wg, wp, exb, exg, sems):
         a0b = s_ref[S_A0B]
         rem = s_ref[S_REM]
         cnt = s_ref[S_CNT]
@@ -144,8 +168,12 @@ def partition_leaf_pallas(part_bins, part_ghi, sc_bins, sc_ghi, scalars, *,
         n_chunks = jnp.where(cnt > 0, _cdiv(total, C), 0)
 
         lane = jax.lax.broadcasted_iota(jnp.int32, (1, C), 1)
-        sub_oh = (jax.lax.broadcasted_iota(jnp.int32, (G32, 1), 0) == col
-                  ).astype(jnp.int32)
+        # split column lives at byte (col // W) of packed word (col % W)
+        col_k = jax.lax.div(col, W)
+        col_w = col - col_k * W
+        col_sh = col_k * 8
+        word_oh = (jax.lax.broadcasted_iota(jnp.int32, (W, 1), 0) == col_w
+                   ).astype(jnp.int32)
 
         def start_read(ci, slot):
             pltpu.make_async_copy(
@@ -177,13 +205,16 @@ def partition_leaf_pallas(part_bins, part_ghi, sc_bins, sc_ghi, scalars, *,
             wait_read(slot)
 
             bins_i = rb[slot].astype(jnp.int32)               # (G32, C)
-            ghi_i = jax.lax.bitcast_convert_type(rg[slot], jnp.int32)
-            payload = jnp.concatenate([bins_i, ghi_i], axis=0)  # (S, C)
+            packed = pack_bins(bins_i)                        # (W, C)
+            ghi_i = jax.lax.bitcast_convert_type(rg[slot], jnp.int32)[0:3]
+            payload = jnp.concatenate([packed, ghi_i], axis=0)  # (P, C)
 
             # --- decision (numerical splits; see ops/partition.py
             # split_decision and models/learner.py _goes_left) ---
-            colv = jnp.sum(bins_i * sub_oh, axis=0,
-                           keepdims=True)                      # (1, C)
+            word = jnp.sum(packed * word_oh, axis=0,
+                           keepdims=True)                     # (1, C)
+            colv = jax.lax.shift_right_logical(
+                word, jnp.broadcast_to(col_sh, word.shape)) & 255
             bstart = s_ref[S_BSTART]
             fb_raw = colv - bstart
             in_rb = (fb_raw >= 1) & (fb_raw <= s_ref[S_NB] - 1)
@@ -213,7 +244,7 @@ def partition_leaf_pallas(part_bins, part_ghi, sc_bins, sc_ghi, scalars, *,
             lcomp = _compact(payload, left, pnr, C, logc)
             rcomp = _compact(payload, 1 - left, lane - pnr, C, logc)
 
-            def append_and_flush(stg, comp, fill, n_add, nf, dst, dst_b0):
+            def stage(stg, comp, fill, n_add):
                 # place comp[0:n_add) at staging positions [fill, +n_add)
                 rolled = pltpu.roll(comp, fill, 1)
                 m1 = (lane >= fill) & (lane < fill + n_add)
@@ -221,52 +252,70 @@ def partition_leaf_pallas(part_bins, part_ghi, sc_bins, sc_ghi, scalars, *,
                 m2 = (lane + C) < (fill + n_add)
                 stg[:, C:2 * C] = jnp.where(m2, rolled, stg[:, C:2 * C])
                 new_fill = fill + n_add
-
-                @pl.when(new_fill >= C)
-                def _():
-                    wb[:] = stg[0:G32, 0:C].astype(jnp.uint8)
-                    wg[:] = jax.lax.bitcast_convert_type(
-                        stg[G32:S, 0:C], jnp.float32)
-                    cb = pltpu.make_async_copy(
-                        wb, dst[0].at[:, pl.ds(dst_b0 * 128 + nf * C, C)],
-                        sems.at[0, 2])
-                    cg = pltpu.make_async_copy(
-                        wg, dst[1].at[:, pl.ds(dst_b0 * 128 + nf * C, C)],
-                        sems.at[1, 2])
-                    cb.start(); cg.start(); cb.wait(); cg.wait()
-                    stg[:, 0:C] = stg[:, C:2 * C]
                 flushed = (new_fill >= C).astype(jnp.int32)
-                return new_fill - flushed * C, nf + flushed
+                return new_fill - flushed * C, flushed
 
-            fill_l, nfl = append_and_flush(stgl, lcomp, fill_l, nlc,
-                                           nfl, (pb, pg), a0b)
-            fill_r, nfr = append_and_flush(stgr, rcomp, fill_r, nrc,
-                                           nfr, (sb, sg), a0b)
-            return fill_l, fill_r, nfl, nfr, nl_cnt
+            fill_l, fl_l = stage(stgl, lcomp, fill_l, nlc)
+            fill_r, fl_r = stage(stgr, rcomp, fill_r, nrc)
+
+            # lefts: unpack and flush in place to the row buffers
+            @pl.when(fl_l > 0)
+            def _():
+                wb[:] = unpack_bins(stgl[0:W, 0:C]).astype(jnp.uint8)
+                wg[:] = jax.lax.bitcast_convert_type(
+                    jnp.concatenate(
+                        [stgl[W:P, 0:C],
+                         jnp.zeros((GH - 3, C), jnp.int32)], axis=0),
+                    jnp.float32)
+                cb = pltpu.make_async_copy(
+                    wb, pb.at[:, pl.ds(a0b * 128 + nfl * C, C)],
+                    sems.at[0, 2])
+                cg = pltpu.make_async_copy(
+                    wg, pg.at[:, pl.ds(a0b * 128 + nfl * C, C)],
+                    sems.at[1, 2])
+                cb.start(); cg.start(); cb.wait(); cg.wait()
+                stgl[:, 0:C] = stgl[:, C:2 * C]
+
+            # rights: flush STILL PACKED to the i32 scratch
+            @pl.when(fl_r > 0)
+            def _():
+                wp[0:P] = stgr[:, 0:C]
+                cp = pltpu.make_async_copy(
+                    wp, sp.at[:, pl.ds(a0b * 128 + nfr * C, C)],
+                    sems.at[0, 3])
+                cp.start(); cp.wait()
+                stgr[:, 0:C] = stgr[:, C:2 * C]
+
+            return fill_l, fill_r, nfl + fl_l, nfr + fl_r, nl_cnt
 
         fill_l, fill_r, nfl, nfr, nl_cnt = jax.lax.fori_loop(
             0, n_chunks, body,
             (jnp.int32(0), jnp.int32(0), jnp.int32(0), jnp.int32(0),
              jnp.int32(0)))
 
-        def final_flush(stg, fill, nf, dst, dst_b0):
-            # Full-window write: the garbage tail beyond ``fill`` is
-            # always rewritten by pass 2 (lefts) or never read (scratch).
-            @pl.when(fill > 0)
-            def _():
-                wb[:] = stg[0:G32, 0:C].astype(jnp.uint8)
-                wg[:] = jax.lax.bitcast_convert_type(
-                    stg[G32:S, 0:C], jnp.float32)
-                cb = pltpu.make_async_copy(
-                    wb, dst[0].at[:, pl.ds(dst_b0 * 128 + nf * C, C)],
-                    sems.at[0, 2])
-                cg = pltpu.make_async_copy(
-                    wg, dst[1].at[:, pl.ds(dst_b0 * 128 + nf * C, C)],
-                    sems.at[1, 2])
-                cb.start(); cg.start(); cb.wait(); cg.wait()
+        # Final partial flushes.  Full-window writes: the garbage tail
+        # beyond ``fill`` is always rewritten by pass 2 (lefts) or never
+        # read (scratch).
+        @pl.when(fill_l > 0)
+        def _():
+            wb[:] = unpack_bins(stgl[0:W, 0:C]).astype(jnp.uint8)
+            wg[:] = jax.lax.bitcast_convert_type(
+                jnp.concatenate(
+                    [stgl[W:P, 0:C],
+                     jnp.zeros((GH - 3, C), jnp.int32)], axis=0),
+                jnp.float32)
+            cb = pltpu.make_async_copy(
+                wb, pb.at[:, pl.ds(a0b * 128 + nfl * C, C)], sems.at[0, 2])
+            cg = pltpu.make_async_copy(
+                wg, pg.at[:, pl.ds(a0b * 128 + nfl * C, C)], sems.at[1, 2])
+            cb.start(); cg.start(); cb.wait(); cg.wait()
 
-        final_flush(stgl, fill_l, nfl, (pb, pg), a0b)
-        final_flush(stgr, fill_r, nfr, (sb, sg), a0b)
+        @pl.when(fill_r > 0)
+        def _():
+            wp[0:P] = stgr[:, 0:C]
+            cp = pltpu.make_async_copy(
+                wp, sp.at[:, pl.ds(a0b * 128 + nfr * C, C)], sems.at[0, 3])
+            cp.start(); cp.wait()
 
         # drop the foreign prefix; with cnt == 0 the chunk loop never ran
         # (trash-slot iterations call the partition with an arbitrary,
@@ -294,11 +343,8 @@ def partition_leaf_pallas(part_bins, part_ghi, sc_bins, sc_ghi, scalars, *,
             @pl.when(read_src)
             def _():
                 pltpu.make_async_copy(
-                    sb_in.at[:, pl.ds(a0b * 128 + j * C, C)],
-                    rb.at[slot], sems.at[slot, 0]).start()
-                pltpu.make_async_copy(
-                    sg_in.at[:, pl.ds(a0b * 128 + j * C, C)],
-                    rg.at[slot], sems.at[slot, 1]).start()
+                    sp_in.at[:, pl.ds(a0b * 128 + j * C, C)],
+                    rs.at[slot], sems.at[slot, 0]).start()
             # destination window bounds (cover-relative)
             dlo = dst_off - r0 + j * C               # window start
             lo = jnp.where(j == 0, r0, 0)
@@ -317,23 +363,25 @@ def partition_leaf_pallas(part_bins, part_ghi, sc_bins, sc_ghi, scalars, *,
 
             @pl.when(read_src)
             def _():
-                wait_read(slot)
+                pltpu.make_async_copy(
+                    sp_in.at[:, pl.ds(0, C)], rs.at[slot],
+                    sems.at[slot, 0]).wait()
 
-            cur_b = rb[slot].astype(jnp.int32)
-            cur_g = jax.lax.bitcast_convert_type(rg[slot], jnp.int32)
-            prv_b = rb[1 - slot].astype(jnp.int32)
-            prv_g = jax.lax.bitcast_convert_type(rg[1 - slot], jnp.int32)
+            cur_p = rs[slot][0:P]                    # packed payload
+            prv_p = rs[1 - slot][0:P]
             take_prev = lane < r0
-            out_b = jnp.where(take_prev, pltpu.roll(prv_b, r0, 1),
-                              pltpu.roll(cur_b, r0, 1))
-            out_g = jnp.where(take_prev, pltpu.roll(prv_g, r0, 1),
-                              pltpu.roll(cur_g, r0, 1))
+            out_p = jnp.where(take_prev, pltpu.roll(prv_p, r0, 1),
+                              pltpu.roll(cur_p, r0, 1))
+            out_b = unpack_bins(out_p[0:W])          # (G32, C)
+            out_g3 = out_p[W:P]                      # (3, C) ghi bits
             valid = (lane >= lo) & (lane < hi)
+            exg_i = jax.lax.bitcast_convert_type(exg[:], jnp.int32)
             wb[:] = jnp.where(valid, out_b,
                               exb[:].astype(jnp.int32)).astype(jnp.uint8)
             wg[:] = jax.lax.bitcast_convert_type(
-                jnp.where(valid, out_g,
-                          jax.lax.bitcast_convert_type(exg[:], jnp.int32)),
+                jnp.concatenate(
+                    [jnp.where(valid, out_g3, exg_i[0:3]), exg_i[3:GH]],
+                    axis=0),
                 jnp.float32)
             cb = pltpu.make_async_copy(
                 wb, pb.at[:, pl.ds(dwb * 128 + j * C, C)], sems.at[0, 2])
@@ -347,16 +395,18 @@ def partition_leaf_pallas(part_bins, part_ghi, sc_bins, sc_ghi, scalars, *,
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(1,),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)] * 4,
-        out_specs=[pl.BlockSpec(memory_space=pltpu.ANY)] * 4 +
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)] * 3,
+        out_specs=[pl.BlockSpec(memory_space=pltpu.ANY)] * 3 +
                   [pl.BlockSpec(memory_space=pltpu.VMEM)],
         scratch_shapes=[
             pltpu.VMEM((2, G32, C), jnp.uint8),      # rb
             pltpu.VMEM((2, GH, C), jnp.float32),     # rg
-            pltpu.VMEM((S, 2 * C), jnp.int32),       # stgl
-            pltpu.VMEM((S, 2 * C), jnp.int32),       # stgr
+            pltpu.VMEM((2, SC_ROWS, C), jnp.int32),  # rs
+            pltpu.VMEM((P, 2 * C), jnp.int32),       # stgl
+            pltpu.VMEM((P, 2 * C), jnp.int32),       # stgr
             pltpu.VMEM((G32, C), jnp.uint8),         # wb
             pltpu.VMEM((GH, C), jnp.float32),        # wg
+            pltpu.VMEM((SC_ROWS, C), jnp.int32),     # wp
             pltpu.VMEM((G32, C), jnp.uint8),         # exb
             pltpu.VMEM((GH, C), jnp.float32),        # exg
             pltpu.SemaphoreType.DMA((2, 4)),
@@ -367,13 +417,12 @@ def partition_leaf_pallas(part_bins, part_ghi, sc_bins, sc_ghi, scalars, *,
         out_shape=[
             jax.ShapeDtypeStruct(part_bins.shape, part_bins.dtype),
             jax.ShapeDtypeStruct(part_ghi.shape, part_ghi.dtype),
-            jax.ShapeDtypeStruct(sc_bins.shape, sc_bins.dtype),
-            jax.ShapeDtypeStruct(sc_ghi.shape, sc_ghi.dtype),
+            jax.ShapeDtypeStruct(sc_packed.shape, sc_packed.dtype),
             jax.ShapeDtypeStruct((8, 128), jnp.int32),
         ],
         grid_spec=grid_spec,
-        input_output_aliases={1: 0, 2: 1, 3: 2, 4: 3},
-    )(scalars, part_bins, part_ghi, sc_bins, sc_ghi)
+        input_output_aliases={1: 0, 2: 1, 3: 2},
+    )(scalars, part_bins, part_ghi, sc_packed)
     return out
 
 

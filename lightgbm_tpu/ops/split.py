@@ -313,11 +313,22 @@ def find_best_split_fast(feat_hist: jnp.ndarray, ctx: SplitContext,
               (bins <= bmax))
 
     z = jnp.float32(0.0)
-    cs = jnp.cumsum(jnp.stack([
+    stacked = jnp.stack([
         jnp.where(mask_f, G, z), jnp.where(mask_f, H, z),
         jnp.where(mask_f, cnt_bin, z),
         jnp.where(mask_r, G, z), jnp.where(mask_r, H, z),
-        jnp.where(mask_r, cnt_bin, z)]), axis=2)              # (6, F, BF)
+        jnp.where(mask_r, cnt_bin, z)])                       # (6, F, BF)
+    # prefix sums as ONE inclusive lower-triangular matmul on the MXU:
+    # XLA's cumsum lowering costs a log-depth pass cascade per operand,
+    # and the per-split cost here is op-bound.  f32 dot keeps integer
+    # counts exact below 2^24; g/h sums round differently from a serial
+    # scan by at most the usual f32 dot-product reassociation.
+    tri = (jax.lax.broadcasted_iota(jnp.int32, (BF, BF), 0) <=
+           jax.lax.broadcasted_iota(jnp.int32, (BF, BF), 1)
+           ).astype(jnp.float32)
+    cs = jax.lax.dot_general(
+        stacked, tri, (((2,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)                   # (6, F, BF)
 
     left_g_f = cs[0]
     left_h_f = cs[1] + K_EPSILON

@@ -65,14 +65,17 @@ def test_partition_kernel_matches_oracle():
                                 dbin, mtype, thr, dl)
         sc = make_scalars(start, cnt, col, bstart, isb, nb, dbin, mtype,
                           thr, dl)
-        rpb, rpg, _, _, rnl = partition_leaf_pallas(
+        from lightgbm_tpu.ops.partition_pallas import SC_ROWS
+        rpb, rpg, _, rnl = partition_leaf_pallas(
             jnp.asarray(pb), jnp.asarray(pg),
-            jnp.zeros((G32, Np), jnp.uint8), jnp.zeros((8, Np), jnp.float32),
+            jnp.zeros((SC_ROWS, Np), jnp.int32),
             sc, row_chunk=C)
         assert int(np.asarray(rnl)[0, 0]) == enl
         np.testing.assert_array_equal(np.asarray(rpb), epb)
+        # only the live (g, h, rowid) rows are preserved through the
+        # packed-payload kernel; the sublane-pad rows come back as zeros
         np.testing.assert_array_equal(
-            np.asarray(rpg).view(np.int32), epg.view(np.int32))
+            np.asarray(rpg)[:3].view(np.int32), epg[:3].view(np.int32))
 
 
 def test_train_pallas_matches_xla():
