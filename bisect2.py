@@ -1,0 +1,15 @@
+import sys
+import numpy as np, jax, jax.numpy as jnp
+from lightgbm_tpu.ops.partition_pallas import (partition_leaf_pallas,
+                                               make_scalars, sc_rows_for)
+C = 8192; G32 = 32
+Np = 8192*130
+SCR = sc_rows_for(G32)
+rng = np.random.RandomState(1)
+pb0 = jnp.asarray(rng.randint(0, 255, (G32, Np)).astype(np.uint8))
+pg0 = jnp.asarray(rng.randn(8, Np).astype(np.float32))
+sp0 = jnp.zeros((SCR, Np), jnp.int32)
+live = int(sys.argv[1]) if len(sys.argv) > 1 else 6
+sc = make_scalars(136229, 491755, 12, 0, 0, 82, 79, 1, 9, 1)
+out = partition_leaf_pallas(pb0, pg0, sp0, sc, row_chunk=C, ghi_live=live)
+print("sum", float(jnp.sum(out[3])))

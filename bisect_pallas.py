@@ -1,0 +1,15 @@
+import sys
+import numpy as np, jax, jax.numpy as jnp
+from lightgbm_tpu.ops.partition_pallas import (partition_leaf_pallas,
+                                               make_scalars, sc_rows_for)
+C = int(sys.argv[1]); live = int(sys.argv[2])
+G32 = 32
+Np = C*34
+SCR = sc_rows_for(G32)
+rng = np.random.RandomState(1)
+pb0 = jnp.asarray(rng.randint(0, 255, (G32, Np)).astype(np.uint8))
+pg0 = jnp.asarray(rng.randn(8, Np).astype(np.float32))
+sp0 = jnp.zeros((SCR, Np), jnp.int32)
+sc = make_scalars(C+7, C*20+13, 3, 0, 0, 200, 5, 1, 100, 0)
+out = partition_leaf_pallas(pb0, pg0, sp0, sc, row_chunk=C, ghi_live=live)
+print("sum", float(jnp.sum(out[3])))

@@ -345,11 +345,15 @@ class SerialTreeLearner:
         self.row_chunk = min(self.row_chunk, 1 << 15)
         self._chunk_bits = self.row_chunk.bit_length() - 1
         C = self.row_chunk
-        # layout: [C front-pad rows][N data rows][>=C tail-pad rows]; the
+        # layout: [C front-pad rows][N data rows][>=2C tail-pad rows]; the
         # front pad keeps the right-aligned partition windows non-negative,
-        # the tail pad keeps chunk windows in bounds.  Root range starts at C.
+        # the tail pad keeps chunk windows in bounds.  TWO tail chunks: the
+        # Pallas partition's pass-2 destination windows start at the
+        # 128-aligned floor of an arbitrary leaf offset, so the last
+        # (RMW-blended) window can overhang the chunk-aligned cover by up
+        # to C-1 rows.  Root range starts at C.
         self.row0 = C
-        self.N_pad = C + ((self.N + C - 1) // C + 1) * C
+        self.N_pad = C + ((self.N + C - 1) // C + 2) * C
         self._use_pallas = (jax.default_backend() == "tpu"
                             and config.tpu_hist_kernel == "pallas")
         if self._use_pallas:
@@ -391,18 +395,25 @@ class SerialTreeLearner:
             and dataset.binned is not None
             and dataset.binned.dtype == np.uint8)
         self._pb_rows = self.G
-        self._ghi_rows = 3
+        # (8, N_pad) f32 ghi payload in BOTH partition modes: rows are
+        # (grad, hess, rowid-bits, then optional score/objective-payload
+        # rows for the physical fused step, zero-padded).  The Pallas DMA
+        # tiling needs 8 f32 sublanes anyway; the XLA path's per-row
+        # gather cost is width-independent (PERF.md).
+        self._ghi_rows = 8
+        self._ghi_live = 3     # rows the Pallas kernel must carry
         if self._use_pallas_part:
             try:
                 from ..ops.partition_pallas import (partition_leaf_pallas,
-                                                    make_scalars, SC_ROWS)
+                                                    make_scalars,
+                                                    sc_rows_for)
                 g32 = ((self.G + 31) // 32) * 32
                 cpr = self.row_chunk
                 tiny = 4 * cpr
                 out = partition_leaf_pallas(
                     jnp.zeros((g32, tiny), jnp.uint8),
                     jnp.zeros((8, tiny), jnp.float32),
-                    jnp.zeros((SC_ROWS, tiny), jnp.int32),
+                    jnp.zeros((sc_rows_for(g32), tiny), jnp.int32),
                     make_scalars(cpr, cpr, 0, 0, 0, 255, 0, 0, 128, 0),
                     row_chunk=cpr)
                 jax.block_until_ready(out)
@@ -519,11 +530,13 @@ class SerialTreeLearner:
         C = self.row_chunk
         G = self.G
         part_bins = st["part_bins"]
-        # grad/hess/rowid live PERMANENTLY as one (3, N_pad) f32 matrix
-        # (rowid bitcast to f32) so the per-chunk permute is one 2-D gather
+        # grad/hess/rowid (+ score/objective payload rows in the fused
+        # physical mode) live PERMANENTLY as one (R, N_pad) f32 matrix
+        # (ints bitcast to f32) so the per-chunk permute is one 2-D gather
         # on the chunk transpose (1-D gathers serialize on TPU) and no
         # per-split pack/unpack of the full row payload is materialized.
         part_ghi = st["part_ghi"]
+        R = part_ghi.shape[0]
         n_chunks = (cnt + C - 1) // C
 
         def blend(dst, val, off, mask):
@@ -543,7 +556,7 @@ class SerialTreeLearner:
             nl, nr, sc, sa = carry
             row0 = start + ci * C
             bch = jax.lax.dynamic_slice(part_bins, (0, row0), (G, C))
-            gch = jax.lax.dynamic_slice(part_ghi, (0, row0), (3, C))
+            gch = jax.lax.dynamic_slice(part_ghi, (0, row0), (R, C))
             # split-column extraction via masked reduction: a dynamic_slice
             # with a runtime SUBLANE offset lowers to a slow per-tile path
             colv = jnp.sum(bch.astype(jnp.int32) * col_onehot, axis=0)
@@ -576,7 +589,7 @@ class SerialTreeLearner:
             both32 = jnp.concatenate(
                 [bch.astype(jnp.int32),
                  jax.lax.bitcast_convert_type(gch, jnp.int32)], axis=0)
-            bothc = jnp.take(both32, order, axis=1)      # (G+3, C)
+            bothc = jnp.take(both32, order, axis=1)      # (G+R, C)
             iot = jax.lax.iota(jnp.int32, C)
             lmask = iot < nlc
             # rights window [start+cnt-nr-C, +C), mask last nrc rows; the
@@ -603,7 +616,7 @@ class SerialTreeLearner:
             pb, pg, pa = carry
             row0 = start + ci * C
             valid = (ci * C + jax.lax.iota(jnp.int32, C)) < cnt
-            win = jax.lax.dynamic_slice(sc, (0, row0), (G + 3, C))
+            win = jax.lax.dynamic_slice(sc, (0, row0), (G + R, C))
             pb = blend(pb, win[:G].astype(pb.dtype), row0, valid)
             pg = blend(pg, jax.lax.bitcast_convert_type(win[G:], jnp.float32),
                        row0, valid)
@@ -637,7 +650,7 @@ class SerialTreeLearner:
                                mtype, thr, dl)
         pb, pg, sp, nl = partition_leaf_pallas(
             st["part_bins"], st["part_ghi"], st["sc_packed"],
-            scalars, row_chunk=self.row_chunk)
+            scalars, row_chunk=self.row_chunk, ghi_live=self._ghi_live)
         moved = {"part_bins": pb, "part_ghi": pg, "sc_packed": sp}
         return moved, nl[0, 0]
 
@@ -1045,8 +1058,11 @@ class SerialTreeLearner:
         winner = jnp.argmax(gathered.gain)
         return jax.tree.map(lambda a: a[winner], gathered)
 
-    def _build_tree_impl(self, part_bins, grad_p, hess_p, rowid, bag_cnt,
+    def _build_tree_impl(self, part_bins, part_ghi0, bag_cnt,
                          feature_mask, seed, feat_used_init=None, aux0=None):
+        """Core tree loop over a prebuilt (8, N_pad) row payload whose
+        rows are (grad, hess, rowid-bits, extras...); the extras ride the
+        partition untouched (physical-order fused step)."""
         L, G, B, F = self.L, self.G, self.B, self.F
         nodes = self.max_splits
         rng0 = jax.random.PRNGKey(seed)
@@ -1064,14 +1080,6 @@ class SerialTreeLearner:
         feat_used0 = (jnp.zeros((F,), jnp.bool_) if feat_used_init is None
                       else feat_used_init)
 
-        part_ghi0 = jnp.stack(
-            [grad_p, hess_p,
-             jax.lax.bitcast_convert_type(rowid, jnp.float32)], axis=0)
-        if self._ghi_rows > 3:    # sublane pad for the Pallas DMA tiling
-            part_ghi0 = jnp.concatenate(
-                [part_ghi0, jnp.zeros((self._ghi_rows - 3,
-                                       part_ghi0.shape[1]), jnp.float32)],
-                axis=0)
         root_hist = self._psum(self._hist_leaf(
             part_bins, part_ghi0, jnp.int32(self.row0), jnp.int32(self.N)))
         bag_cnt_g = self._psum_scalar(bag_cnt)
@@ -1139,12 +1147,13 @@ class SerialTreeLearner:
             state["node_cat_set"] = jnp.zeros((nodes + 1, self.BF),
                                               jnp.bool_)
         if self._use_pallas_part:
-            from ..ops.partition_pallas import SC_ROWS
-            state["sc_packed"] = jnp.zeros((SC_ROWS, part_bins.shape[1]),
-                                           jnp.int32)
+            from ..ops.partition_pallas import sc_rows_for
+            state["sc_packed"] = jnp.zeros(
+                (sc_rows_for(self._pb_rows), part_bins.shape[1]),
+                jnp.int32)
         else:
-            state["sc32"] = jnp.zeros((G + 3, part_bins.shape[1]),
-                                      jnp.int32)
+            state["sc32"] = jnp.zeros((G + self._ghi_rows,
+                                       part_bins.shape[1]), jnp.int32)
 
         if self.ic_masks is not None:
             state["leaf_used"] = jnp.zeros((L + 1, F), jnp.bool_)
@@ -1562,9 +1571,14 @@ class SerialTreeLearner:
         hess_p = jnp.pad(hess, (C, tail))
         iota = jax.lax.iota(jnp.int32, self.N_pad)
         rowid = jnp.where((iota >= C) & (iota < C + self.N), iota - C, self.N)
+        part_ghi0 = jnp.concatenate([
+            jnp.stack([grad_p, hess_p,
+                       jax.lax.bitcast_convert_type(rowid, jnp.float32)]),
+            jnp.zeros((self._ghi_rows - 3, self.N_pad), jnp.float32)],
+            axis=0)
         if aux0 is not None:
             aux0 = jnp.pad(aux0, ((0, 0), (C, tail)))
-        return self._build_tree_impl(part_bins0, grad_p, hess_p, rowid,
+        return self._build_tree_impl(part_bins0, part_ghi0,
                                      bag_cnt, feature_mask, seed,
                                      feat_used_init, aux0)
 

@@ -53,6 +53,23 @@ class ObjectiveFunction:
     def get_gradients(self, score: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
         raise NotImplementedError
 
+    # --- physical-order fused training support -------------------------
+    # Names of the row-aligned attribute arrays the gradient computation
+    # reads; they ride the tree builder's partition payload so gradients
+    # are computed in PHYSICAL row order without a per-iteration scatter
+    # (models/boosting.py _setup_fused_phys).  A class opting in defines
+    # BOTH ``payload_fields`` and ``gradients_from_payload``; the fused
+    # step additionally requires gradients_from_payload in the concrete
+    # class's own __dict__, so a subclass overriding get_gradients can
+    # never silently inherit the wrong payload formula.
+    payload_fields: Optional[Tuple[str, ...]] = None
+
+    def gradient_payload(self) -> Optional[Tuple[jnp.ndarray, ...]]:
+        if self.payload_fields is None:
+            return None
+        return tuple(getattr(self, n) for n in self.payload_fields
+                     if getattr(self, n) is not None)
+
     def boost_from_score(self, class_id: int) -> float:
         return 0.0
 
@@ -99,6 +116,15 @@ class RegressionL2(ObjectiveFunction):
         grad = score - self.label
         hess = jnp.ones_like(score)
         return self._apply_weight(grad, hess)
+
+    payload_fields = ("label", "weight")
+
+    def gradients_from_payload(self, score, label, weight=None):
+        grad = score - label
+        hess = jnp.ones_like(score)
+        if weight is not None:
+            return grad * weight, hess * weight
+        return grad, hess
 
     def boost_from_score(self, class_id):
         lbl = self.label
@@ -297,6 +323,23 @@ class BinaryLogloss(ObjectiveFunction):
         self.cnt_pos, self.cnt_neg = cnt_pos, cnt_neg
         self.sign_label = jnp.where(jnp.asarray(pos), 1.0, -1.0)
         self.label_weight = jnp.where(jnp.asarray(pos), w_pos, w_neg)
+        # combined per-row weight for the payload path; pad rows carry 0
+        # sign_label, which already zeroes grad and hess there
+        self.label_weight_eff = (self.label_weight * self.weight
+                                 if self.weight is not None
+                                 else self.label_weight)
+
+    payload_fields = ("sign_label", "label_weight_eff")
+
+    def gradients_from_payload(self, score, sign_label, label_weight_eff):
+        response = -sign_label * self.sigmoid / (
+            1.0 + jnp.exp(sign_label * self.sigmoid * score))
+        abs_response = jnp.abs(response)
+        grad = response * label_weight_eff
+        hess = abs_response * (self.sigmoid - abs_response) * label_weight_eff
+        if not self.need_train:
+            return jnp.zeros_like(grad), jnp.zeros_like(hess)
+        return grad, hess
 
     def get_gradients(self, score):
         # reference: binary_objective.hpp:105-137

@@ -64,7 +64,13 @@ S_THR = 9       # split threshold (bin)
 S_DL = 10       # default_left (0/1)
 N_SCALARS = 11
 
-SC_ROWS = 16    # packed-scratch sublanes (32-bit DMA tile multiple)
+def sc_rows_for(g32: int) -> int:
+    """Packed-scratch sublanes for a (g32, N) bin matrix: the packed
+    words plus up to 8 live ghi rows, rounded to the 32-bit DMA tile."""
+    return ((g32 // 4 + 8 + 7) // 8) * 8
+
+
+SC_ROWS = sc_rows_for(32)   # the common g32=32 geometry
 
 
 def _excl_prefix_rights(flag_l, C):
@@ -117,17 +123,18 @@ def _cdiv(a, c):
 
 
 def partition_leaf_pallas(part_bins, part_ghi, sc_packed, scalars, *,
-                          row_chunk: int):
+                          row_chunk: int, ghi_live: int = 3):
     """Two-way stable partition of the leaf range described by
     ``scalars`` (see the S_* layout above), in place.
 
     Args:
       part_bins: (G32, N_pad) u8 binned matrix, G32 a multiple of 32.
-      part_ghi:  (8, N_pad)  f32 packed (grad, hess, rowid-bits, pad...).
-        Only rows 0..2 are preserved through the partition; the pad rows
-        come back as garbage.
+      part_ghi:  (8, N_pad)  f32 packed (grad, hess, rowid-bits, ...).
+        Only rows 0..ghi_live-1 are preserved through the partition; the
+        trailing pad rows come back zeroed/garbage.  The physical-order
+        fused training step rides score and objective payload rows here
+        (models/boosting.py _setup_fused_step).
       sc_packed: (SC_ROWS, N_pad) i32 scratch staging the packed rights
-        between the two passes (contents don't survive).
       scalars: (N_SCALARS,) i32.
     Returns (part_bins', part_ghi', sc_packed', nl) with the first three
     aliased in place; nl is an (8, 128) i32 tile whose [0, 0] element is
@@ -139,13 +146,16 @@ def partition_leaf_pallas(part_bins, part_ghi, sc_packed, scalars, *,
     G32, Np = part_bins.shape
     GH = part_ghi.shape[0]
     assert GH == 8 and G32 % 32 == 0, (G32, GH)
-    assert sc_packed.shape == (SC_ROWS, Np) and sc_packed.dtype == jnp.int32
+    SCR = sc_packed.shape[0]
+    assert (sc_packed.shape[1] == Np and SCR % 8 == 0
+            and sc_packed.dtype == jnp.int32)
     C = row_chunk
     assert C >= 256 and (C & (C - 1)) == 0 and Np % 128 == 0
     logc = C.bit_length() - 1
     W = G32 // 4        # packed bin words
-    P = W + 3           # packed payload sublanes (bins + g, h, rowid)
-    assert P <= SC_ROWS
+    assert 3 <= ghi_live <= GH
+    P = W + ghi_live    # packed payload sublanes (bins + live ghi rows)
+    assert P <= SCR
 
     def pack_bins(bins_i32):
         """(G32, C) i32 byte values -> (W, C) packed words."""
@@ -206,7 +216,8 @@ def partition_leaf_pallas(part_bins, part_ghi, sc_packed, scalars, *,
 
             bins_i = rb[slot].astype(jnp.int32)               # (G32, C)
             packed = pack_bins(bins_i)                        # (W, C)
-            ghi_i = jax.lax.bitcast_convert_type(rg[slot], jnp.int32)[0:3]
+            ghi_i = jax.lax.bitcast_convert_type(
+                rg[slot], jnp.int32)[0:ghi_live]
             payload = jnp.concatenate([packed, ghi_i], axis=0)  # (P, C)
 
             # --- decision (numerical splits; see ops/partition.py
@@ -265,7 +276,7 @@ def partition_leaf_pallas(part_bins, part_ghi, sc_packed, scalars, *,
                 wg[:] = jax.lax.bitcast_convert_type(
                     jnp.concatenate(
                         [stgl[W:P, 0:C],
-                         jnp.zeros((GH - 3, C), jnp.int32)], axis=0),
+                         jnp.zeros((GH - ghi_live, C), jnp.int32)], axis=0),
                     jnp.float32)
                 cb = pltpu.make_async_copy(
                     wb, pb.at[:, pl.ds(a0b * 128 + nfl * C, C)],
@@ -302,7 +313,7 @@ def partition_leaf_pallas(part_bins, part_ghi, sc_packed, scalars, *,
             wg[:] = jax.lax.bitcast_convert_type(
                 jnp.concatenate(
                     [stgl[W:P, 0:C],
-                     jnp.zeros((GH - 3, C), jnp.int32)], axis=0),
+                     jnp.zeros((GH - ghi_live, C), jnp.int32)], axis=0),
                 jnp.float32)
             cb = pltpu.make_async_copy(
                 wb, pb.at[:, pl.ds(a0b * 128 + nfl * C, C)], sems.at[0, 2])
@@ -373,14 +384,15 @@ def partition_leaf_pallas(part_bins, part_ghi, sc_packed, scalars, *,
             out_p = jnp.where(take_prev, pltpu.roll(prv_p, r0, 1),
                               pltpu.roll(cur_p, r0, 1))
             out_b = unpack_bins(out_p[0:W])          # (G32, C)
-            out_g3 = out_p[W:P]                      # (3, C) ghi bits
+            out_gl = out_p[W:P]                      # (ghi_live, C) bits
             valid = (lane >= lo) & (lane < hi)
             exg_i = jax.lax.bitcast_convert_type(exg[:], jnp.int32)
             wb[:] = jnp.where(valid, out_b,
                               exb[:].astype(jnp.int32)).astype(jnp.uint8)
             wg[:] = jax.lax.bitcast_convert_type(
                 jnp.concatenate(
-                    [jnp.where(valid, out_g3, exg_i[0:3]), exg_i[3:GH]],
+                    [jnp.where(valid, out_gl, exg_i[0:ghi_live]),
+                     exg_i[ghi_live:GH]],
                     axis=0),
                 jnp.float32)
             cb = pltpu.make_async_copy(
@@ -401,12 +413,12 @@ def partition_leaf_pallas(part_bins, part_ghi, sc_packed, scalars, *,
         scratch_shapes=[
             pltpu.VMEM((2, G32, C), jnp.uint8),      # rb
             pltpu.VMEM((2, GH, C), jnp.float32),     # rg
-            pltpu.VMEM((2, SC_ROWS, C), jnp.int32),  # rs
+            pltpu.VMEM((2, SCR, C), jnp.int32),      # rs
             pltpu.VMEM((P, 2 * C), jnp.int32),       # stgl
             pltpu.VMEM((P, 2 * C), jnp.int32),       # stgr
             pltpu.VMEM((G32, C), jnp.uint8),         # wb
             pltpu.VMEM((GH, C), jnp.float32),        # wg
-            pltpu.VMEM((SC_ROWS, C), jnp.int32),     # wp
+            pltpu.VMEM((SCR, C), jnp.int32),         # wp
             pltpu.VMEM((G32, C), jnp.uint8),         # exb
             pltpu.VMEM((GH, C), jnp.float32),        # exg
             pltpu.SemaphoreType.DMA((2, 4)),

@@ -1,0 +1,21 @@
+import numpy as np, jax, jax.numpy as jnp
+from lightgbm_tpu.ops.partition_pallas import (partition_leaf_pallas,
+                                               make_scalars, sc_rows_for)
+C, G32 = 8192, 32
+Np = 8192*130
+SCR = sc_rows_for(G32)
+rng = np.random.RandomState(1)
+pb0 = jnp.asarray(rng.randint(0, 255, (G32, Np)).astype(np.uint8))
+pg0 = jnp.asarray(rng.randn(8, Np).astype(np.float32))
+sp0 = jnp.zeros((SCR, Np), jnp.int32)
+for trial in range(40):
+    start = int(rng.randint(C, Np//2))
+    cnt = int(rng.randint(0, Np - start - 3*C))
+    col = int(rng.randint(0, 28)); nb = int(rng.randint(10, 255))
+    thr = int(rng.randint(0, nb)); mtype = int(rng.randint(0, 3))
+    dbin = int(rng.randint(0, nb)); dl = int(rng.rand() < 0.5)
+    sc = make_scalars(start, cnt, col, 0, 0, nb, dbin, mtype, thr, dl)
+    out = partition_leaf_pallas(pb0, pg0, sp0, sc, row_chunk=C, ghi_live=6)
+    s = float(jnp.sum(out[3])); _ = float(jnp.sum(out[0].astype(jnp.int32))); _ = float(jnp.sum(out[1]))
+    print("trial", trial, "cnt", cnt, "nl", s/ (8*128), flush=True)
+print("OK")
