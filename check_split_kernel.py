@@ -1,0 +1,64 @@
+"""TPU check: best_split_pair_pallas vs find_best_split_fast."""
+import numpy as np, jax, jax.numpy as jnp
+assert jax.default_backend() == "tpu"
+from lightgbm_tpu.ops import split as so
+from lightgbm_tpu.ops.split_pallas import best_split_pair_pallas
+
+rng = np.random.RandomState(5)
+F, BF = 28, 255
+for trial in range(6):
+    num_bin = rng.randint(3, BF + 1, size=F).astype(np.int32)
+    missing = rng.randint(0, 3, size=F).astype(np.int32)
+    dflt = np.where(missing == 1, rng.randint(0, 3, size=F), 0).astype(np.int32)
+    ctx = so.SplitContext(jnp.asarray(num_bin), jnp.asarray(missing),
+                          jnp.asarray(dflt), jnp.zeros(F, jnp.int32),
+                          jnp.arange(F, dtype=jnp.int32))
+    half = np.zeros((F, 8), np.int32)
+    half[:, 0] = num_bin; half[:, 1] = missing; half[:, 2] = dflt
+    fmeta = jnp.asarray(np.concatenate([half, half]))
+    args_static = dict(l1=0.0 if trial % 2 else 0.3, l2=1e-3,
+                       max_delta_step=0.0, min_gain_to_split=0.0,
+                       min_data_in_leaf=5, min_sum_hessian=1e-3,
+                       max_depth=0)
+    hists, infos, refs = [], [], []
+    for c in range(2):
+        hist = np.zeros((F, BF, 2), np.float32)
+        for f in range(F):
+            hist[f, :num_bin[f], 0] = rng.normal(size=num_bin[f])
+            hist[f, :num_bin[f], 1] = rng.uniform(0.01, 2.0, size=num_bin[f])
+        sum_g = float(hist[0, :, 0].sum()); sum_h = float(hist[0, :, 1].sum())
+        cnt = 2000 + c * 500
+        mask = rng.rand(F) > 0.2
+        ref = so.find_best_split_fast(
+            jnp.asarray(hist), ctx, jnp.float32(sum_g), jnp.float32(sum_h),
+            jnp.int32(cnt), args_static["l1"], args_static["l2"], 0.0, 0.0,
+            5, 1e-3, jnp.asarray(mask))
+        refs.append(ref)
+        hists.append(hist)
+        info = np.zeros((F, 8), np.float32)
+        info[:, 0] = sum_g; info[:, 1] = sum_h; info[:, 2] = cnt
+        info[:, 3] = 1.0; info[:, 4] = mask
+        infos.append(info)
+    hg = jnp.asarray(np.concatenate([hists[0][..., 0], hists[1][..., 0]]))
+    hh = jnp.asarray(np.concatenate([hists[0][..., 1], hists[1][..., 1]]))
+    info = jnp.asarray(np.concatenate(infos))
+    tile = np.asarray(best_split_pair_pallas(hg, hh, fmeta, info,
+                                             **args_static))
+    for c, ref in enumerate(refs):
+        row = tile[c]
+        gain = row[0]
+        feat = row[1:2].view(np.int32)[0]
+        thr = row[2:3].view(np.int32)[0]
+        dl = row[3] > 0.5
+        lc = row[4:5].view(np.int32)[0]
+        assert np.isclose(gain, float(ref.gain), rtol=2e-4, atol=1e-5) or \
+            (not np.isfinite(gain) and not np.isfinite(float(ref.gain))), \
+            (trial, c, gain, float(ref.gain))
+        assert feat == int(ref.feature), (trial, c, feat, int(ref.feature))
+        assert thr == int(ref.threshold), (trial, c, thr, int(ref.threshold))
+        assert dl == bool(ref.default_left), (trial, c)
+        assert abs(lc - int(ref.left_count)) <= 1, (trial, c)
+        np.testing.assert_allclose(row[6], float(ref.left_sum_g), rtol=2e-4, atol=1e-5)
+        np.testing.assert_allclose(row[10], float(ref.left_output), rtol=2e-4, atol=1e-5)
+    print("trial", trial, "ok", flush=True)
+print("ALL OK")

@@ -465,6 +465,41 @@ class SerialTreeLearner:
                              and self.path_smooth <= 0.0
                              and self.N < (1 << 24))
 
+        # Pallas split-search kernel: one program per split evaluates
+        # both children (ops/split_pallas.py).  Plain serial TPU path
+        # only; falls back to the XLA fast search elsewhere.
+        self._use_pallas_search = (self._use_pallas_part
+                                   and self._fast_search
+                                   and self._plain_view
+                                   and self.forced is None
+                                   and parallel_mode == "serial"
+                                   and self.F > 0)
+        if self._use_pallas_search:
+            half = np.zeros((self.F, 8), np.int32)
+            half[:, 0] = meta["num_bin"]
+            half[:, 1] = meta["missing_type"]
+            half[:, 2] = meta["default_bin"]
+            self._fmeta_pair = jnp.asarray(np.concatenate([half, half]))
+            try:
+                from ..ops.split_pallas import best_split_pair_pallas
+                t = best_split_pair_pallas(
+                    jnp.zeros((2 * self.F, self.BF), jnp.float32),
+                    jnp.zeros((2 * self.F, self.BF), jnp.float32),
+                    self._fmeta_pair,
+                    jnp.zeros((2 * self.F, 8), jnp.float32),
+                    l1=self.l1, l2=self.l2,
+                    max_delta_step=self.max_delta_step,
+                    min_gain_to_split=self.min_gain_to_split,
+                    min_data_in_leaf=self.min_data_in_leaf,
+                    min_sum_hessian=self.min_sum_hessian,
+                    max_depth=self.max_depth)
+                jax.block_until_ready(t)
+            except Exception as exc:
+                log.warning("pallas split-search kernel unavailable (%s); "
+                            "using the XLA search",
+                            str(exc).split("\n")[0][:120])
+                self._use_pallas_search = False
+
         axes = (0, 0, 0, 0, 0, 0, 0, 0, 0, 0, None)
         if self.cegb_lazy is not None:
             axes = axes + (0,)
@@ -1388,19 +1423,6 @@ class SerialTreeLearner:
                     lazy_pair = (self._lazy_counts(
                         aux_m, start, left_cnt, cnt - left_cnt),)
 
-                both = self._best_split_vmapped(
-                    jnp.stack([hist_left, hist_right]),
-                    jnp.stack([lsg, rsg]), jnp.stack([lsh, rsh]),
-                    jnp.stack([left_cnt_g, right_cnt_g]),
-                    jnp.stack([left_cnt, right_cnt]),
-                    jnp.stack([depth_child, depth_child]),
-                    jnp.stack([l_cmin, r_cmin]),
-                    jnp.stack([l_cmax, r_cmax]),
-                    jnp.stack([lout, rout]),
-                    jnp.stack([mask_l, mask_r]), feat_used_new, *lazy_pair)
-                best_l = self._sync_best(jax.tree.map(lambda a: a[0], both))
-                best_r = self._sync_best(jax.tree.map(lambda a: a[1], both))
-
                 if self.forced is not None:
                     forced_l = jnp.where(forced_ok,
                                          self.forced["left"][forced_node],
@@ -1411,24 +1433,86 @@ class SerialTreeLearner:
                 else:
                     forced_l = forced_r = jnp.int32(-1)
 
-                def child_col(cstart, ccnt, ccnt_g, csg, csh, cout, cmin_,
-                              cmax_, side, bs, forced_id):
+                def child_head(cstart, ccnt, ccnt_g, csg, csh, cout, cmin_,
+                               cmax_, side):
                     return jnp.stack([
                         _i2f(cstart), _i2f(ccnt), _i2f(ccnt_g), csg, csh,
                         _i2f(depth_child), cmin_, cmax_, cout, _i2f(s),
-                        _i2f(side), bs.gain, _i2f(bs.feature),
-                        _i2f(bs.threshold),
-                        bs.default_left.astype(jnp.float32),
-                        _i2f(bs.left_count), _i2f(bs.right_count),
-                        bs.left_sum_g, bs.left_sum_h,
-                        bs.right_sum_g, bs.right_sum_h,
-                        bs.left_output, bs.right_output,
-                        bs.is_cat.astype(jnp.float32), _i2f(forced_id)])
+                        _i2f(side)])
 
-                col_l = child_col(l_start, left_cnt, left_cnt_g, lsg, lsh,
-                                  lout, l_cmin, l_cmax, 0, best_l, forced_l)
-                col_r = child_col(r_start, right_cnt, right_cnt_g, rsg, rsh,
-                                  rout, r_cmin, r_cmax, 1, best_r, forced_r)
+                head_l = child_head(l_start, left_cnt, left_cnt_g, lsg,
+                                    lsh, lout, l_cmin, l_cmax, 0)
+                head_r = child_head(r_start, right_cnt, right_cnt_g, rsg,
+                                    rsh, rout, r_cmin, r_cmax, 1)
+
+                if self._use_pallas_search:
+                    # both children's searches as ONE kernel emitting the
+                    # packed [LM_BGAIN..LM_BISCAT] leafmat segments
+                    from ..ops.split_pallas import best_split_pair_pallas
+                    BFs = self.BF
+                    hg = jnp.concatenate([hist_left[:, :BFs, 0],
+                                          hist_right[:, :BFs, 0]], axis=0)
+                    hh = jnp.concatenate([hist_left[:, :BFs, 1],
+                                          hist_right[:, :BFs, 1]], axis=0)
+                    onesF = jnp.ones((F, 1), jnp.float32)
+                    dep_f = depth_child.astype(jnp.float32)
+
+                    def iblock(csg, csh, ccnt_g, mask):
+                        return jnp.concatenate([
+                            onesF * csg, onesF * csh,
+                            onesF * ccnt_g.astype(jnp.float32),
+                            onesF * dep_f,
+                            mask.astype(jnp.float32)[:, None],
+                            jnp.zeros((F, 3), jnp.float32)], axis=1)
+
+                    info = jnp.concatenate(
+                        [iblock(lsg, lsh, left_cnt_g, mask_l),
+                         iblock(rsg, rsh, right_cnt_g, mask_r)], axis=0)
+                    tile = best_split_pair_pallas(
+                        hg, hh, self._fmeta_pair, info,
+                        l1=self.l1, l2=self.l2,
+                        max_delta_step=self.max_delta_step,
+                        min_gain_to_split=self.min_gain_to_split,
+                        min_data_in_leaf=self.min_data_in_leaf,
+                        min_sum_hessian=self.min_sum_hessian,
+                        max_depth=self.max_depth)
+                    col_l = jnp.concatenate(
+                        [head_l, tile[0, :13],
+                         _i2f(forced_l)[None]])
+                    col_r = jnp.concatenate(
+                        [head_r, tile[1, :13],
+                         _i2f(forced_r)[None]])
+                else:
+                    both = self._best_split_vmapped(
+                        jnp.stack([hist_left, hist_right]),
+                        jnp.stack([lsg, rsg]), jnp.stack([lsh, rsh]),
+                        jnp.stack([left_cnt_g, right_cnt_g]),
+                        jnp.stack([left_cnt, right_cnt]),
+                        jnp.stack([depth_child, depth_child]),
+                        jnp.stack([l_cmin, r_cmin]),
+                        jnp.stack([l_cmax, r_cmax]),
+                        jnp.stack([lout, rout]),
+                        jnp.stack([mask_l, mask_r]), feat_used_new,
+                        *lazy_pair)
+                    best_l = self._sync_best(
+                        jax.tree.map(lambda a: a[0], both))
+                    best_r = self._sync_best(
+                        jax.tree.map(lambda a: a[1], both))
+
+                    def seg13(bs):
+                        return jnp.stack([
+                            bs.gain, _i2f(bs.feature), _i2f(bs.threshold),
+                            bs.default_left.astype(jnp.float32),
+                            _i2f(bs.left_count), _i2f(bs.right_count),
+                            bs.left_sum_g, bs.left_sum_h,
+                            bs.right_sum_g, bs.right_sum_h,
+                            bs.left_output, bs.right_output,
+                            bs.is_cat.astype(jnp.float32)])
+
+                    col_l = jnp.concatenate(
+                        [head_l, seg13(best_l), _i2f(forced_l)[None]])
+                    col_r = jnp.concatenate(
+                        [head_r, seg13(best_r), _i2f(forced_r)[None]])
                 lm2 = lm.at[:, wr_a].set(col_l).at[:, wr_b].set(col_r)
 
                 iot_l1 = jax.lax.iota(jnp.int32, L + 1)

@@ -1,29 +1,33 @@
 """Pallas TPU kernel for the all-numerical best-split search.
 
-One program per split evaluates BOTH children: the while-body's split
-search is op-dispatch-bound on this stack (~80 us/split as ~25 XLA ops,
-PERF.md), while the actual compute is trivial — a (336, BF) prefix-sum
-matmul and a few VPU passes over (2F, BF) grids.  Collapsing it into a
-single all-VMEM pallas_call (no DMAs, no scalar prefetch — the kernel
-class that compiles through the remote Mosaic toolchain) removes the
-dispatch overhead.
+One program per split evaluates BOTH children of the freshly split leaf:
+the while-body's split search is op-dispatch-bound on this stack
+(~80 us/split as ~25 XLA ops, PERF.md), while the actual compute is
+trivial — one (12F, BF) prefix-sum matmul on the MXU and a few VPU
+passes over (2F, BF) grids.  Collapsing it into a single all-VMEM
+pallas_call (no DMAs, no scalar prefetch — the kernel class that
+compiles through the remote Mosaic toolchain) removes the dispatch
+overhead.
 
 Semantics match ops/split.py:find_best_split_fast (itself equivalent to
 the reference FindBestThresholdSequentially dispatch,
 feature_histogram.hpp:272-455):
   * forward scan (missing right) and reverse scan (missing left) with
     MissingType::Zero default-bin skipping and the NaN-bin exclusion;
-  * tie-breaking encoded as a per-candidate PREFERENCE KEY
-    (feature-major; within a feature the reverse scan's thresholds
-    descending, then the forward scan's ascending) — the winner is the
-    minimum key among maximum-gain candidates, so no lane reversal is
-    needed in-kernel;
-  * counts ride f32 (exact below 2^24 rows).
+  * the reference's scan-order tie-breaking is encoded as a
+    per-candidate PREFERENCE KEY (feature-major; within a feature the
+    reverse scan's thresholds descending, then the forward scan's
+    ascending): the winner is the minimum key among maximum-gain
+    candidates, so no lane reversal is needed in-kernel;
+  * counts ride f32 (exact below 2^24 rows);
+  * the depth guard (models/learner.py _depth_guard) is folded into the
+    candidate validity mask.
 
 The output tile rows are the packed leafmat column segment
 [LM_BGAIN..LM_BISCAT] (models/learner.py) for the left (row 0) and
 right (row 1) child, with int fields bitcast into the f32 container —
-the caller splices them into the leaf matrix with one dynamic update.
+the caller splices them into the leaf matrix with one dynamic update
+per child.
 """
 
 from __future__ import annotations
@@ -35,80 +39,79 @@ import jax.numpy as jnp
 import numpy as np
 
 K_EPSILON = 1e-15
-NEG = jnp.float32(-jnp.inf)
 
-# output tile rows 0/1 hold, per child, lanes 0..12 =
-# [gain, feature(i32), threshold(i32), default_left, lcnt(i32),
-#  rcnt(i32), lsg, lsh, rsg, rsh, lout, rout, is_cat] — exactly the
-# LM_BGAIN..LM_BISCAT leafmat segment.
-OUT_FIELDS = 13
+# fmeta columns (per stacked child-feature row)
+FM_NUM_BIN = 0
+FM_MISSING = 1
+FM_DEFAULT = 2
 
+# info columns (per stacked child-feature row)
+IN_SUM_G = 0
+IN_SUM_H = 1
+IN_NUM_DATA = 2
+IN_DEPTH = 3
+IN_MASK = 4
 
-def _i2f(x):
-    return jax.lax.bitcast_convert_type(jnp.asarray(x, jnp.int32),
-                                        jnp.float32)
+OUT_FIELDS = 13     # lanes of each output row = LM_BGAIN..LM_BISCAT
 
 
 @functools.partial(jax.jit, static_argnames=(
     "l1", "l2", "max_delta_step", "min_gain_to_split", "min_data_in_leaf",
     "min_sum_hessian", "max_depth"))
-def best_split_pair_pallas(hist_g, hist_h, fmeta, leafinfo, feature_mask,
+def best_split_pair_pallas(hist_g, hist_h, fmeta, info,
                            *, l1: float, l2: float, max_delta_step: float,
                            min_gain_to_split: float, min_data_in_leaf: int,
                            min_sum_hessian: float, max_depth: int):
     """Best numerical split for two sibling leaves.
 
     Args:
-      hist_g / hist_h: (2F, BF) f32 — gradient / hessian histograms, the
-        left child's F feature rows stacked above the right child's.
-      fmeta: (8, F) i32 — rows [num_bin, missing_type, default_bin] (the
-        rest pad).
-      leafinfo: (8, 128) f32 — per-child scalars at [child, k]:
-        k=0 sum_g, 1 sum_h, 2 num_data (f32), 3 depth (f32).
-      feature_mask: (1, F) i32 — 1 where the feature may split.
-    Returns an (8, 128) f32 tile (see OUT_FIELDS).
+      hist_g / hist_h: (2F, BF) f32 — gradient / hessian histograms;
+        the left child's F feature rows stacked above the right child's.
+      fmeta: (2F, 8) i32 — FM_* columns (static per-feature metadata,
+        duplicated per child block).
+      info: (2F, 8) f32 — IN_* columns (per-split leaf scalars broadcast
+        over each child block; IN_MASK is the per-child feature mask).
+    Returns an (8, 128) f32 tile; rows 0/1 hold the children's packed
+    leafmat segments (see module docstring).
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     F2, BF = hist_g.shape
     F = F2 // 2
-    BIG = jnp.float32(3e38)
+    NEG = float("-inf")
+
+    def thr_l1(g):
+        # sign(g)*max(0,|g|-l1) without jnp.sign (untested lowering);
+        # the where-form is identical (both give 0 at g == 0)
+        mag = jnp.maximum(0.0, jnp.abs(g) - l1)
+        return jnp.where(g < 0, -mag, mag)
 
     def leaf_out(g, h):
-        s = jnp.sign(g) * jnp.maximum(0.0, jnp.abs(g) - l1)
-        ret = -s / (h + l2)
+        ret = -thr_l1(g) / (h + l2)
         if max_delta_step > 0:
             ret = jnp.clip(ret, -max_delta_step, max_delta_step)
         return ret
 
     def leaf_gain(g, h):
+        s = thr_l1(g)
         if max_delta_step > 0:
             out = leaf_out(g, h)
-            s = jnp.sign(g) * jnp.maximum(0.0, jnp.abs(g) - l1)
             return -(2.0 * s * out + (h + l2) * out * out)
-        s = jnp.sign(g) * jnp.maximum(0.0, jnp.abs(g) - l1)
         return s * s / (h + l2)
 
-    def kernel(hg, hh, fm, li, mask, out):
-        nb = jnp.broadcast_to(fm[0:1, :].reshape(F, 1), (F, 1))
-        mtype = fm[1:2, :].reshape(F, 1)
-        dflt = fm[2:3, :].reshape(F, 1)
-        nb2 = jnp.concatenate([nb, nb], axis=0)          # (2F, 1)
-        mtype2 = jnp.concatenate([mtype, mtype], axis=0)
-        dflt2 = jnp.concatenate([dflt, dflt], axis=0)
-        fmask2 = jnp.concatenate(
-            [mask[0:1, :].reshape(F, 1), mask[0:1, :].reshape(F, 1)],
-            axis=0)                                       # (2F, 1)
-
-        child = (jax.lax.broadcasted_iota(jnp.int32, (F2, 1), 0) >= F
-                 ).astype(jnp.int32)                      # 0 left, 1 right
-        sum_g = jnp.where(child == 0, li[0, 0], li[1, 0])
-        sum_h_tot = jnp.where(child == 0, li[0, 1], li[1, 1]) \
-            + 2 * K_EPSILON
-        num_data = jnp.where(child == 0, li[0, 2], li[1, 2])
-        depth = li[0, 3]
-        cnt_factor = num_data / sum_h_tot                 # (2F, 1)
+    def kernel(hg_ref, hh_ref, fm_ref, li_ref, out):
+        hg = hg_ref[:]
+        hh = hh_ref[:]
+        nb2 = fm_ref[:, FM_NUM_BIN:FM_NUM_BIN + 1]        # (2F, 1)
+        mtype2 = fm_ref[:, FM_MISSING:FM_MISSING + 1]
+        dflt2 = fm_ref[:, FM_DEFAULT:FM_DEFAULT + 1]
+        sum_g = li_ref[:, IN_SUM_G:IN_SUM_G + 1]          # (2F, 1)
+        sum_h_tot = li_ref[:, IN_SUM_H:IN_SUM_H + 1] + 2 * K_EPSILON
+        num_data = li_ref[:, IN_NUM_DATA:IN_NUM_DATA + 1]
+        depth = li_ref[:, IN_DEPTH:IN_DEPTH + 1]
+        fmask2 = (li_ref[:, IN_MASK:IN_MASK + 1] > 0).astype(jnp.int32)
+        cnt_factor = num_data / sum_h_tot
 
         bins = jax.lax.broadcasted_iota(jnp.int32, (F2, BF), 1)
         in_range_i = (bins < nb2).astype(jnp.int32)
@@ -118,16 +121,14 @@ def best_split_pair_pallas(hist_g, hist_h, fmeta, leafinfo, feature_mask,
         cnt_bin = jnp.floor(hh * cnt_factor + 0.5) * in_range_i
 
         at_dflt_i = (bins == dflt2).astype(jnp.int32)
-        mf = in_range_i * (1 - zero_i * at_dflt_i)
+        mf = (in_range_i * (1 - zero_i * at_dflt_i)).astype(jnp.float32)
         bmax = nb2 - 1 - nan_i * two_scan_i
         mr = (in_range_i * (1 - two_scan_i * zero_i * at_dflt_i) *
-              (bins <= bmax).astype(jnp.int32))
+              (bins <= bmax).astype(jnp.int32)).astype(jnp.float32)
 
-        mf_f = mf.astype(jnp.float32)
-        mr_f = mr.astype(jnp.float32)
         stacked = jnp.concatenate([
-            hg * mf_f, hh * mf_f, cnt_bin * mf_f,
-            hg * mr_f, hh * mr_f, cnt_bin * mr_f], axis=0)  # (12F, BF)
+            hg * mf, hh * mf, cnt_bin * mf,
+            hg * mr, hh * mr, cnt_bin * mr], axis=0)       # (12F, BF)
         tri = (jax.lax.broadcasted_iota(jnp.int32, (BF, BF), 0) <=
                jax.lax.broadcasted_iota(jnp.int32, (BF, BF), 1)
                ).astype(jnp.float32)
@@ -145,9 +146,12 @@ def best_split_pair_pallas(hist_g, hist_h, fmeta, leafinfo, feature_mask,
         cg_r = cs[3 * F2:4 * F2]
         ch_r = cs[4 * F2:5 * F2]
         cc_r = cs[5 * F2:6 * F2]
-        tot_g = jnp.sum(hg * mr_f, axis=1, keepdims=True)
-        tot_h = jnp.sum(hh * mr_f, axis=1, keepdims=True)
-        tot_c = jnp.sum(cnt_bin * mr_f, axis=1, keepdims=True)
+        # totals from the prefix matmul's LAST column: a separate sum
+        # reduce rounds differently and the right-side subtraction
+        # amplifies the mismatch vs the XLA fast search
+        tot_g = cg_r[:, BF - 1:BF]
+        tot_h = ch_r[:, BF - 1:BF]
+        tot_c = cc_r[:, BF - 1:BF]
         rg_r = tot_g - cg_r
         rh_r = tot_h - ch_r + K_EPSILON
         rc_r = tot_c - cc_r
@@ -186,81 +190,74 @@ def best_split_pair_pallas(hist_g, hist_h, fmeta, leafinfo, feature_mask,
         gf = jnp.where(valid_f != 0, gain_f, NEG)
         gr = jnp.where(valid_r != 0, gain_r, NEG)
 
-        # preference keys: feature-major, reverse-desc then forward-asc
+        # preference keys (feature-major; rev desc-t then fwd asc-t)
         feat = jax.lax.broadcasted_iota(jnp.int32, (F2, BF), 0)
         feat = jnp.where(feat >= F, feat - F, feat)
         pref_r = feat * (2 * BF) + (BF - 1 - bins)
         pref_f = feat * (2 * BF) + BF + bins
+        # single-scan NaN features flip default_left off for reverse
+        # winners (find_best_split_fast dl_r); kept as a (2F, 1) column —
+        # materializing it as a broadcast grid crashes Mosaic
+        snan_col = ((1 - two_scan_i) * nan_i).astype(jnp.float32)
 
-        out_rows = []
+        acc = jnp.zeros((8, 128), jnp.float32)
+        rows8 = jax.lax.broadcasted_iota(jnp.int32, (8, 128), 0)
+        lanes8 = jax.lax.broadcasted_iota(jnp.int32, (8, 128), 1)
         for c in range(2):
             s = slice(c * F, (c + 1) * F)
             gmax = jnp.maximum(jnp.max(gf[s]), jnp.max(gr[s]))
             key_r = jnp.where(gr[s] >= gmax, pref_r[s], jnp.int32(1 << 30))
             key_f = jnp.where(gf[s] >= gmax, pref_f[s], jnp.int32(1 << 30))
             win = jnp.minimum(jnp.min(key_r), jnp.min(key_f))
-            is_rev = (win % (2 * BF)) < BF
             sel_r = (key_r == win).astype(jnp.float32)
             sel_f = (key_f == win).astype(jnp.float32)
 
-            def pick(a_r, a_f):
-                return (jnp.sum(a_r[s] * sel_r) + jnp.sum(a_f[s] * sel_f))
+            def pick(a_r, a_f, s=s, sel_r=sel_r, sel_f=sel_f):
+                return jnp.sum(a_r[s] * sel_r) + jnp.sum(a_f[s] * sel_f)
 
             lg = pick(lg_r, lg_f)
             lh = pick(lh_r, lh_f)
             lc = pick(lc_r, lc_f)
-            snan = pick((two_scan_i == 0).astype(jnp.float32) *
-                        nan_i.astype(jnp.float32) *
-                        jnp.ones((F2, BF), jnp.float32),
-                        jnp.zeros((F2, BF), jnp.float32))
             wfeat = win // (2 * BF)
             r = win - wfeat * (2 * BF)
-            thr = jnp.where(is_rev, BF - 1 - r, r - BF)
-            dl = jnp.where(is_rev, jnp.where(snan > 0, 0.0, 1.0), 0.0)
+            is_rev_i = (r < BF).astype(jnp.int32)
+            thr = jnp.where(is_rev_i != 0, BF - 1 - r, r - BF)
+            sel_row = jnp.sum(sel_r, axis=1, keepdims=True)
+            snan_pick = jnp.sum(snan_col[s] * sel_row)
+            dl = is_rev_i.astype(jnp.float32) * (1.0 - snan_pick)
 
-            sg_c = li[c, 0]
-            sh_c = li[c, 1] + 2 * K_EPSILON
-            nd_c = li[c, 2]
+            sg_c = jnp.max(li_ref[s, IN_SUM_G:IN_SUM_G + 1])
+            sh_c = jnp.max(li_ref[s, IN_SUM_H:IN_SUM_H + 1]) \
+                + 2 * K_EPSILON
+            nd_c = jnp.max(li_ref[s, IN_NUM_DATA:IN_NUM_DATA + 1])
             rg = sg_c - lg
             rh = sh_c - lh
             rc = nd_c - lc
-            g_best = jnp.maximum(gmax, NEG)
-            gain_rel = jnp.where(g_best > NEG,
-                                 g_best - (leaf_gain(sg_c, sh_c) +
-                                           min_gain_to_split), NEG)
-            row = [
+            shift_c = leaf_gain(sg_c, sh_c) + min_gain_to_split
+            has_win = (win < (1 << 30)).astype(jnp.float32)
+            gain_rel = jnp.where(has_win > 0, gmax - shift_c, NEG)
+
+            def bitf(x):
+                # tpu.bitcast needs vector operands; go through (1, 1)
+                v = jnp.broadcast_to(x, (1, 1)).astype(jnp.int32)
+                return jax.lax.bitcast_convert_type(v, jnp.float32)
+
+            vals = [
                 gain_rel,
-                jax.lax.bitcast_convert_type(wfeat, jnp.float32),
-                jax.lax.bitcast_convert_type(thr, jnp.float32),
+                bitf(wfeat),
+                bitf(thr),
                 dl,
-                jax.lax.bitcast_convert_type(lc.astype(jnp.int32),
-                                             jnp.float32),
-                jax.lax.bitcast_convert_type(rc.astype(jnp.int32),
-                                             jnp.float32),
+                bitf(lc),
+                bitf(rc),
                 lg, lh - K_EPSILON, rg, rh - K_EPSILON,
                 leaf_out(lg, lh), leaf_out(rg, rh),
                 jnp.float32(0.0),          # is_cat: numerical only
             ]
-            out_rows.append(row)
-
-        lanes = jax.lax.broadcasted_iota(jnp.int32, (8, 128), 1)
-        rows = jax.lax.broadcasted_iota(jnp.int32, (8, 128), 0)
-        acc = jnp.zeros((8, 128), jnp.float32)
-        for c in range(2):
-            for k, v in enumerate(out_rows[c]):
-                acc = jnp.where((rows == c) & (lanes == k),
-                                v, acc)
+            for k, v in enumerate(vals):
+                acc = jnp.where((rows8 == c) & (lanes8 == k), v, acc)
         out[:] = acc
 
-    out = jax.jit(lambda *a: pl.pallas_call(
+    return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)] * 0 +
-                 [pl.BlockSpec((hist_g.shape), lambda: (0, 0)),
-                  pl.BlockSpec((hist_h.shape), lambda: (0, 0)),
-                  pl.BlockSpec((fmeta.shape), lambda: (0, 0)),
-                  pl.BlockSpec((leafinfo.shape), lambda: (0, 0)),
-                  pl.BlockSpec((feature_mask.shape), lambda: (0, 0))],
-        out_specs=pl.BlockSpec((8, 128), lambda: (0, 0)),
-    )(*a))(hist_g, hist_h, fmeta, leafinfo, feature_mask)
-    return out
+    )(hist_g, hist_h, fmeta, info)
