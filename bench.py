@@ -46,6 +46,8 @@ def _train_per_iter(lgb, rows, iters):
         "verbosity": -1,
         "metric": "",
     }
+    if os.environ.get("BENCH_CHUNK"):
+        params["tpu_row_chunk"] = int(os.environ["BENCH_CHUNK"])
     ds = lgb.Dataset(X, label=y)
     ds.construct(params)
 

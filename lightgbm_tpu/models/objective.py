@@ -323,20 +323,25 @@ class BinaryLogloss(ObjectiveFunction):
         self.cnt_pos, self.cnt_neg = cnt_pos, cnt_neg
         self.sign_label = jnp.where(jnp.asarray(pos), 1.0, -1.0)
         self.label_weight = jnp.where(jnp.asarray(pos), w_pos, w_neg)
-        # combined per-row weight for the payload path; pad rows carry 0
-        # sign_label, which already zeroes grad and hess there
-        self.label_weight_eff = (self.label_weight * self.weight
-                                 if self.weight is not None
-                                 else self.label_weight)
+        # sign and combined weight packed into ONE payload row (the
+        # partition payload is compaction-cost-proportional to its row
+        # count): sign(signed_lw) is the label sign, |signed_lw| the
+        # effective weight.  Zero-weight (and pad) rows decode sign +1
+        # and weight 0, which zeroes grad and hess.
+        lw = (self.label_weight * self.weight
+              if self.weight is not None else self.label_weight)
+        self.signed_label_weight = self.sign_label * lw
 
-    payload_fields = ("sign_label", "label_weight_eff")
+    payload_fields = ("signed_label_weight",)
 
-    def gradients_from_payload(self, score, sign_label, label_weight_eff):
+    def gradients_from_payload(self, score, signed_label_weight):
+        sign_label = jnp.where(signed_label_weight < 0, -1.0, 1.0)
+        lw = jnp.abs(signed_label_weight)
         response = -sign_label * self.sigmoid / (
             1.0 + jnp.exp(sign_label * self.sigmoid * score))
         abs_response = jnp.abs(response)
-        grad = response * label_weight_eff
-        hess = abs_response * (self.sigmoid - abs_response) * label_weight_eff
+        grad = response * lw
+        hess = abs_response * (self.sigmoid - abs_response) * lw
         if not self.need_train:
             return jnp.zeros_like(grad), jnp.zeros_like(hess)
         return grad, hess
