@@ -305,6 +305,20 @@ class BinnedDataset:
         max_bin_by_feature = None
         if cfg.max_bin_by_feature:
             max_bin_by_feature = [int(x) for x in str(cfg.max_bin_by_feature).split(",")]
+        # forced bin upper bounds (reference: DatasetLoader reads
+        # forcedbins_filename as [{"feature": i, "bin_upper_bound": [...]}]
+        # and threads them into BinMapper::FindBin, dataset_loader.cpp)
+        forced_bounds: dict = {}
+        if getattr(cfg, "forcedbins_filename", ""):
+            import json as _json
+            try:
+                with open(cfg.forcedbins_filename) as fh:
+                    for entry in _json.load(fh):
+                        forced_bounds[int(entry["feature"])] = [
+                            float(v) for v in entry["bin_upper_bound"]]
+            except (OSError, ValueError, KeyError, TypeError) as exc:
+                log.warning("could not read forcedbins file %s (%s); "
+                            "ignoring", cfg.forcedbins_filename, exc)
         # feature_pre_filter threshold (reference: dataset_loader.cpp FindBin call)
         filter_cnt = int(cfg.min_data_in_leaf * sample_cnt / max(n, 1))
         self.bin_mappers = []
@@ -323,7 +337,8 @@ class BinnedDataset:
                 pre_filter=cfg.feature_pre_filter,
                 bin_type=BIN_CATEGORICAL if f in cat_set else BIN_NUMERICAL,
                 use_missing=cfg.use_missing,
-                zero_as_missing=cfg.zero_as_missing)
+                zero_as_missing=cfg.zero_as_missing,
+                forced_upper_bounds=forced_bounds.get(f))
             self.bin_mappers.append(bm)
         self.used_features = [f for f in range(self.num_total_features)
                               if not self.bin_mappers[f].is_trivial]

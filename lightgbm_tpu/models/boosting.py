@@ -170,8 +170,20 @@ class GBDT:
         self.bag_rng = jax.random.PRNGKey(cfg.bagging_seed)
         self.feat_rng = jax.random.PRNGKey(cfg.feature_fraction_seed)
         self.goss = cfg.data_sample_strategy == "goss"
+        # balanced (per-class) bagging engages whenever either class
+        # fraction is below 1 (reference: bagging.hpp:88)
+        self.balanced_bagging = (
+            cfg.bagging_freq > 0
+            and (cfg.pos_bagging_fraction < 1.0
+                 or cfg.neg_bagging_fraction < 1.0)
+            and train_data.metadata.label is not None)
         self.need_bagging = (not self.goss and cfg.bagging_freq > 0
-                             and cfg.bagging_fraction < 1.0)
+                             and (cfg.bagging_fraction < 1.0
+                                  or self.balanced_bagging))
+        if cfg.bagging_by_query:
+            log.warning("bagging_by_query is accepted for config "
+                        "compatibility but is not implemented by the "
+                        "reference this framework tracks; it is IGNORED")
         self._cached_bag = None
         binned_host = train_data.binned
         if binned_host is None or binned_host.shape[1] < self.learner.G:
@@ -520,9 +532,22 @@ class GBDT:
             return None, None
         if it % cfg.bagging_freq == 0 or self._cached_bag is None:
             self.bag_rng, sub = jax.random.split(self.bag_rng)
-            cnt = max(int(N * cfg.bagging_fraction), 1)
-            mask = jnp.zeros((N,), bool).at[
-                jax.random.permutation(sub, N)[:cnt]].set(True)
+            if self.balanced_bagging:
+                # per-class Bernoulli (reference: bagging.hpp
+                # BalancedBaggingHelper:180-200); the bag count estimate
+                # is the reference's bag_data_cnt_ (:100)
+                label = jnp.asarray(self.train_data.metadata.label)
+                pos = label > 0
+                npos = int(jnp.sum(pos))
+                u = jax.random.uniform(sub, (N,))
+                mask = jnp.where(pos, u < cfg.pos_bagging_fraction,
+                                 u < cfg.neg_bagging_fraction)
+                cnt = max(int(npos * cfg.pos_bagging_fraction) +
+                          int((N - npos) * cfg.neg_bagging_fraction), 1)
+            else:
+                cnt = max(int(N * cfg.bagging_fraction), 1)
+                mask = jnp.zeros((N,), bool).at[
+                    jax.random.permutation(sub, N)[:cnt]].set(True)
             self._cached_bag = (mask, cnt)
         return self._cached_bag
 

@@ -293,6 +293,17 @@ class SerialTreeLearner:
         self.frac_bynode = float(config.feature_fraction_bynode)
         self.has_bynode = 0.0 < self.frac_bynode < 1.0
 
+        # ---- extra_trees (reference: feature_histogram.hpp USE_RAND) ----
+        self.extra_trees = bool(config.extra_trees)
+        self.extra_seed = int(config.extra_seed)
+
+        # ---- feature_contri per-feature gain scaling ----
+        fc_all = parse_per_feature_penalty(
+            config.feature_contri or None, dataset.num_total_features)
+        self.feature_contri = None
+        if fc_all is not None and np.any(fc_all != 1.0):
+            self.feature_contri = jnp.asarray(fc_all[meta["feature"]])
+
         self.cat_params = None
         if self.has_categorical:
             self.cat_params = {
@@ -472,6 +483,8 @@ class SerialTreeLearner:
                                    and self._fast_search
                                    and self._plain_view
                                    and self.forced is None
+                                   and not self.extra_trees
+                                   and self.feature_contri is None
                                    and parallel_mode == "serial"
                                    and self.F > 0)
         if self._use_pallas_search:
@@ -503,9 +516,18 @@ class SerialTreeLearner:
         axes = (0, 0, 0, 0, 0, 0, 0, 0, 0, 0, None)
         if self.cegb_lazy is not None:
             axes = axes + (0,)
+        if self.extra_trees:
+            axes = axes + (0,)
         self._best_split_vmapped = jax.vmap(self._leaf_best_split,
                                             in_axes=axes)
         self._build = jax.jit(self._build_impl)
+
+    def _rand_bins(self, key):
+        """One random threshold per feature (reference:
+        meta_->rand.NextInt(0, num_bin - 2), feature_histogram.hpp:204)."""
+        u = jax.random.uniform(key, (self.F,))
+        span = jnp.maximum(self.ctx.num_bin - 2, 1).astype(jnp.float32)
+        return jnp.floor(u * span).astype(jnp.int32)
 
     # ------------------------------------------------------------------
     def _hist_leaf(self, part_bins, part_ghi, start, cnt):
@@ -846,7 +868,15 @@ class SerialTreeLearner:
 
     def _leaf_best_split(self, hist_group, sum_g, sum_h, cnt, local_cnt,
                          depth, cmin, cmax, parent_out, feature_mask,
-                         feat_used, lazy_cnt=None):
+                         feat_used, *rest):
+        # trailing optional operands in a fixed order (vmap needs flat
+        # positional args): cegb-lazy counts, then extra_trees rand bins
+        i = 0
+        lazy_cnt = None
+        if self.cegb_lazy is not None and len(rest) > i:
+            lazy_cnt = rest[i]
+            i += 1
+        rand_bins = rest[i] if (self.extra_trees and len(rest) > i) else None
         if self.F == 0:   # no usable features: every tree is a stub
             z = jnp.float32(0.0)
             zi = jnp.int32(0)
@@ -860,11 +890,13 @@ class SerialTreeLearner:
         if self.parallel_mode == "voting" and self.axis_name is not None:
             return self._leaf_best_split_voting(
                 hist_group, sum_g, sum_h, cnt, local_cnt, depth, cmin, cmax,
-                parent_out, feature_mask, feat_used, lazy_cnt=lazy_cnt)
+                parent_out, feature_mask, feat_used, lazy_cnt=lazy_cnt,
+                rand_bins=rand_bins)
         feat_hist = self._feat_view(hist_group, sum_g, sum_h)
         best = self._find_best(feat_hist, sum_g, sum_h, cnt, depth,
                                cmin, cmax, feature_mask, feat_used=feat_used,
-                               parent_out=parent_out, lazy_cnt=lazy_cnt)
+                               parent_out=parent_out, lazy_cnt=lazy_cnt,
+                               rand_bins=rand_bins)
         return self._depth_guard(best, depth)
 
     def _feat_view(self, hist_group, sum_g, sum_h):
@@ -882,7 +914,8 @@ class SerialTreeLearner:
 
     def _find_best(self, feat_hist, sum_g, sum_h, cnt, depth, cmin, cmax,
                    feature_mask, feat_used=None, parent_out=None,
-                   with_feature_gains=False, lazy_cnt=None):
+                   with_feature_gains=False, lazy_cnt=None,
+                   rand_bins=None):
         cegb_delta = None
         if self.cegb_coupled is not None and feat_used is not None:
             cegb_delta = jnp.where(feat_used, 0.0, self.cegb_coupled)
@@ -896,7 +929,9 @@ class SerialTreeLearner:
                 feat_hist, self.ctx, sum_g, sum_h, cnt,
                 self.l1, self.l2, self.max_delta_step,
                 self.min_gain_to_split, self.min_data_in_leaf,
-                self.min_sum_hessian, feature_mask)
+                self.min_sum_hessian, feature_mask,
+                rand_bins=rand_bins,
+                feature_contri=self.feature_contri)
         return split_ops.find_best_split(
             feat_hist, self.ctx, sum_g, sum_h, cnt,
             self.l1, self.l2, self.max_delta_step, self.min_gain_to_split,
@@ -909,7 +944,9 @@ class SerialTreeLearner:
             cegb_feature_delta=cegb_delta,
             path_smooth=self.path_smooth,
             parent_output=parent_out,
-            with_feature_gains=with_feature_gains)
+            with_feature_gains=with_feature_gains,
+            rand_bins=rand_bins,
+            feature_contri=self.feature_contri)
 
     def _depth_guard(self, best, depth):
         depth_ok = (self.max_depth <= 0) | (depth < self.max_depth)
@@ -970,6 +1007,14 @@ class SerialTreeLearner:
             # lazy counts are not re-derived on constraint refresh (the
             # cegb-lazy x intermediate-monotone interplay is not modeled)
             extra = (jnp.zeros((L, self.F), jnp.int32),)
+        if self.extra_trees:
+            # the constraint-refresh re-search draws fresh per-leaf random
+            # thresholds from a fixed stream (the reference redraws on
+            # every RecomputeBestSplitForLeaf call)
+            base = jax.random.PRNGKey(self.extra_seed ^ 0x9E37)
+            keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(
+                jnp.arange(L))
+            extra = extra + (jax.vmap(self._rand_bins)(keys),)
         # per-leaf effective masks: interaction-constraint/bynode masks are
         # stored per leaf; under feature-parallel the device-local feature
         # shards are UNIONed so every device recomputes the identical
@@ -1010,7 +1055,8 @@ class SerialTreeLearner:
 
     def _leaf_best_split_voting(self, hist_local, sum_g, sum_h, cnt,
                                 local_cnt, depth, cmin, cmax, parent_out,
-                                feature_mask, feat_used=None, lazy_cnt=None):
+                                feature_mask, feat_used=None, lazy_cnt=None,
+                                rand_bins=None):
         """PV-Tree voting split search (reference:
         voting_parallel_tree_learner.cpp): each device votes its top-k
         features by LOCAL gain, the global top-2k features are elected by
@@ -1049,7 +1095,7 @@ class SerialTreeLearner:
         best = self._find_best(feat_hist, sum_g, sum_h, cnt, depth,
                                cmin, cmax, feature_mask & elected_mask,
                                feat_used=feat_used, parent_out=parent_out,
-                               lazy_cnt=lazy_cnt)
+                               lazy_cnt=lazy_cnt, rand_bins=rand_bins)
         return self._depth_guard(best, depth)
 
     # ------------------------------------------------------------------
@@ -1133,6 +1179,12 @@ class SerialTreeLearner:
             lazy_extra = (self._lazy_counts(
                 aux0, jnp.int32(self.row0), jnp.int32(self.N),
                 jnp.int32(0))[0],)
+        rngx = None
+        if self.extra_trees:
+            rngx = jax.random.fold_in(
+                jax.random.PRNGKey(self.extra_seed), seed)
+            lazy_extra = lazy_extra + (
+                self._rand_bins(jax.random.fold_in(rngx, 0)),)
         best0 = self._sync_best(self._leaf_best_split(
             root_hist, sum_g, sum_h, bag_cnt_g, bag_cnt, jnp.int32(0),
             neg_inf, pos_inf, jnp.float32(0.0), root_mask, feat_used0,
@@ -1422,6 +1474,11 @@ class SerialTreeLearner:
                     upd["part_aux"] = aux_m
                     lazy_pair = (self._lazy_counts(
                         aux_m, start, left_cnt, cnt - left_cnt),)
+                if self.extra_trees:
+                    klx, krx = jax.random.split(
+                        jax.random.fold_in(rngx, s + 1))
+                    lazy_pair = lazy_pair + (jnp.stack(
+                        [self._rand_bins(klx), self._rand_bins(krx)]),)
 
                 if self.forced is not None:
                     forced_l = jnp.where(forced_ok,

@@ -99,6 +99,78 @@ def greedy_find_bin(distinct_values: Sequence[float], counts: Sequence[int],
     return bin_upper
 
 
+def find_bin_with_predefined_bin(distinct_values: Sequence[float],
+                                 counts: Sequence[int], max_bin: int,
+                                 total_sample_cnt: int, min_data_in_bin: int,
+                                 forced_upper_bounds: Sequence[float]
+                                 ) -> List[float]:
+    """Bin boundaries honoring forced upper bounds
+    (reference: bin.cpp FindBinWithPredefinedBin:157): the zero bounds and
+    the forced bounds are inserted first, then the remaining bin budget is
+    distributed across the resulting segments proportionally to their
+    sample counts and filled with the greedy search."""
+    n = len(distinct_values)
+    bin_upper: List[float] = []
+    left_cnt = n
+    for i in range(n):
+        if distinct_values[i] > -K_ZERO_THRESHOLD:
+            left_cnt = i
+            break
+    right_start = -1
+    for i in range(left_cnt, n):
+        if distinct_values[i] > K_ZERO_THRESHOLD:
+            right_start = i
+            break
+    if max_bin == 2:
+        bin_upper.append(K_ZERO_THRESHOLD if left_cnt == 0
+                         else -K_ZERO_THRESHOLD)
+    elif max_bin >= 3:
+        if left_cnt > 0:
+            bin_upper.append(-K_ZERO_THRESHOLD)
+        if right_start >= 0:
+            bin_upper.append(K_ZERO_THRESHOLD)
+    bin_upper.append(math.inf)
+
+    max_to_insert = max_bin - len(bin_upper)
+    inserted = 0
+    for b in forced_upper_bounds:
+        if inserted >= max_to_insert:
+            break
+        if abs(b) > K_ZERO_THRESHOLD:
+            bin_upper.append(float(b))
+            inserted += 1
+    bin_upper.sort()
+
+    free_bins = max_bin - len(bin_upper)
+    bounds_to_add: List[float] = []
+    value_ind = 0
+    nb = len(bin_upper)
+    for i in range(nb):
+        cnt_in_bin = 0
+        distinct_cnt = 0
+        bin_start = value_ind
+        while value_ind < n and distinct_values[value_ind] < bin_upper[i]:
+            cnt_in_bin += counts[value_ind]
+            distinct_cnt += 1
+            value_ind += 1
+        bins_remaining = max_bin - nb - len(bounds_to_add)
+        num_sub = int(round(cnt_in_bin * free_bins
+                            / max(total_sample_cnt, 1)))
+        num_sub = min(num_sub, bins_remaining) + 1
+        if i == nb - 1:
+            num_sub = bins_remaining + 1
+        if distinct_cnt > 0 and num_sub > 0:
+            seg = greedy_find_bin(
+                distinct_values[bin_start:bin_start + distinct_cnt],
+                counts[bin_start:bin_start + distinct_cnt],
+                num_sub, cnt_in_bin, min_data_in_bin)
+            bounds_to_add.extend(seg[:-1])      # last bound is infinity
+    bin_upper.extend(bounds_to_add)
+    bin_upper.sort()
+    assert len(bin_upper) <= max_bin
+    return bin_upper
+
+
 def find_bin_with_zero_as_one_bin(distinct_values: Sequence[float], counts: Sequence[int],
                                   max_bin: int, total_sample_cnt: int,
                                   min_data_in_bin: int) -> List[float]:
@@ -227,20 +299,25 @@ class BinMapper:
         num_distinct = len(distinct_values)
 
         if bin_type == BIN_NUMERICAL:
-            if forced_upper_bounds:
-                log.warning("forced bin bounds not yet supported; ignoring")
+            def bounds(mb, total):
+                # forced bounds route through the reference's
+                # FindBinWithPredefinedBin split (bin.cpp:302-308)
+                if forced_upper_bounds:
+                    return find_bin_with_predefined_bin(
+                        distinct_values, counts, mb, total,
+                        min_data_in_bin, forced_upper_bounds)
+                return find_bin_with_zero_as_one_bin(
+                    distinct_values, counts, mb, total, min_data_in_bin)
+
             if self.missing_type == MISSING_ZERO:
-                self.bin_upper_bound = find_bin_with_zero_as_one_bin(
-                    distinct_values, counts, max_bin, total_sample_cnt, min_data_in_bin)
+                self.bin_upper_bound = bounds(max_bin, total_sample_cnt)
                 if len(self.bin_upper_bound) == 2:
                     self.missing_type = MISSING_NONE
             elif self.missing_type == MISSING_NONE:
-                self.bin_upper_bound = find_bin_with_zero_as_one_bin(
-                    distinct_values, counts, max_bin, total_sample_cnt, min_data_in_bin)
+                self.bin_upper_bound = bounds(max_bin, total_sample_cnt)
             else:  # NaN: last bin reserved for NaN
-                self.bin_upper_bound = find_bin_with_zero_as_one_bin(
-                    distinct_values, counts, max_bin - 1, total_sample_cnt - na_cnt,
-                    min_data_in_bin)
+                self.bin_upper_bound = bounds(max_bin - 1,
+                                              total_sample_cnt - na_cnt)
                 self.bin_upper_bound.append(math.nan)
             self.num_bin = len(self.bin_upper_bound)
             cnt_in_bin = [0] * self.num_bin

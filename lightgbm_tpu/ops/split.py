@@ -265,7 +265,9 @@ def find_best_split_fast(feat_hist: jnp.ndarray, ctx: SplitContext,
                          l1: float, l2: float, max_delta_step: float,
                          min_gain_to_split: float, min_data_in_leaf: int,
                          min_sum_hessian: float,
-                         feature_mask: jnp.ndarray | None = None):
+                         feature_mask: jnp.ndarray | None = None,
+                         rand_bins: jnp.ndarray | None = None,
+                         feature_contri: jnp.ndarray | None = None):
     """Lean all-numerical best-split search.
 
     Bit-identical to ``find_best_split`` for plain configs (no
@@ -368,11 +370,26 @@ def find_best_split_fast(feat_hist: jnp.ndarray, ctx: SplitContext,
     if feature_mask is not None:
         valid_f &= feature_mask[:, None]
         valid_r &= feature_mask[:, None]
+    if rand_bins is not None:
+        # extra_trees: each feature evaluates ONE random threshold
+        # (feature_histogram.hpp USE_RAND arms, rand_threshold)
+        at_rand = bins == rand_bins[:, None]
+        valid_f &= at_rand
+        valid_r &= at_rand
 
     neg = jnp.float32(K_MIN_SCORE)
+    if feature_contri is not None:
+        # per-feature gain scaling (feature_histogram.hpp:174
+        # `output->gain *= meta_->penalty`): candidates compete on the
+        # SCALED relative gain, so the flat argmax runs on it directly
+        fc = feature_contri[:, None]
+        cand_f = jnp.where(valid_f, (gain_f - min_gain_shift) * fc, neg)
+        cand_r = jnp.where(valid_r, (gain_r - min_gain_shift) * fc, neg)
+    else:
+        cand_f = jnp.where(valid_f, gain_f, neg)
+        cand_r = jnp.where(valid_r, gain_r, neg)
     # candidate order encodes the tie-breaking (see docstring)
-    gains = jnp.concatenate([jnp.where(valid_r, gain_r, neg)[:, ::-1],
-                             jnp.where(valid_f, gain_f, neg)], axis=1)
+    gains = jnp.concatenate([cand_r[:, ::-1], cand_f], axis=1)
     # default_left: reverse scan => True, except single-scan NaN features
     dl_r = jnp.broadcast_to((two_scan | ~is_nan_miss).astype(jnp.float32),
                             (F, BF))
@@ -398,8 +415,10 @@ def find_best_split_fast(feat_hist: jnp.ndarray, ctx: SplitContext,
     rh = sum_h_tot - lh
     rc = num_data - lc_f32
     args = (l1, l2, max_delta_step)
+    gain_out = (best_gain if feature_contri is not None
+                else best_gain - min_gain_shift)
     return BestSplit(
-        gain=jnp.where(best_gain > neg, best_gain - min_gain_shift, neg),
+        gain=jnp.where(best_gain > neg, gain_out, neg),
         feature=best_f.astype(jnp.int32),
         threshold=best_t.astype(jnp.int32),
         default_left=dl > 0.5,
@@ -427,7 +446,9 @@ def find_best_split(feat_hist: jnp.ndarray, ctx: SplitContext,
                     cegb_count_coeff: float = 0.0,
                     cegb_feature_delta: jnp.ndarray | None = None,
                     path_smooth: float = 0.0, parent_output=None,
-                    with_feature_gains: bool = False):
+                    with_feature_gains: bool = False,
+                    rand_bins: jnp.ndarray | None = None,
+                    feature_contri: jnp.ndarray | None = None):
     """Find the best numerical split for one leaf.
 
     Args:
@@ -572,6 +593,12 @@ def find_best_split(feat_hist: jnp.ndarray, ctx: SplitContext,
     if feature_mask is not None:
         valid_f &= feature_mask[:, None]
         valid_r &= feature_mask[:, None]
+    if rand_bins is not None:
+        # extra_trees: each feature evaluates ONE random threshold
+        # (feature_histogram.hpp USE_RAND arms)
+        at_rand = bins == rand_bins[:, None]
+        valid_f &= at_rand
+        valid_r &= at_rand
 
     neg = jnp.float32(K_MIN_SCORE)
     gain_f = jnp.where(valid_f, gain_f, neg)
@@ -614,6 +641,15 @@ def find_best_split(feat_hist: jnp.ndarray, ctx: SplitContext,
         lh_c = jnp.zeros((F,))
         lc_c = jnp.zeros((F,), jnp.int32)
         l2_eff_c = jnp.full((F,), l2)
+
+    if feature_contri is not None:
+        # per-feature gain scaling (feature_histogram.hpp:174), applied
+        # BEFORE the CEGB delta like the reference (the penalty scales
+        # inside FindBestThreshold; CEGB subtracts at
+        # serial_tree_learner.cpp:982)
+        rel = feat_gain - min_gain_shift
+        feat_gain = jnp.where(feat_gain > neg,
+                              min_gain_shift + rel * feature_contri, neg)
 
     if cegb_count_coeff > 0.0 or cegb_feature_delta is not None:
         # CEGB: subtract the split cost from the (relative) gain

@@ -1,0 +1,101 @@
+"""Behavior tests for formerly accepted-but-ignored parameters:
+extra_trees, pos/neg_bagging_fraction, feature_contri,
+forcedbins_filename (reference: feature_histogram.hpp USE_RAND arms,
+bagging.hpp balanced bagging, feature_contri penalty, bin.cpp
+FindBinWithPredefinedBin)."""
+
+import json
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _data(rng, n=3000, f=6):
+    X = rng.normal(size=(n, f))
+    y = X[:, 0] * 2 + np.sin(X[:, 1] * 2) + 0.3 * rng.normal(size=n)
+    return X, y
+
+
+BASE = {"objective": "regression", "num_leaves": 31, "verbosity": -1,
+        "min_data_in_leaf": 20, "metric": ""}
+
+
+def test_extra_trees_changes_model_and_still_learns(rng):
+    X, y = _data(rng)
+    plain = lgb.train(BASE, lgb.Dataset(X, label=y), num_boost_round=20)
+    xt = lgb.train(dict(BASE, extra_trees=True, extra_seed=3),
+                   lgb.Dataset(X, label=y), num_boost_round=20)
+    p_plain = plain.predict(X)
+    p_xt = xt.predict(X)
+    assert not np.allclose(p_plain, p_xt)      # random thresholds differ
+    mse0 = float(np.mean((y - np.mean(y)) ** 2))
+    assert float(np.mean((y - p_xt) ** 2)) < 0.5 * mse0   # still learns
+    # different seed -> different trees
+    xt2 = lgb.train(dict(BASE, extra_trees=True, extra_seed=77),
+                    lgb.Dataset(X, label=y), num_boost_round=20)
+    assert not np.allclose(p_xt, xt2.predict(X))
+
+
+def test_balanced_bagging(rng):
+    X, _ = _data(rng, n=4000)
+    y = (rng.rand(4000) < 0.15).astype(float)     # unbalanced classes
+    params = dict(BASE, objective="binary", bagging_freq=1,
+                  pos_bagging_fraction=1.0, neg_bagging_fraction=0.3)
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
+    g = bst._gbdt
+    assert g.balanced_bagging and g.need_bagging
+    mask, cnt = g._cached_bag
+    mask = np.asarray(mask)
+    pos = y > 0
+    assert mask[pos].all()                        # every positive in bag
+    neg_frac = mask[~pos].mean()
+    assert 0.2 < neg_frac < 0.4                   # ~30% of negatives
+    exp = int(pos.sum()) + int((~pos).sum() * 0.3)
+    assert abs(cnt - exp) <= 1
+
+
+def test_feature_contri_downweights_feature(rng):
+    X, y = _data(rng)
+    # crush feature 0's gain; the model must lean on other features
+    fc = "0.001,1.0,1.0,1.0,1.0,1.0"
+    bst = lgb.train(dict(BASE, feature_contri=fc),
+                    lgb.Dataset(X, label=y), num_boost_round=10)
+    plain = lgb.train(BASE, lgb.Dataset(X, label=y), num_boost_round=10)
+    imp = bst.feature_importance(importance_type="split")
+    imp_plain = plain.feature_importance(importance_type="split")
+    assert imp_plain[0] > 0                       # feature 0 used normally
+    assert imp[0] < imp_plain[0]                  # and demoted under contri
+
+
+def test_forcedbins_bounds_respected(rng, tmp_path):
+    X, y = _data(rng, n=2000)
+    forced = [-0.5, 0.75]
+    path = tmp_path / "forced.json"
+    path.write_text(json.dumps(
+        [{"feature": 0, "bin_upper_bound": forced}]))
+    ds = lgb.Dataset(X, label=y)
+    ds.construct(dict(BASE, forcedbins_filename=str(path)))
+    bm = ds._inner.bin_mappers[0]
+    ub = np.asarray(bm.bin_upper_bound)
+    for b in forced:
+        assert np.any(np.isclose(ub, b)), (b, ub[:10])
+    # other features keep the default binning
+    bm1 = ds._inner.bin_mappers[1]
+    assert not np.any(np.isclose(np.asarray(bm1.bin_upper_bound), -0.5,
+                                 atol=1e-9))
+
+
+def test_bagging_by_query_warns(rng):
+    X, y = _data(rng, n=500)
+    from lightgbm_tpu.utils import log as _log
+    msgs = []
+    _log.register_callback(msgs.append)
+    try:
+        lgb.train(dict(BASE, verbosity=0, bagging_by_query=True,
+                       bagging_freq=1, bagging_fraction=0.5),
+                  lgb.Dataset(X, label=y), num_boost_round=2)
+    finally:
+        _log.register_callback(None)
+    assert any("bagging_by_query" in m for m in msgs)
