@@ -29,6 +29,24 @@ from .tree import Tree, tree_from_device_record
 K_EPSILON = 1e-15
 
 
+@functools.partial(jax.jit, static_argnames=("l1", "l2", "mds"))
+def _quant_renew_device(idx, grad, hess, starts, cnts, old_values,
+                        l1, l2, mds):
+    """Per-leaf true-gradient sums via prefix-sum differencing over the
+    partitioned row order (pad rows sit outside every leaf range, so
+    their clipped-gather values never enter a difference)."""
+    from ..ops.split import leaf_output
+    nmax = grad.shape[0] - 1
+    gp = jnp.take(grad, jnp.minimum(idx, nmax))
+    hp = jnp.take(hess, jnp.minimum(idx, nmax))
+    cg = jnp.concatenate([jnp.zeros((1,), jnp.float32), jnp.cumsum(gp)])
+    ch = jnp.concatenate([jnp.zeros((1,), jnp.float32), jnp.cumsum(hp)])
+    sum_g = jnp.take(cg, starts + cnts) - jnp.take(cg, starts)
+    sum_h = jnp.take(ch, starts + cnts) - jnp.take(ch, starts)
+    new = leaf_output(sum_g, sum_h + 2e-15, l1, l2, mds)
+    return jnp.where(cnts > 0, new, old_values)
+
+
 @functools.partial(jax.jit, static_argnums=(1,))
 def _scores_from_phys(ghi, num_data):
     """Scatter the physically-ordered score row back to original row
@@ -206,7 +224,7 @@ class GBDT:
         if (self.sharded_builder is None and self.objective is not None
                 and getattr(self.objective, "is_jit_safe", True)
                 and K == 1
-                and not cfg.linear_tree and not self.use_quant
+                and not cfg.linear_tree
                 and not self.goss and not self.need_bagging
                 and not cfg.cegb_penalty_feature_lazy
                 and not self.objective.is_renew_tree_output):
@@ -234,6 +252,10 @@ class GBDT:
             if 4 + len(names) <= lr_._ghi_rows:
                 self._setup_fused_phys(names)
                 return
+        if self.use_quant:
+            # quantized training fuses only through the physical path
+            # (the discretizer and renewal are folded into that program)
+            return
 
         def step(part_bins, scores, feature_mask, seed, feat_used):
             grad, hess = obj.get_gradients(scores)
@@ -275,7 +297,13 @@ class GBDT:
         N = self.num_data
         Npad = lr_.N_pad
         C = lr_.row0
-        lr_._ghi_live = 4 + len(names)
+        # quantized renewal needs the TRUE gradients in POST-partition
+        # order: they ride two extra payload rows through the partition
+        q_renew_rows = 2 if (self.use_quant
+                             and self.config.quant_train_renew_leaf) else 0
+        tg_row = 4 + len(names)
+        th_row = tg_row + 1
+        lr_._ghi_live = 4 + len(names) + q_renew_rows
         payload_arrs = [jnp.asarray(getattr(obj, n), jnp.float32)
                         for n in names]
 
@@ -296,14 +324,74 @@ class GBDT:
 
         self._init_phys = jax.jit(init_phys)
 
+        use_quant = self.use_quant
+        cfg = self.config
+        q_bins = float(cfg.num_grad_quant_bins)
+        q_stoch = bool(cfg.stochastic_rounding)
+        q_renew = bool(cfg.quant_train_renew_leaf)
+        q_const_h = bool(obj.is_constant_hessian)
+        q_key = jax.random.PRNGKey(cfg.seed if cfg.seed is not None
+                                   else 12345)
+        l1_, l2_, mds_ = (float(cfg.lambda_l1), float(cfg.lambda_l2),
+                          float(cfg.max_delta_step))
+
         def step(part_bins, ghi, feature_mask, seed, feat_used):
             rowid = jax.lax.bitcast_convert_type(ghi[2], jnp.int32)
             vf = (rowid != N).astype(jnp.float32)   # pad rows: grad/hess 0
             payload = {n: ghi[4 + i] for i, n in enumerate(names)}
             g, h = obj.gradients_from_payload(ghi[3], **payload)
-            ghi = ghi.at[0].set(g * vf).at[1].set(h * vf)
+            g = g * vf
+            h = h * vf
+            hist_scale = None
+            if use_quant:
+                # in-program discretizer (reference:
+                # GradientDiscretizer::DiscretizeGradients); integer
+                # carriers ride the payload, the scale goes to the
+                # histogram (bf16 int-exact accumulation)
+                gs = jnp.maximum(jnp.max(jnp.abs(g)) / (q_bins / 2.0),
+                                 1e-30)
+                max_h = jnp.max(jnp.abs(h))
+                hs = jnp.maximum(max_h if q_const_h else max_h / q_bins,
+                                 1e-30)
+                if q_stoch:
+                    kg, kh = jax.random.split(
+                        jax.random.fold_in(q_key, seed))
+                    rg = jax.random.uniform(kg, g.shape)
+                    rh = jax.random.uniform(kh, h.shape)
+                else:
+                    rg = rh = 0.5
+                ig = jnp.trunc(g / gs + jnp.where(g >= 0, rg, -rg))
+                ih = (jnp.ones_like(h) if q_const_h
+                      else jnp.trunc(h / hs + rh))
+                g_q = ig * vf
+                h_q = ih * vf
+                hist_scale = jnp.stack([gs, hs])
+            else:
+                g_q, h_q = g, h
+            ghi = ghi.at[0].set(g_q).at[1].set(h_q)
+            if use_quant and q_renew:
+                # true grads ride the partition so the renewal reads
+                # them in the record's row order
+                ghi = ghi.at[tg_row].set(g).at[th_row].set(h)
             rec = lr_._build_tree_impl(part_bins, ghi, jnp.int32(N),
-                                       feature_mask, seed, feat_used)
+                                       feature_mask, seed, feat_used,
+                                       None, hist_scale)
+            if use_quant and q_renew:
+                # leaf renewal from the TRUE gradients in POST-partition
+                # order: per-leaf sums are prefix differences at the
+                # range boundaries (reference: RenewIntGradTreeOutput)
+                from ..ops.split import leaf_output as _leaf_out
+                cg = jnp.concatenate([jnp.zeros((1,), jnp.float32),
+                                      jnp.cumsum(rec["part_ghi"][tg_row])])
+                ch = jnp.concatenate([jnp.zeros((1,), jnp.float32),
+                                      jnp.cumsum(rec["part_ghi"][th_row])])
+                ls = rec["leaf_start"]
+                lc = rec["leaf_cnt"]
+                sum_g = jnp.take(cg, ls + lc) - jnp.take(cg, ls)
+                sum_h = jnp.take(ch, ls + lc) - jnp.take(ch, ls)
+                renewed = _leaf_out(sum_g, sum_h + 2e-15, l1_, l2_, mds_)
+                rec["leaf_value"] = jnp.where(lc > 0, renewed,
+                                              rec["leaf_value"])
             # per-row score delta from the physical leaf ranges (see the
             # boundary-difference comment in the original-order step).
             # The flat prefix sum runs as a 2-D lane cumsum + small
@@ -632,7 +720,12 @@ class GBDT:
             rg = rh = 0.5
         ig = jnp.trunc(grad / gs + jnp.where(grad >= 0, rg, -rg))
         ih = jnp.ones_like(hess) if const_h else jnp.trunc(hess / hs + rh)
-        return ig * gs, ih * hs
+        # INTEGER carriers + a separate (2,) scale: the histogram then
+        # accumulates exact small integers, which the learner computes
+        # with bfloat16 one-hot matmuls at double MXU rate — the TPU
+        # analog of the reference's int16 histogram fast path
+        # (feature_histogram.hpp:293-374) — and scales once per leaf
+        return ig, ih, jnp.stack([gs, hs])
 
     def _leaf_rows(self, record, num_nodes: int):
         """Per-leaf train row lookup via device traversal of the built tree.
@@ -656,9 +749,21 @@ class GBDT:
     def _renew_quant_leaf_outputs(self, record, num_nodes: int, grad, hess):
         """Recompute leaf outputs from the TRUE (un-quantized) gradient sums
         (reference: GradientDiscretizer::RenewIntGradTreeOutput,
-        gradient_discretizer.cpp:209)."""
+        gradient_discretizer.cpp:209).
+
+        Serial records carry the physical leaf ranges, so the renewal is
+        one device program: permute the true gradients into partition
+        order and difference their prefix sums at the range boundaries.
+        Sharded records (no partition arrays off the mesh) fall back to a
+        traversal-based host loop."""
         from ..ops.split import leaf_output
         cfg = self.config
+        if "indices" in record:
+            return _quant_renew_device(
+                record["indices"], jnp.asarray(grad), jnp.asarray(hess),
+                record["leaf_start"], record["leaf_cnt"],
+                record["leaf_value"],
+                cfg.lambda_l1, cfg.lambda_l2, cfg.max_delta_step)
         num_leaves = num_nodes + 1
         leaf_rows = self._leaf_rows(record, num_nodes)
         g = np.asarray(grad)
@@ -810,10 +915,16 @@ class GBDT:
             gk = grad[:, k] if K > 1 else grad
             hk = hess[:, k] if K > 1 else hess
             gk_true, hk_true = gk, hk
+            qscale = None
             if self.use_quant:
-                gk, hk = self._discretize_gradients(
+                gk, hk, qscale = self._discretize_gradients(
                     gk, hk,
                     row_sampling=self.goss or (bag_mask is not None))
+                if use_sharded:
+                    # the sharded builders take pre-scaled carriers
+                    gk = gk * qscale[0]
+                    hk = hk * qscale[1]
+                    qscale = None
             tree_seed = self.iter * K + k + 1
             with global_timer.section("TreeLearner::Train",
                                       sync=lambda: record["leaf_value"]):
@@ -826,7 +937,8 @@ class GBDT:
                     record = self.learner.build_tree(
                         gk, hk, bag_cnt, feature_mask, seed=tree_seed,
                         feat_used=self._cegb_feat_used,
-                        lazy_aux=self._cegb_lazy_aux)
+                        lazy_aux=self._cegb_lazy_aux,
+                        hist_scale=qscale)
             if self.learner.has_cegb:
                 # coupled penalties persist for the model lifetime
                 self._cegb_feat_used = record["feat_used"]

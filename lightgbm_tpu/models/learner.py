@@ -530,15 +530,24 @@ class SerialTreeLearner:
         return jnp.floor(u * span).astype(jnp.int32)
 
     # ------------------------------------------------------------------
-    def _hist_leaf(self, part_bins, part_ghi, start, cnt):
-        if self._use_pallas:
+    def _hist_leaf(self, part_bins, part_ghi, start, cnt, scale=None):
+        if self._use_pallas and scale is None:
             return leaf_hist_pallas(part_bins, part_ghi[0], part_ghi[1],
                                     start, cnt, num_bins=self.B,
                                     row_chunk=self.row_chunk,
                                     num_groups=self.G)
-        return leaf_hist_slice(part_bins, part_ghi, start, cnt,
-                               num_bins=self.B, row_chunk=self.row_chunk,
-                               vary=self._pvary, num_groups=self.G)
+        # quantized training rides INTEGER gradient carriers: the one-hot
+        # matmuls run in bfloat16 (exact for the small int grid, double
+        # MXU rate — the int16-histogram analog) and the scale applies
+        # once per histogram
+        h = leaf_hist_slice(part_bins, part_ghi, start, cnt,
+                            num_bins=self.B, row_chunk=self.row_chunk,
+                            vary=self._pvary, num_groups=self.G,
+                            dtype=(jnp.bfloat16 if scale is not None
+                                   else jnp.float32))
+        if scale is not None:
+            h = h * scale[None, None, :]
+        return h
 
     def _goes_left(self, colv, scalars):
         """Per-row decision from raw group-column values.
@@ -1140,7 +1149,8 @@ class SerialTreeLearner:
         return jax.tree.map(lambda a: a[winner], gathered)
 
     def _build_tree_impl(self, part_bins, part_ghi0, bag_cnt,
-                         feature_mask, seed, feat_used_init=None, aux0=None):
+                         feature_mask, seed, feat_used_init=None, aux0=None,
+                         hist_scale=None):
         """Core tree loop over a prebuilt (8, N_pad) row payload whose
         rows are (grad, hess, rowid-bits, extras...); the extras ride the
         partition untouched (physical-order fused step)."""
@@ -1162,7 +1172,8 @@ class SerialTreeLearner:
                       else feat_used_init)
 
         root_hist = self._psum(self._hist_leaf(
-            part_bins, part_ghi0, jnp.int32(self.row0), jnp.int32(self.N)))
+            part_bins, part_ghi0, jnp.int32(self.row0), jnp.int32(self.N),
+            scale=hist_scale))
         bag_cnt_g = self._psum_scalar(bag_cnt)
         # in voting mode root_hist stays LOCAL; the leaf totals are global
         sum_g = self._psum_scalar(root_hist[0, :, 0].sum()) \
@@ -1374,7 +1385,7 @@ class SerialTreeLearner:
                 sm_cnt = jnp.where(small_is_left, left_cnt, right_cnt)
                 hist_small = self._psum(self._hist_leaf(
                     moved["part_bins"], moved["part_ghi"],
-                    sm_start, sm_cnt))
+                    sm_start, sm_cnt, scale=hist_scale))
                 parent_hist = st["hist"][best_leaf]
                 hist_large = parent_hist - hist_small
                 hist_left = jnp.where(small_is_left, hist_small, hist_large)
@@ -1697,7 +1708,8 @@ class SerialTreeLearner:
 
     # ------------------------------------------------------------------
     def _build_impl(self, part_bins0, grad, hess, bag_cnt, feature_mask,
-                    seed=jnp.int32(0), feat_used_init=None, aux0=None):
+                    seed=jnp.int32(0), feat_used_init=None, aux0=None,
+                    hist_scale=None):
         """Front/tail-pad the per-row arrays and run the tree loop.
 
         ``grad``/``hess`` are (N,) in ORIGINAL row order with out-of-bag rows
@@ -1712,16 +1724,17 @@ class SerialTreeLearner:
         hess_p = jnp.pad(hess, (C, tail))
         iota = jax.lax.iota(jnp.int32, self.N_pad)
         rowid = jnp.where((iota >= C) & (iota < C + self.N), iota - C, self.N)
-        part_ghi0 = jnp.concatenate([
-            jnp.stack([grad_p, hess_p,
-                       jax.lax.bitcast_convert_type(rowid, jnp.float32)]),
-            jnp.zeros((self._ghi_rows - 3, self.N_pad), jnp.float32)],
-            axis=0)
+        # row writes, NOT jnp.stack+concat: the stack-of-padded-rows
+        # fusion MISCOMPILES on the tunnel's XLA at N_pad ~> 32k, zeroing
+        # the bitcast rowid row (verified minimal repro, round 3)
+        part_ghi0 = jnp.zeros((self._ghi_rows, self.N_pad), jnp.float32) \
+            .at[0].set(grad_p).at[1].set(hess_p) \
+            .at[2].set(jax.lax.bitcast_convert_type(rowid, jnp.float32))
         if aux0 is not None:
             aux0 = jnp.pad(aux0, ((0, 0), (C, tail)))
         return self._build_tree_impl(part_bins0, part_ghi0,
                                      bag_cnt, feature_mask, seed,
-                                     feat_used_init, aux0)
+                                     feat_used_init, aux0, hist_scale)
 
     def lazy_aux_to_original_order(self, rec) -> jnp.ndarray:
         """Scatter the partitioned used-feature bitset back to original row
@@ -1732,7 +1745,8 @@ class SerialTreeLearner:
 
     def build_tree(self, grad, hess, bag_cnt=None,
                    feature_mask=None, seed: int = 0,
-                   feat_used=None, lazy_aux=None) -> Dict[str, Any]:
+                   feat_used=None, lazy_aux=None,
+                   hist_scale=None) -> Dict[str, Any]:
         """Train one tree; returns the device state record."""
         if feature_mask is None:
             feature_mask = jnp.ones((self.F,), dtype=bool)
@@ -1746,7 +1760,7 @@ class SerialTreeLearner:
             lazy_aux = jnp.zeros((self.aux_rows, self.N), jnp.int32)
         return self._build(self._part0, grad, hess, jnp.int32(bag_cnt),
                            feature_mask, jnp.int32(seed), feat_used,
-                           lazy_aux)
+                           lazy_aux, hist_scale)
 
     def node_arrays_for_predict(self, st: Dict[str, Any]) -> Dict[str, Any]:
         node = {
