@@ -233,14 +233,27 @@ class SerialTreeLearner:
         # the reference's recursive constraint propagation + per-leaf split
         # recomputation (IntermediateLeafConstraints::Update /
         # GoUpToFindLeavesToUpdate, monotone_constraints.hpp:516-740).
-        # `advanced` (per-threshold constraint segments) falls back to the
-        # same region-exact mode, which is already a sound tightening.
         self.mc_mode = "basic"
         if self.use_mc and config.monotone_constraints_method in (
                 "intermediate", "advanced"):
             self.mc_mode = "intermediate"
             self.mono_enums = [int(i) for i in np.where(mono_used != 0)[0]]
             self.mono_signs = [int(mono_used[i]) for i in self.mono_enums]
+            if config.monotone_constraints_method == "advanced":
+                # the reference's advanced mode keeps PER-THRESHOLD
+                # constraint segments (AdvancedLeafConstraints,
+                # monotone_constraints.hpp:858) so different thresholds
+                # of one candidate feature see different bounds; the
+                # region-exact refresh here applies one [min,max] box per
+                # leaf — a sound but coarser constraint.  Say so loudly
+                # instead of silently aliasing.
+                log.warning(
+                    "monotone_constraints_method=advanced: this framework "
+                    "runs the region-exact intermediate mode (one output "
+                    "bound pair per leaf) instead of the reference's "
+                    "per-threshold constraint segments; constraints are "
+                    "enforced soundly but some splits the advanced mode "
+                    "would allow may be rejected")
         if self.F:
             self._fmeta_np[7] = mono_used
         self._fmeta = jnp.asarray(self._fmeta_np)

@@ -100,3 +100,31 @@ def test_monotone_penalty_discourages_splits():
     imp = bst.feature_importance(importance_type="split")
     assert imp[2] >= imp[0]
     assert imp[2] >= imp[1]
+
+
+def test_advanced_mode_warns_and_enforces(rng):
+    """`advanced` runs the region-exact refresh with a loud downgrade
+    warning (reference: AdvancedLeafConstraints per-threshold segments,
+    monotone_constraints.hpp:858)."""
+    from lightgbm_tpu.utils import log as _log
+    import lightgbm_tpu as lgb
+    n = 2000
+    X = rng.normal(size=(n, 4))
+    y = 2 * X[:, 0] + np.sin(X[:, 1]) + 0.1 * rng.normal(size=n)
+    msgs = []
+    _log.register_callback(msgs.append)
+    try:
+        bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                         "verbosity": 0, "monotone_constraints": "1,0,0,0",
+                         "monotone_constraints_method": "advanced",
+                         "metric": ""},
+                        lgb.Dataset(X, label=y), num_boost_round=10)
+    finally:
+        _log.register_callback(None)
+    assert any("advanced" in m for m in msgs)
+    # monotonicity holds along feature 0
+    base = np.zeros((50, 4))
+    base[:, 1:] = rng.normal(size=(1, 3))
+    base[:, 0] = np.linspace(-2, 2, 50)
+    p = bst.predict(base)
+    assert np.all(np.diff(p) >= -1e-6)
