@@ -369,11 +369,14 @@ class BinnedDataset:
         sparse, dense = [], []
         for f in self.used_features:
             bm = self.bin_mappers[f]
-            # Only bundle features whose shared "all-default" bin is bin 0:
-            # the learner's bundled-bin decode (bin b -> offset+b-1, b>=1)
-            # and FixHistogram reconstruction assume it.
-            if (bm.sparse_rate >= 0.8 and bm.most_freq_bin == 0
-                    and bm.default_bin == 0):
+            # Any feature whose shared "all-default" bin is bin 0 may
+            # bundle (the learner's bundled-bin decode — bin b ->
+            # offset+b-1, b>=1 — and FixHistogram reconstruction assume
+            # it).  The conflict graph decides who actually shares a
+            # group, like the reference's FindGroups over ALL features
+            # (dataset.cpp:60-244): dense features conflict with
+            # everything and come out as singletons on their own.
+            if bm.most_freq_bin == 0 and bm.default_bin == 0:
                 sparse.append(f)
             else:
                 dense.append(f)
@@ -451,25 +454,45 @@ class BinnedDataset:
         n = len(next(iter(cols.values()))) if cols else 0
         max_conflict = int(0.0 * n)  # reference default max_conflict_rate = 0.0
         # sample rows for conflict counting to bound cost
-        sample = np.random.RandomState(self.config.data_random_seed).choice(
+        rng = np.random.RandomState(self.config.data_random_seed)
+        sample = rng.choice(
             n, size=min(n, 50000), replace=False) if n > 50000 else np.arange(n)
         nz_masks = {f: (cols[f][sample] != self.bin_mappers[f].most_freq_bin)
                     for f in sparse}
         bundles: List[List[int]] = []
         bundle_masks: List[np.ndarray] = []
+        bundle_bins: List[int] = []
         order = sorted(sparse, key=lambda f: -int(nz_masks[f].sum()))
+        # reference FindGroups' random-search fallback (dataset.cpp:92):
+        # with many groups, each feature probes a random subset instead
+        # of every group, bounding the O(F x groups) conflict scan
+        max_search = 100
+        # a bundle stays within one u8 bin column: groups beyond 256
+        # total bins would force the whole matrix to u16 and off the
+        # Pallas partition kernel
+        max_group_bins = 256
         for f in order:
+            nb_add = self.bin_mappers[f].num_bin - 1
             placed = False
-            for bi, mask in enumerate(bundle_masks):
-                conflict = int((mask & nz_masks[f]).sum())
+            if len(bundles) <= max_search:
+                probe = range(len(bundles))
+            else:
+                probe = rng.choice(len(bundles), size=max_search,
+                                   replace=False)
+            for bi in probe:
+                if bundle_bins[bi] + nb_add > max_group_bins:
+                    continue
+                conflict = int((bundle_masks[bi] & nz_masks[f]).sum())
                 if conflict <= max_conflict:
                     bundles[bi].append(f)
-                    bundle_masks[bi] = mask | nz_masks[f]
+                    bundle_masks[bi] |= nz_masks[f]
+                    bundle_bins[bi] += nb_add
                     placed = True
                     break
             if not placed:
                 bundles.append([f])
                 bundle_masks.append(nz_masks[f].copy())
+                bundle_bins.append(1 + nb_add)
         for bundle in bundles:
             bundle.sort()
             if len(bundle) == 1:

@@ -82,3 +82,36 @@ def test_trivial_feature():
     bm = BinMapper()
     bm.find_bin(np.zeros(0), total_sample_cnt=100, max_bin=255)
     assert bm.is_trivial
+
+
+def test_efb_bundles_one_hot_blocks(rng):
+    """Full EFB: mutually exclusive one-hot columns (sparse_rate ~0.75,
+    below the old 0.8-only policy) bundle into few groups while dense
+    columns stay singletons, and predictions match an unbundled model
+    (reference: Dataset::FindGroups over ALL features, dataset.cpp:60)."""
+    import lightgbm_tpu as lgb
+    n = 4000
+    codes = rng.randint(0, 4, size=n)
+    onehot = np.eye(4)[codes]                      # 4 exclusive columns
+    dense = rng.normal(size=(n, 3))
+    X = np.column_stack([onehot, dense])
+    y = codes * 1.0 + dense[:, 0] + 0.1 * rng.normal(size=n)
+
+    params = {"objective": "regression", "num_leaves": 15, "verbosity": -1,
+              "min_data_in_leaf": 20, "metric": ""}
+    ds = lgb.Dataset(X, label=y)
+    ds.construct(params)
+    inner = ds._inner
+    # 4 exclusive one-hots -> 1 shared group; 3 dense singletons
+    assert inner.num_groups <= 1 + 3, [g.feature_indices
+                                       for g in inner.groups]
+    assert any(len(g.feature_indices) >= 4 for g in inner.groups)
+
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=15)
+    # unbundled oracle: disable bundling via enable_bundle=false
+    bst0 = lgb.train(dict(params, enable_bundle=False),
+                     lgb.Dataset(X, label=y), num_boost_round=15)
+    p, p0 = bst.predict(X), bst0.predict(X)
+    mse = float(np.mean((y - p) ** 2))
+    mse0 = float(np.mean((y - p0) ** 2))
+    assert mse < mse0 * 1.2 + 1e-6      # bundling does not hurt quality
