@@ -1169,6 +1169,120 @@ class GBDT:
             out[:, k] = col
         return out
 
+    def _predict_raw_device_loaded(self, data: np.ndarray,
+                                   start_iteration: int, end_iter: int,
+                                   leaves_only: bool = False):
+        """Device batch prediction for LOADED models (real thresholds, no
+        bin mappers): raw values convert to per-feature threshold-index
+        space with exact float64 searchsorted on the host, and the trees
+        traverse on device in integer space (ops/predict.py
+        predict_leaf_thridx) — the device analog of the reference's
+        OpenMP batch predictor (predictor.hpp:30) for model_file
+        boosters.  Returns None for categorical/linear trees."""
+        from ..ops.predict import predict_leaf_thridx
+        from .tree import K_CATEGORICAL_MASK
+        K = self.num_tree_per_iteration
+        if np.asarray(data).shape[0] < 4096 or end_iter <= start_iteration:
+            return None
+        trees = self.models[start_iteration * K:end_iter * K]
+        if any(t.is_linear or
+               (len(t.decision_type) and
+                (np.asarray(t.decision_type) & K_CATEGORICAL_MASK).any())
+               for t in trees):
+            return None
+        cache = getattr(self, "_loaded_cache", None)
+        ckey = (start_iteration, end_iter, len(self.models),
+                self._model_version)
+        if cache is None or cache[0] != ckey:
+            feat_thr: Dict[int, set] = {}
+            for t in trees:
+                for f, thr in zip(np.asarray(t.split_feature),
+                                  np.asarray(t.threshold)):
+                    feat_thr.setdefault(int(f), set()).add(float(thr))
+            feats = sorted(feat_thr)
+            enum = {f: i for i, f in enumerate(feats)}
+            thr_list = [np.asarray(sorted(feat_thr[f]), np.float64)
+                        for f in feats]
+            b0 = np.asarray([int(np.searchsorted(tl, 0.0, side="left"))
+                             for tl in thr_list], np.int32)
+            nmax = max(max((len(t.split_feature) for t in trees),
+                           default=1), 1)
+            per_k = []
+            for k in range(K):
+                ts = trees[k::K]
+                T = len(ts)
+                arrs = {name: np.zeros((T, nmax), np.int32)
+                        for name in ("col", "kidx", "default_left",
+                                     "mtype", "left", "right")}
+                arrs["left"][:] = -1
+                arrs["right"][:] = -1
+                nn = np.zeros((T,), np.int32)
+                lv = np.zeros((T, nmax + 1), np.float32)
+                for ti, t in enumerate(ts):
+                    m = len(t.split_feature)
+                    nn[ti] = m
+                    lv[ti, :len(t.leaf_value)] = t.leaf_value
+                    if m == 0:
+                        if len(t.leaf_value):
+                            lv[ti, 0] = t.leaf_value[0]
+                        continue
+                    dt = np.asarray(t.decision_type).astype(np.int32)
+                    arrs["col"][ti, :m] = [enum[int(f)]
+                                           for f in t.split_feature]
+                    arrs["kidx"][ti, :m] = [
+                        int(np.searchsorted(thr_list[enum[int(f)]],
+                                            float(v), side="left"))
+                        for f, v in zip(t.split_feature, t.threshold)]
+                    arrs["default_left"][ti, :m] = (dt >> 1) & 1
+                    arrs["mtype"][ti, :m] = (dt >> 2) & 3
+                    arrs["left"][ti, :m] = t.left_child
+                    arrs["right"][ti, :m] = t.right_child
+                node = {n: jnp.asarray(a) for n, a in arrs.items()}
+                node["num_nodes"] = jnp.asarray(nn)
+                node["b0"] = jnp.broadcast_to(jnp.asarray(b0),
+                                              (T, len(feats)))
+                per_k.append((node, jnp.asarray(lv)))
+            self._loaded_cache = (ckey, feats, thr_list, per_k)
+            cache = self._loaded_cache
+        _, feats, thr_list, per_k = cache
+        data = np.asarray(data, dtype=np.float64)
+        packed = np.zeros((max(len(feats), 1), data.shape[0]), np.int32)
+        for i, f in enumerate(feats):
+            v = data[:, f]
+            nan = np.isnan(v)
+            fv = np.where(nan, 0.0, v)
+            b = np.searchsorted(thr_list[i], v, side="left")
+            packed[i] = (b.astype(np.int64) * 4 + nan * 2 +
+                         (np.abs(fv) <= 1e-35)).astype(np.int32)
+        packed_dev = jnp.asarray(packed)
+        if not hasattr(self, "_stacked_thridx"):
+            def stacked(node, lv, pv):
+                leaves = jax.vmap(
+                    lambda nd: predict_leaf_thridx(pv, nd)
+                )({k: v for k, v in node.items()})
+                return jnp.sum(jax.vmap(jnp.take)(lv, leaves), axis=0)
+            self._stacked_thridx = jax.jit(stacked)
+
+            def stacked_leaves(node, pv):
+                return jax.vmap(
+                    lambda nd: predict_leaf_thridx(pv, nd)
+                )({k: v for k, v in node.items()})
+            self._stacked_thridx_leaves = jax.jit(stacked_leaves)
+        if leaves_only:
+            T = len(trees)
+            out = np.zeros((data.shape[0], T), dtype=np.int32)
+            for k in range(K):
+                node, _ = per_k[k]
+                out[:, k::K] = np.asarray(
+                    self._stacked_thridx_leaves(node, packed_dev)).T
+            return out
+        out = np.zeros((data.shape[0], K), dtype=np.float64)
+        for k in range(K):
+            node, lv = per_k[k]
+            out[:, k] = np.asarray(
+                self._stacked_thridx(node, lv, packed_dev))
+        return out
+
     def predict_raw(self, data: np.ndarray, start_iteration: int = 0,
                     num_iteration: int = -1,
                     pred_early_stop: bool = False,
@@ -1199,6 +1313,9 @@ class GBDT:
                                   "cross_entropy_lambda"))))
         if not use_es:
             dev = self._predict_raw_device(data, start_iteration, end_iter)
+            if dev is None:
+                dev = self._predict_raw_device_loaded(
+                    data, start_iteration, end_iter)
             if dev is not None:
                 if self.average_output and end_iter > start_iteration:
                     dev /= (end_iter - start_iteration)
@@ -1240,6 +1357,11 @@ class GBDT:
     def predict_leaf_index(self, data: np.ndarray) -> np.ndarray:
         self._flush_pending()
         data = np.asarray(data, dtype=np.float64)
+        K = self.num_tree_per_iteration
+        dev = self._predict_raw_device_loaded(
+            data, 0, len(self.models) // max(K, 1), leaves_only=True)
+        if dev is not None:
+            return dev
         out = np.zeros((data.shape[0], len(self.models)), dtype=np.int32)
         for t, tree in enumerate(self.models):
             out[:, t] = tree.predict_leaf(data)

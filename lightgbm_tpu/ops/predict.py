@@ -5,6 +5,13 @@ TPU-native equivalent of Tree::AddPredictionToScore on binned data
 all rows advance one level per step of a while_loop; finished rows hold their
 (negative) leaf reference.  The loop runs ~tree-depth iterations, fully
 vectorized across rows.
+
+``predict_leaf_thridx`` runs the same loop for LOADED models (real-valued
+thresholds, no bin mappers): the host converts raw values to per-feature
+THRESHOLD-INDEX space with exact float64 searchsorted (v <= t_k iff
+#thresholds-below-v <= k), so the device compares integers and the f64
+decision semantics of the host walk (tree.py predict_leaf) are preserved
+bit-for-bit.
 """
 
 from __future__ import annotations
@@ -13,6 +20,61 @@ import jax
 import jax.numpy as jnp
 
 from .partition import split_decision
+
+
+def predict_leaf_thridx(packed_vals: jnp.ndarray, node: dict) -> jnp.ndarray:
+    """Leaf index per row for a loaded (real-threshold) tree.
+
+    Args:
+      packed_vals: (Fu, n) i32 — per (used-feature, row): b*4 + nan*2 +
+        zeroish, where b = #thresholds(feature) strictly below the value,
+        nan = isnan(raw), zeroish = |effective value| <= kZeroThreshold
+        (after the NaN->0 substitution the host walk applies for
+        non-NaN-missing nodes).
+      node: per-internal-node arrays: 'col' (index into the used-feature
+        enumeration), 'kidx' (threshold index), 'default_left', 'mtype',
+        'left', 'right' (children <0 = ~leaf), 'b0' (F,) threshold index
+        of value 0.0 per feature, scalar 'num_nodes'.
+    """
+    n = packed_vals.shape[1]
+    cur = jnp.zeros((n,), dtype=jnp.int32)
+    f_iota = jax.lax.broadcasted_iota(jnp.int32, packed_vals.shape, 0)
+    packed_nodes = jnp.stack([
+        node["col"], node["kidx"], node["default_left"].astype(jnp.int32),
+        node["mtype"], node["left"], node["right"],
+        jnp.take(node["b0"], node["col"])], axis=0).astype(jnp.int32)
+
+    def empty(_):
+        return jnp.zeros((n,), dtype=jnp.int32)
+
+    def run(_):
+        def cond(c):
+            return jnp.any(c >= 0)
+
+        def body(c):
+            active = c >= 0
+            nid = jnp.maximum(c, 0)
+            rows = jnp.take(packed_nodes, nid, axis=1)       # (7, n)
+            col, kidx, dleft, mtype, left, right, b0 = (
+                rows[0], rows[1], rows[2], rows[3], rows[4], rows[5],
+                rows[6])
+            pv = jnp.sum(jnp.where(f_iota == col[None, :], packed_vals, 0),
+                         axis=0)
+            b = pv >> 2
+            is_nan = (pv & 2) != 0
+            zeroish = (pv & 1) != 0
+            # NaN substitutes 0.0 unless the node is NaN-missing
+            b_eff = jnp.where(is_nan & (mtype != 2), b0, b)
+            missing = jnp.where(mtype == 2, is_nan,
+                                (mtype == 1) & zeroish)
+            goes_left = jnp.where(missing, dleft != 0, b_eff <= kidx)
+            nxt = jnp.where(goes_left, left, right)
+            return jnp.where(active, nxt, c)
+
+        final = jax.lax.while_loop(cond, body, cur)
+        return -(final + 1)
+
+    return jax.lax.cond(node["num_nodes"] > 0, run, empty, operand=None)
 
 
 def predict_leaf_binned(binned: jnp.ndarray, node: dict,
