@@ -82,6 +82,17 @@ class Application:
             log.fatal("Unknown task: %s", task)
 
     # ------------------------------------------------------------------
+    @staticmethod
+    def _side_file(path: str, suffix: str):
+        """Reference-style side files next to the data file
+        (dataset_loader.cpp LoadQueryBoundaries / LoadWeights /
+        LoadInitialScore: ``<data>.query`` etc.)."""
+        import numpy as np
+        p = path + "." + suffix
+        if os.path.exists(p):
+            return np.loadtxt(p, dtype=np.float64, ndmin=1)
+        return None
+
     def _load_train_data(self) -> Dataset:
         cfg = self.config
         if not cfg.data:
@@ -95,8 +106,15 @@ class Application:
             cfg.data, has_header=cfg.header, label_column=cfg.label_column,
             weight_column=cfg.weight_column, group_column=cfg.group_column,
             ignore_column=cfg.ignore_column)
-        ds = Dataset(loaded.X, label=loaded.label, weight=loaded.weight,
-                     group=loaded.group,
+        group = loaded.group
+        if group is None:
+            group = self._side_file(cfg.data, "query")
+        weight = loaded.weight
+        if weight is None:
+            weight = self._side_file(cfg.data, "weight")
+        init = self._side_file(cfg.data, "init")
+        ds = Dataset(loaded.X, label=loaded.label, weight=weight,
+                     group=group, init_score=init,
                      feature_name=loaded.feature_names or "auto",
                      params=dict(self.raw_params))
         return ds
@@ -116,8 +134,12 @@ class Application:
                     weight_column=cfg.weight_column,
                     group_column=cfg.group_column,
                     ignore_column=cfg.ignore_column)
+                vgroup = vl.group if vl.group is not None \
+                    else self._side_file(vf, "query")
+                vweight = vl.weight if vl.weight is not None \
+                    else self._side_file(vf, "weight")
                 valid_sets.append(Dataset(
-                    vl.X, label=vl.label, weight=vl.weight, group=vl.group,
+                    vl.X, label=vl.label, weight=vweight, group=vgroup,
                     reference=train_set, params=dict(self.raw_params)))
                 valid_names.append(os.path.basename(vf))
         init_model = cfg.input_model or None

@@ -29,6 +29,49 @@ from .tree import Tree, tree_from_device_record
 K_EPSILON = 1e-15
 
 
+import os as _os
+
+DEBUG_CHECKS = _os.environ.get("LIGHTGBM_TPU_DEBUG", "") == "1"
+
+
+def debug_validate_record(host_record, num_nodes: int, num_data: int,
+                          row0: int) -> None:
+    """LIGHTGBM_TPU_DEBUG=1 invariant checks on a materialized tree
+    record — the analog of the reference's DEBUG CheckSplit /
+    CheckAllDataInLeaf validation (serial_tree_learner.h:174-176):
+
+      * child pointers reference valid nodes/leaves and every leaf is
+        reached exactly once;
+      * the physical leaf ranges partition [row0, row0 + num_data);
+      * leaf values and gains are finite.
+    Raises AssertionError with a diagnostic on violation."""
+    L = num_nodes + 1
+    if num_nodes == 0:
+        return
+    left = np.asarray(host_record["node_left"])[:num_nodes]
+    right = np.asarray(host_record["node_right"])[:num_nodes]
+    seen_leaves = []
+    for arr in (left, right):
+        for v in arr:
+            if v < 0:
+                seen_leaves.append(~v)
+            else:
+                assert 0 <= v < num_nodes, f"child node {v} out of range"
+    assert sorted(seen_leaves) == list(range(L)), \
+        f"leaves reached {sorted(seen_leaves)} != 0..{L - 1}"
+    lv = np.asarray(host_record["leaf_value"])[:L]
+    assert np.isfinite(lv).all(), "non-finite leaf value"
+    starts = np.asarray(host_record["leaf_start"])[:L]
+    cnts = np.asarray(host_record["leaf_cnt"])[:L]
+    order = np.argsort(starts)
+    s, c = starts[order], cnts[order]
+    assert int(c.sum()) == num_data, \
+        f"leaf counts sum {int(c.sum())} != {num_data}"
+    assert s[0] == row0, f"first leaf starts at {s[0]} != {row0}"
+    assert (s[1:] == s[:-1] + c[:-1]).all(), \
+        "leaf ranges are not disjoint-contiguous"
+
+
 @functools.partial(jax.jit, static_argnames=("l1", "l2", "mds"))
 def _quant_renew_device(idx, grad, hess, starts, cnts, old_values,
                         l1, l2, mds):
@@ -493,6 +536,9 @@ class GBDT:
         if host_record is None:
             host_record = jax.device_get(small)
         num_nodes = int(host_record["s"])
+        if DEBUG_CHECKS:
+            debug_validate_record(host_record, num_nodes, self.num_data,
+                                  self.learner.row0)
         nodes = self.learner.node_arrays_for_predict(small)
         delta_leaf = small["leaf_delta"]
         for vi, (vd, metrics, binned) in enumerate(self.valid_sets):
@@ -969,6 +1015,10 @@ class GBDT:
             host_record = {key: np.asarray(val) for key, val in record.items()
                            if key.startswith(("node_", "leaf_"))}
             host_record["leaf_value"] = np.asarray(leaf_value_dev)
+            if DEBUG_CHECKS and "leaf_start" in host_record \
+                    and not use_sharded:
+                debug_validate_record(host_record, num_nodes,
+                                      self.num_data, self.learner.row0)
             tree = tree_from_device_record(
                 host_record, num_nodes, self.train_data.bin_mappers,
                 None, shrinkage=self.shrinkage_rate)

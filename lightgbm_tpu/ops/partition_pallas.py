@@ -123,7 +123,8 @@ def _cdiv(a, c):
 
 
 def partition_leaf_pallas(part_bins, part_ghi, sc_packed, scalars, *,
-                          row_chunk: int, ghi_live: int = 3):
+                          row_chunk: int, ghi_live: int = 3,
+                          interpret: bool = False):
     """Two-way stable partition of the leaf range described by
     ``scalars`` (see the S_* layout above), in place.
 
@@ -353,8 +354,11 @@ def partition_leaf_pallas(part_bins, part_ghi, sc_packed, scalars, *,
 
             @pl.when(read_src)
             def _():
+                # read through the OUTPUT refs: on TPU they alias the
+                # inputs, and the snapshot semantics of interpret mode
+                # would otherwise show pass 2 stale pre-pass-1 contents
                 pltpu.make_async_copy(
-                    sp_in.at[:, pl.ds(a0b * 128 + j * C, C)],
+                    sp.at[:, pl.ds(a0b * 128 + j * C, C)],
                     rs.at[slot], sems.at[slot, 0]).start()
             # destination window bounds (cover-relative)
             dlo = dst_off - r0 + j * C               # window start
@@ -365,17 +369,17 @@ def partition_leaf_pallas(part_bins, part_ghi, sc_packed, scalars, *,
             @pl.when(need_rmw)
             def _():
                 cb = pltpu.make_async_copy(
-                    pb_in.at[:, pl.ds(dwb * 128 + j * C, C)], exb,
+                    pb.at[:, pl.ds(dwb * 128 + j * C, C)], exb,
                     sems.at[0, 3])
                 cg = pltpu.make_async_copy(
-                    pg_in.at[:, pl.ds(dwb * 128 + j * C, C)], exg,
+                    pg.at[:, pl.ds(dwb * 128 + j * C, C)], exg,
                     sems.at[1, 3])
                 cb.start(); cg.start(); cb.wait(); cg.wait()
 
             @pl.when(read_src)
             def _():
                 pltpu.make_async_copy(
-                    sp_in.at[:, pl.ds(0, C)], rs.at[slot],
+                    sp.at[:, pl.ds(0, C)], rs.at[slot],
                     sems.at[slot, 0]).wait()
 
             cur_p = rs[slot][0:P]                    # packed payload
@@ -434,6 +438,7 @@ def partition_leaf_pallas(part_bins, part_ghi, sc_packed, scalars, *,
         ],
         grid_spec=grid_spec,
         input_output_aliases={1: 0, 2: 1, 3: 2},
+        interpret=interpret,
     )(scalars, part_bins, part_ghi, sc_packed)
     return out
 

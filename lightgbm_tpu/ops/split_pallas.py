@@ -57,11 +57,12 @@ OUT_FIELDS = 13     # lanes of each output row = LM_BGAIN..LM_BISCAT
 
 @functools.partial(jax.jit, static_argnames=(
     "l1", "l2", "max_delta_step", "min_gain_to_split", "min_data_in_leaf",
-    "min_sum_hessian", "max_depth"))
+    "min_sum_hessian", "max_depth", "interpret"))
 def best_split_pair_pallas(hist_g, hist_h, fmeta, info,
                            *, l1: float, l2: float, max_delta_step: float,
                            min_gain_to_split: float, min_data_in_leaf: int,
-                           min_sum_hessian: float, max_depth: int):
+                           min_sum_hessian: float, max_depth: int,
+                           interpret: bool = False):
     """Best numerical split for two sibling leaves.
 
     Args:
@@ -260,4 +261,5 @@ def best_split_pair_pallas(hist_g, hist_h, fmeta, info,
     return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+        interpret=interpret,
     )(hist_g, hist_h, fmeta, info)

@@ -156,3 +156,25 @@ def test_cli_module_entry(workdir):
          f"output_model={workdir}/m2.txt"],
         env=env, cwd="/root/repo")
     assert (workdir / "m2.txt").exists()
+
+
+@pytest.mark.parametrize("example", [
+    "multiclass_classification", "xendcg", "parallel_learning"])
+def test_example_confs_train(example, tmp_path):
+    """The example dirs double as consistency fixtures (reference ships
+    the same trio; BASELINE.md target configs 4-5)."""
+    import shutil
+    from lightgbm_tpu.cli import main as cli_main
+    src = os.path.join(os.path.dirname(__file__), "..", "examples", example)
+    work = tmp_path / example
+    shutil.copytree(src, work)
+    old = os.getcwd()
+    try:
+        os.chdir(work)
+        cli_main(["config=train.conf", "num_iterations=3", "verbosity=-1"])
+        assert os.path.exists("LightGBM_model.txt")
+        import lightgbm_tpu as lgb
+        bst = lgb.Booster(model_file="LightGBM_model.txt")
+        assert bst.num_trees() >= 3
+    finally:
+        os.chdir(old)
