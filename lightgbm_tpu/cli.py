@@ -68,6 +68,8 @@ class Application:
         # application.cpp:171 Network::Init ahead of LoadData/Train)
         from .parallel.network import init_from_config
         init_from_config(self.config)
+        from .parallel.distributed import sync_config_params
+        sync_config_params(self.config)
         if task == "train":
             self.train()
         elif task in ("predict", "prediction", "test"):

@@ -321,8 +321,19 @@ class BinnedDataset:
                             "ignoring", cfg.forcedbins_filename, exc)
         # feature_pre_filter threshold (reference: dataset_loader.cpp FindBin call)
         filter_cnt = int(cfg.min_data_in_leaf * sample_cnt / max(n, 1))
+        # multi-process construction: each rank finds bins only for its
+        # FEATURE shard (from its local sample) and the serialized
+        # mappers are allgathered so every rank agrees
+        # (dataset_loader.cpp:658-740, :1228-1236)
+        from .parallel import network as _net
+        nmach = _net.num_machines()
+        my_rank = _net.rank() if nmach > 1 else 0
+        self._distributed = nmach > 1
         self.bin_mappers = []
         for f in range(self.num_total_features):
+            if self._distributed and (f % nmach) != my_rank:
+                self.bin_mappers.append(None)
+                continue
             col = np.asarray(data[sample_idx, f], dtype=np.float64)
             # mirror the reference's sparse sampling: non-zero values + implied zeros
             nonzero = col[(np.abs(col) > 1e-35) | np.isnan(col)]
@@ -340,6 +351,14 @@ class BinnedDataset:
                 zero_as_missing=cfg.zero_as_missing,
                 forced_upper_bounds=forced_bounds.get(f))
             self.bin_mappers.append(bm)
+        if self._distributed:
+            from .parallel.distributed import allgather_bin_mappers
+            local = {f: bm for f, bm in enumerate(self.bin_mappers)
+                     if bm is not None}
+            merged, num_total = allgather_bin_mappers(
+                local, self.num_total_features)
+            self.bin_mappers = [merged[f] for f in range(num_total)]
+            self.num_total_features = num_total
         self.used_features = [f for f in range(self.num_total_features)
                               if not self.bin_mappers[f].is_trivial]
         if not self.used_features:
@@ -357,15 +376,21 @@ class BinnedDataset:
         rate) stay in their own group.
         """
         self.groups = []
-        if not self.config.enable_bundle:
+        if not self.config.enable_bundle or getattr(self, "_distributed",
+                                                    False):
+            if (getattr(self, "_distributed", False)
+                    and self.config.enable_bundle):
+                # conflict counts are rank-local samples; divergent
+                # bundles would give each process a different physical
+                # layout (the reference reaches group agreement through
+                # its synced sample — not modeled here yet)
+                log.warning("EFB disabled under multi-process construction")
             for f in self.used_features:
                 nb = self.bin_mappers[f].num_bin
                 self.groups.append(FeatureGroupInfo([f], nb, [0]))
             return
-        # Round-1 policy: bundle only sufficiently sparse features; dense ones
-        # are singleton groups.  Conflict counting over the full binned column
-        # happens later in _bin_data; here we group by sparse-rate heuristic
-        # identical in effect to the reference for dense data (no bundling).
+        # Candidate selection here; the conflict graph itself runs later
+        # in _bin_data / _finalize_groups over the binned columns.
         sparse, dense = [], []
         for f in self.used_features:
             bm = self.bin_mappers[f]

@@ -1,0 +1,96 @@
+"""Distributed data plane: rank-sharded loading and bin-mapper sync.
+
+TPU-native port of the reference's distributed loading protocol
+(src/io/dataset_loader.cpp):
+  * `LoadFromFile(rank, num_machines)` keeps only this rank's rows —
+    round-robin when the file is not pre-partitioned (:203);
+  * bin mappers are found FEATURE-SHARDED (each rank bins its slice of
+    the feature space from its local sample) and exchanged so every rank
+    ends with the identical full mapper set (:658-740, the Allgather of
+    serialized BinMappers at :1228-1236);
+  * `num_total_features` agrees by max (:602).
+
+The exchange rides the typed host-level helpers in
+``parallel/network.py`` (jax.experimental.multihost_utils); with a
+single process everything degrades to local computation.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional
+
+import numpy as np
+
+from ..utils import log
+from . import network
+
+
+def rank_shard_indices(n: int, rank: int, num_machines: int,
+                       pre_partition: bool = False) -> np.ndarray:
+    """Row indices this rank keeps (reference: dataset_loader.cpp:203 —
+    round-robin `line % num_machines == rank` unless the input files are
+    already pre-partitioned per machine)."""
+    if pre_partition or num_machines <= 1:
+        return np.arange(n)
+    return np.arange(rank, n, num_machines)
+
+
+def allgather_bin_mappers(local_mappers: dict, num_total_features: int):
+    """Exchange feature-sharded BinMappers so every process holds the
+    full, identical set.
+
+    Args:
+      local_mappers: {feature_index: BinMapper} for THIS rank's feature
+        shard (feature f belongs to rank f % num_machines).
+      num_total_features: local feature count (synced by max).
+    Returns (mappers_by_feature: dict, num_total_features_global).
+    """
+    from ..ops.binning import BinMapper
+    nmach = network.num_machines()
+    num_total = int(network.global_sync_by_max(float(num_total_features)))
+    if nmach <= 1:
+        return dict(local_mappers), num_total
+    payload = json.dumps(
+        {str(f): bm.to_dict() for f, bm in local_mappers.items()},
+        separators=(",", ":")).encode()
+    import jax
+    from jax.experimental import multihost_utils
+    # two-phase exchange: lengths first, then the padded byte tensors
+    lens = multihost_utils.process_allgather(
+        np.asarray([len(payload)], np.int32))
+    maxlen = int(lens.max())
+    buf = np.zeros((maxlen,), np.uint8)
+    buf[:len(payload)] = np.frombuffer(payload, np.uint8)
+    bufs = multihost_utils.process_allgather(buf)
+    merged = {}
+    for r in range(bufs.shape[0]):
+        raw = bytes(bufs[r][:int(lens[r, 0])].tobytes())
+        for fs, d in json.loads(raw.decode()).items():
+            merged[int(fs)] = BinMapper.from_dict(d)
+    missing = [f for f in range(num_total) if f not in merged]
+    if missing:
+        log.warning("allgather_bin_mappers: features %s missing from every "
+                    "rank's shard", missing[:8])
+    return merged, num_total
+
+
+def sync_config_params(config) -> None:
+    """Cross-rank parameter agreement at startup (reference:
+    application.cpp:173-179 — the seeds and sampled fractions must match
+    on every machine or the replicated split decisions diverge; the
+    reference syncs by GlobalSyncUpByMin)."""
+    if network.num_machines() <= 1:
+        return
+    for name in ("seed", "data_random_seed", "bagging_seed",
+                 "feature_fraction_seed", "drop_seed", "extra_seed",
+                 "objective_seed"):
+        if hasattr(config, name) and getattr(config, name) is not None:
+            setattr(config, name,
+                    int(network.global_sync_by_min(
+                        float(getattr(config, name)))))
+    for name in ("feature_fraction", "bagging_fraction"):
+        if hasattr(config, name):
+            setattr(config, name,
+                    float(network.global_sync_by_min(
+                        float(getattr(config, name)))))
