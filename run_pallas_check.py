@@ -1,0 +1,42 @@
+"""Standalone TPU check of the packed partition kernel vs the NumPy oracle
+(same cases as tests/test_pallas_tpu.py, runnable outside the CPU-pinned
+pytest conftest)."""
+import numpy as np, jax, jax.numpy as jnp
+assert jax.default_backend() == "tpu", jax.default_backend()
+from lightgbm_tpu.ops.partition_pallas import (partition_leaf_pallas,
+                                               make_scalars, SC_ROWS)
+
+def oracle(pb, pg, start, cnt, col, bstart, isb, nb, dbin, mtype, thr, dl):
+    pb = pb.copy(); pg = pg.copy()
+    colv = pb[col, start:start+cnt].astype(np.int32)
+    fb_raw = colv - bstart
+    in_r = (fb_raw >= 1) & (fb_raw <= nb - 1)
+    fb = np.where(isb == 1, np.where(in_r, fb_raw, dbin), colv)
+    miss = (fb == dbin) if mtype == 1 else ((fb == nb-1) if mtype == 2 else np.zeros_like(fb, bool))
+    gl = np.where(miss, dl != 0, fb <= thr)
+    order = np.concatenate([np.where(gl)[0], np.where(~gl)[0]]) + start
+    pb[:, start:start+cnt] = pb[:, order]
+    pg[:, start:start+cnt] = pg[:, order]
+    return pb, pg, int(gl.sum())
+
+C, G32 = 1024, 32
+Np = 10 * C
+rng = np.random.RandomState(7)
+for trial in range(8):
+    pb = rng.randint(0, 250, (G32, Np)).astype(np.uint8)
+    pg = rng.randn(8, Np).astype(np.float32)
+    start = int(rng.randint(C, 5*C)); cnt = int(rng.randint(0, 4*C))
+    col = int(rng.randint(0, 28)); isb = int(rng.rand() < 0.3)
+    nb = int(rng.randint(10, 250)); bstart = int(rng.randint(0, 5)) if isb else 0
+    dbin = int(rng.randint(0, nb)); mtype = int(rng.randint(0, 3))
+    thr = int(rng.randint(0, nb)); dl = int(rng.rand() < 0.5)
+    epb, epg, enl = oracle(pb, pg, start, cnt, col, bstart, isb, nb, dbin, mtype, thr, dl)
+    sc = make_scalars(start, cnt, col, bstart, isb, nb, dbin, mtype, thr, dl)
+    rpb, rpg, _, rnl = partition_leaf_pallas(
+        jnp.asarray(pb), jnp.asarray(pg), jnp.zeros((SC_ROWS, Np), jnp.int32),
+        sc, row_chunk=C)
+    assert int(np.asarray(rnl)[0,0]) == enl, (trial, int(np.asarray(rnl)[0,0]), enl)
+    np.testing.assert_array_equal(np.asarray(rpb), epb)
+    np.testing.assert_array_equal(np.asarray(rpg)[:3].view(np.int32), epg[:3].view(np.int32))
+    print("trial", trial, "ok", flush=True)
+print("ALL OK")
