@@ -264,14 +264,41 @@ class GBDT:
         # the eager path (the TPU analog of the reference keeping the whole
         # iteration inside C++, gbdt.cpp:338-441).
         self._fused = None
+        # GOSS and plain bagging fold into the fused physical program
+        # (their masks are pure jnp); balanced/query bagging do not yet
+        plain_bagging = self.need_bagging and not self.balanced_bagging
         if (self.sharded_builder is None and self.objective is not None
                 and getattr(self.objective, "is_jit_safe", True)
                 and K == 1
                 and not cfg.linear_tree
-                and not self.goss and not self.need_bagging
+                and not (self.need_bagging and self.balanced_bagging)
                 and not cfg.cegb_penalty_feature_lazy
                 and not self.objective.is_renew_tree_output):
             self._setup_fused_step()
+        if self._fused is None and train_data is not None:
+            reasons = []
+            if self.sharded_builder is not None:
+                reasons.append("tree_learner=" + cfg.tree_learner)
+            if K != 1:
+                reasons.append(f"num_class={self.num_class}")
+            if cfg.linear_tree:
+                reasons.append("linear_tree")
+            if self.need_bagging and self.balanced_bagging:
+                reasons.append("balanced bagging")
+            if cfg.cegb_penalty_feature_lazy:
+                reasons.append("cegb_penalty_feature_lazy")
+            if self.objective is not None \
+                    and self.objective.is_renew_tree_output:
+                reasons.append(f"objective={self.objective.name} "
+                               "(renews leaf outputs)")
+            if self.objective is not None \
+                    and not getattr(self.objective, "is_jit_safe", True):
+                reasons.append(f"objective={self.objective.name} "
+                               "(not jit-safe)")
+            log.info("fused single-program iteration DISABLED (%s): each "
+                     "iteration pays per-dispatch host latency",
+                     ", ".join(reasons) or
+                     "objective lacks gradients_from_payload")
 
     def _setup_fused_step(self) -> None:
         lr_ = self.learner
@@ -295,9 +322,9 @@ class GBDT:
             if 4 + len(names) <= lr_._ghi_rows:
                 self._setup_fused_phys(names)
                 return
-        if self.use_quant:
-            # quantized training fuses only through the physical path
-            # (the discretizer and renewal are folded into that program)
+        if self.use_quant or self.goss or self.need_bagging:
+            # these fold only into the physical path (discretizer,
+            # renewal and sampling masks live inside that program)
             return
 
         def step(part_bins, scores, feature_mask, seed, feat_used):
@@ -377,6 +404,13 @@ class GBDT:
                                    else 12345)
         l1_, l2_, mds_ = (float(cfg.lambda_l1), float(cfg.lambda_l2),
                           float(cfg.max_delta_step))
+        use_goss = self.goss
+        use_bag = self.need_bagging and not self.balanced_bagging
+        bag_key = jax.random.PRNGKey(cfg.bagging_seed)
+        bag_freq = max(int(cfg.bagging_freq), 1)
+        bag_frac = float(cfg.bagging_fraction)
+        g_top_k = max(int(N * cfg.top_rate), 1)
+        g_other_k = max(int(N * cfg.other_rate), 1)
 
         def step(part_bins, ghi, feature_mask, seed, feat_used):
             rowid = jax.lax.bitcast_convert_type(ghi[2], jnp.int32)
@@ -385,6 +419,36 @@ class GBDT:
             g, h = obj.gradients_from_payload(ghi[3], **payload)
             g = g * vf
             h = h * vf
+            bag_cnt = jnp.int32(N)
+            if use_goss:
+                # in-program GOSS (goss.hpp Helper:116-165): pad rows
+                # carry zero importance and never select
+                imp = jnp.abs(g * h)
+                threshold = jax.lax.top_k(imp, g_top_k)[0][-1]
+                is_top = (imp >= threshold) & (vf > 0)
+                kg = jax.random.fold_in(bag_key, seed)
+                n_top = jnp.sum(is_top.astype(jnp.int32))
+                rest = jnp.maximum(N - n_top, 1)
+                prob = g_other_k / rest.astype(jnp.float32)
+                keep_other = ((~is_top) & (vf > 0) &
+                              (jax.random.uniform(kg, g.shape) < prob))
+                multiply = (N - g_top_k) / g_other_k
+                scale = jnp.where(is_top, 1.0,
+                                  jnp.where(keep_other, multiply, 0.0))
+                g = g * scale
+                h = h * scale
+                bag_cnt = jnp.sum((is_top | keep_other).astype(jnp.int32))
+            elif use_bag:
+                # bag redrawn per bagging_freq period: the key depends on
+                # the PERIOD index, so iterations inside one period see
+                # the identical mask (bagging.hpp semantics)
+                kb = jax.random.fold_in(bag_key, (seed - 1) // bag_freq)
+                sel = (jax.random.uniform(kb, g.shape) < bag_frac) \
+                    & (vf > 0)
+                sf = sel.astype(jnp.float32)
+                g = g * sf
+                h = h * sf
+                bag_cnt = jnp.sum(sel.astype(jnp.int32))
             hist_scale = None
             if use_quant:
                 # in-program discretizer (reference:
@@ -416,7 +480,7 @@ class GBDT:
                 # true grads ride the partition so the renewal reads
                 # them in the record's row order
                 ghi = ghi.at[tg_row].set(g).at[th_row].set(h)
-            rec = lr_._build_tree_impl(part_bins, ghi, jnp.int32(N),
+            rec = lr_._build_tree_impl(part_bins, ghi, bag_cnt,
                                        feature_mask, seed, feat_used,
                                        None, hist_scale)
             if use_quant and q_renew:
