@@ -142,6 +142,7 @@ class Application:
                     else self._side_file(vf, "weight")
                 valid_sets.append(Dataset(
                     vl.X, label=vl.label, weight=vweight, group=vgroup,
+                    init_score=self._side_file(vf, "init"),
                     reference=train_set, params=dict(self.raw_params)))
                 valid_names.append(os.path.basename(vf))
         init_model = cfg.input_model or None

@@ -357,7 +357,12 @@ class BinnedDataset:
                      if bm is not None}
             merged, num_total = allgather_bin_mappers(
                 local, self.num_total_features)
-            self.bin_mappers = [merged[f] for f in range(num_total)]
+            # a feature past some rank's local width may be binned by no
+            # rank (num_total agrees by max); degrade it to a trivial
+            # mapper instead of crashing
+            trivial = BinMapper()
+            self.bin_mappers = [merged.get(f, trivial)
+                                for f in range(num_total)]
             self.num_total_features = num_total
         self.used_features = [f for f in range(self.num_total_features)
                               if not self.bin_mappers[f].is_trivial]

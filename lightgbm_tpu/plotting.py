@@ -80,7 +80,7 @@ def plot_importance(booster, ax=None, height: float = 0.2,
     if ignore_zero:
         order = order[imp[order] > 0]
     if max_num_features is not None and max_num_features > 0:
-        order = order[len(order) - max_num_features:]
+        order = order[max(len(order) - max_num_features, 0):]
     shown = imp[order]
     rows = np.arange(shown.size)
 
