@@ -221,8 +221,12 @@ def find_best_split_categorical(feat_hist: jnp.ndarray, ctx: SplitContext,
             ev = ok_i & (c >= min_data_per_group)
             return jnp.where(ev, 0, c), ev
 
+        # the carry derives from the (possibly device-varying) inputs so
+        # shard_map's vma typing accepts the scan (a constant zero carry
+        # is unvarying and trips "carry input/output types differ")
+        carry0 = (step_cnt[:, 0] * 0).astype(jnp.int32)
         _, ev = jax.lax.scan(
-            step, jnp.zeros((F,), jnp.int32),
+            step, carry0,
             (step_cnt.T, (left_ok & not_broken & in_loop).T))
         evaluated = ev.T
         gain = pair_gain(lg, lh, rg, rh, l2c)
