@@ -205,6 +205,16 @@ class RegressionPoisson(ObjectiveFunction):
         hess = exp_score * math.exp(self.max_delta_step)
         return self._apply_weight(grad, hess)
 
+    payload_fields = ("label", "weight")
+
+    def gradients_from_payload(self, score, label, weight=None):
+        exp_score = jnp.exp(score)
+        grad = exp_score - label
+        hess = exp_score * math.exp(self.max_delta_step)
+        if weight is not None:
+            return grad * weight, hess * weight
+        return grad, hess
+
     def boost_from_score(self, class_id):
         if self.weight is not None:
             mean = float(jnp.sum(self.label * self.weight) / jnp.sum(self.weight))
@@ -273,6 +283,14 @@ class RegressionGamma(RegressionPoisson):
         hess = self.label * exp_neg
         return self._apply_weight(grad, hess)
 
+    def gradients_from_payload(self, score, label, weight=None):
+        exp_neg = jnp.exp(-score)
+        grad = 1.0 - label * exp_neg
+        hess = label * exp_neg
+        if weight is not None:
+            return grad * weight, hess * weight
+        return grad, hess
+
 
 class RegressionTweedie(RegressionPoisson):
     name = "tweedie"
@@ -287,6 +305,15 @@ class RegressionTweedie(RegressionPoisson):
         grad = -self.label * e1 + e2
         hess = -self.label * (1.0 - self.rho) * e1 + (2.0 - self.rho) * e2
         return self._apply_weight(grad, hess)
+
+    def gradients_from_payload(self, score, label, weight=None):
+        e1 = jnp.exp((1.0 - self.rho) * score)
+        e2 = jnp.exp((2.0 - self.rho) * score)
+        grad = -label * e1 + e2
+        hess = -label * (1.0 - self.rho) * e1 + (2.0 - self.rho) * e2
+        if weight is not None:
+            return grad * weight, hess * weight
+        return grad, hess
 
 
 # ---------------------------------------------------------------------------
@@ -477,6 +504,16 @@ class CrossEntropy(ObjectiveFunction):
         grad = z - self.label
         hess = z * (1.0 - z)
         return self._apply_weight(grad, hess)
+
+    payload_fields = ("label", "weight")
+
+    def gradients_from_payload(self, score, label, weight=None):
+        z = jax.nn.sigmoid(score)
+        grad = z - label
+        hess = z * (1.0 - z)
+        if weight is not None:
+            return grad * weight, hess * weight
+        return grad, hess
 
     def boost_from_score(self, class_id):
         if self.weight is not None:
