@@ -270,32 +270,46 @@ def partition_leaf_pallas(part_bins, part_ghi, sc_packed, scalars, *,
             fill_l, fl_l = stage(stgl, lcomp, fill_l, nlc)
             fill_r, fl_r = stage(stgr, rcomp, fill_r, nrc)
 
-            # lefts: unpack and flush in place to the row buffers
+            # lefts: unpack and flush in place to the row buffers.
+            # Flush DMAs are NOT waited inline: the wait happens just
+            # before the NEXT overwrite of the staging window (or at the
+            # pass-1 drain), overlapping the write with the next chunk's
+            # compaction.  Write windows only ever move forward, so the
+            # deferred write still lands strictly behind the read
+            # frontier.
             @pl.when(fl_l > 0)
             def _():
+                @pl.when(nfl > 0)
+                def _():
+                    pltpu.make_async_copy(
+                        wb, pb.at[:, pl.ds(0, C)], sems.at[0, 2]).wait()
+                    pltpu.make_async_copy(
+                        wg, pg.at[:, pl.ds(0, C)], sems.at[1, 2]).wait()
                 wb[:] = unpack_bins(stgl[0:W, 0:C]).astype(jnp.uint8)
                 wg[:] = jax.lax.bitcast_convert_type(
                     jnp.concatenate(
                         [stgl[W:P, 0:C],
                          jnp.zeros((GH - ghi_live, C), jnp.int32)], axis=0),
                     jnp.float32)
-                cb = pltpu.make_async_copy(
+                pltpu.make_async_copy(
                     wb, pb.at[:, pl.ds(a0b * 128 + nfl * C, C)],
-                    sems.at[0, 2])
-                cg = pltpu.make_async_copy(
+                    sems.at[0, 2]).start()
+                pltpu.make_async_copy(
                     wg, pg.at[:, pl.ds(a0b * 128 + nfl * C, C)],
-                    sems.at[1, 2])
-                cb.start(); cg.start(); cb.wait(); cg.wait()
+                    sems.at[1, 2]).start()
                 stgl[:, 0:C] = stgl[:, C:2 * C]
 
             # rights: flush STILL PACKED to the i32 scratch
             @pl.when(fl_r > 0)
             def _():
+                @pl.when(nfr > 0)
+                def _():
+                    pltpu.make_async_copy(
+                        wp, sp.at[:, pl.ds(0, C)], sems.at[0, 3]).wait()
                 wp[0:P] = stgr[:, 0:C]
-                cp = pltpu.make_async_copy(
+                pltpu.make_async_copy(
                     wp, sp.at[:, pl.ds(a0b * 128 + nfr * C, C)],
-                    sems.at[0, 3])
-                cp.start(); cp.wait()
+                    sems.at[0, 3]).start()
                 stgr[:, 0:C] = stgr[:, C:2 * C]
 
             return fill_l, fill_r, nfl + fl_l, nfr + fl_r, nl_cnt
@@ -304,6 +318,21 @@ def partition_leaf_pallas(part_bins, part_ghi, sc_packed, scalars, *,
             0, n_chunks, body,
             (jnp.int32(0), jnp.int32(0), jnp.int32(0), jnp.int32(0),
              jnp.int32(0)))
+
+        # Drain the deferred in-flight flush DMAs before the staging
+        # buffers are overwritten and before pass 2 touches their
+        # destination regions.
+        @pl.when(nfl > 0)
+        def _():
+            pltpu.make_async_copy(
+                wb, pb.at[:, pl.ds(0, C)], sems.at[0, 2]).wait()
+            pltpu.make_async_copy(
+                wg, pg.at[:, pl.ds(0, C)], sems.at[1, 2]).wait()
+
+        @pl.when(nfr > 0)
+        def _():
+            pltpu.make_async_copy(
+                wp, sp.at[:, pl.ds(0, C)], sems.at[0, 3]).wait()
 
         # Final partial flushes.  Full-window writes: the garbage tail
         # beyond ``fill`` is always rewritten by pass 2 (lefts) or never
@@ -390,6 +419,15 @@ def partition_leaf_pallas(part_bins, part_ghi, sc_packed, scalars, *,
             out_b = unpack_bins(out_p[0:W])          # (G32, C)
             out_gl = out_p[W:P]                      # (ghi_live, C) bits
             valid = (lane >= lo) & (lane < hi)
+            # wait the PREVIOUS window's deferred write before reusing
+            # the staging buffers (destination windows are disjoint, so
+            # the in-flight write never races this window's RMW read)
+            @pl.when(j > 0)
+            def _():
+                pltpu.make_async_copy(
+                    wb, pb.at[:, pl.ds(0, C)], sems.at[0, 2]).wait()
+                pltpu.make_async_copy(
+                    wg, pg.at[:, pl.ds(0, C)], sems.at[1, 2]).wait()
             exg_i = jax.lax.bitcast_convert_type(exg[:], jnp.int32)
             wb[:] = jnp.where(valid, out_b,
                               exb[:].astype(jnp.int32)).astype(jnp.uint8)
@@ -399,14 +437,22 @@ def partition_leaf_pallas(part_bins, part_ghi, sc_packed, scalars, *,
                      exg_i[ghi_live:GH]],
                     axis=0),
                 jnp.float32)
-            cb = pltpu.make_async_copy(
-                wb, pb.at[:, pl.ds(dwb * 128 + j * C, C)], sems.at[0, 2])
-            cg = pltpu.make_async_copy(
-                wg, pg.at[:, pl.ds(dwb * 128 + j * C, C)], sems.at[1, 2])
-            cb.start(); cg.start(); cb.wait(); cg.wait()
+            pltpu.make_async_copy(
+                wb, pb.at[:, pl.ds(dwb * 128 + j * C, C)],
+                sems.at[0, 2]).start()
+            pltpu.make_async_copy(
+                wg, pg.at[:, pl.ds(dwb * 128 + j * C, C)],
+                sems.at[1, 2]).start()
             return 0
 
         jax.lax.fori_loop(0, n_d, body2, 0)
+
+        @pl.when(n_d > 0)
+        def _():
+            pltpu.make_async_copy(
+                wb, pb.at[:, pl.ds(0, C)], sems.at[0, 2]).wait()
+            pltpu.make_async_copy(
+                wg, pg.at[:, pl.ds(0, C)], sems.at[1, 2]).wait()
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
