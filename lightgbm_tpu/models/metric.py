@@ -44,9 +44,47 @@ class Metric:
         raise NotImplementedError
 
     def _wmean(self, values):
+        """Weighted mean of a per-row loss; under multi-process training
+        the numerator/denominator sums are reduced ACROSS ranks so every
+        process reports the metric over the full rank-sharded dataset.
+        (The reference evaluates on each machine's local shard only — no
+        Network calls exist in src/metric/; the global reduction here is
+        deliberate so distributed logs agree with single-process runs.)"""
         if self.weight is not None:
-            return jnp.sum(values * self.weight) / self.sum_weight
-        return jnp.mean(values)
+            vs = float(jnp.sum(values * self.weight))
+            ws = self.sum_weight
+        else:
+            vs = float(jnp.sum(values))
+            ws = float(int(np.prod(values.shape)))
+        vs, ws = _global_pair(vs, ws)
+        return vs / max(ws, K_EPSILON)
+
+    def _rank_mean(self, value: float) -> float:
+        """Cross-rank aggregation for non-decomposable metrics (AUC, NDCG
+        family): the sum_weight-weighted mean of per-rank values.  Exact
+        only when every rank sees the full data (feature-parallel); an
+        explicit approximation for rank-sharded rows."""
+        vs, ws = _global_pair(value * self.sum_weight, self.sum_weight)
+        return vs / max(ws, K_EPSILON)
+
+
+def _global_pair(vsum: float, wsum: float) -> Tuple[float, float]:
+    from ..parallel import network
+    if network.num_machines() <= 1:
+        return vsum, wsum
+    out = network.global_sum([vsum, wsum])
+    return float(out[0]), float(out[1])
+
+
+def _global_queries(totals: "np.ndarray", num_queries: int) -> float:
+    """Sum per-rank DCG/AP totals (in place) and query counts across the
+    process group so ranking metrics cover the full sharded dataset."""
+    from ..parallel import network
+    if network.num_machines() <= 1:
+        return float(num_queries)
+    out = network.global_sum(list(totals) + [float(num_queries)])
+    totals[:] = out[:-1]
+    return float(out[-1])
 
 
 def _convert(score, objective):
@@ -225,8 +263,8 @@ class AUCMetric(Metric):
     is_max_better = True
 
     def eval(self, score, objective):
-        return [(self.name, float(_weighted_auc(
-            jnp.asarray(score), self.label, self.weight)))]
+        return [(self.name, self._rank_mean(float(_weighted_auc(
+            jnp.asarray(score), self.label, self.weight))))]
 
 
 class AveragePrecisionMetric(Metric):
@@ -242,7 +280,7 @@ class AveragePrecisionMetric(Metric):
         precision = tp / jnp.maximum(total, K_EPSILON)
         pos_w = w * (y > 0)
         ap = jnp.sum(precision * pos_w) / jnp.maximum(jnp.sum(pos_w), K_EPSILON)
-        return [(self.name, float(ap))]
+        return [(self.name, self._rank_mean(float(ap)))]
 
 
 # ---------------------------------------------------------------------------
@@ -338,7 +376,7 @@ class AucMuMetric(Metric):
                 den_j = np.sum(w[jj]) if w is not None else len(jj)
                 total += (s_ij / den_i) / den_j
         ans = (2.0 * total / K) / (K - 1)
-        return [(self.name, float(ans))]
+        return [(self.name, self._rank_mean(float(ans)))]
 
 
 # ---------------------------------------------------------------------------
@@ -408,7 +446,8 @@ class NDCGMetric(Metric):
                 idcg = b["idcg"][:, ki]
                 ndcg = jnp.where(idcg > 0, dcg / jnp.maximum(idcg, K_EPSILON), 1.0)
                 totals[ki] += float(jnp.sum(ndcg))
-        return [(f"ndcg@{k}", totals[ki] / self.num_queries)
+        nq = _global_queries(totals, self.num_queries)
+        return [(f"ndcg@{k}", totals[ki] / nq)
                 for ki, k in enumerate(self.eval_at)]
 
 
@@ -459,7 +498,8 @@ class MapMetric(Metric):
                 denom = jnp.maximum(jnp.minimum(cum_rel[:, -1], float(kk)), 1.0)
                 ap = ap_num / denom
                 totals[ki] += float(jnp.sum(ap))
-        return [(f"map@{k}", totals[ki] / self.num_queries)
+        nq = _global_queries(totals, self.num_queries)
+        return [(f"map@{k}", totals[ki] / nq)
                 for ki, k in enumerate(self.eval_at)]
 
 
