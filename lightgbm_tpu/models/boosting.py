@@ -100,6 +100,115 @@ def _scores_from_phys(ghi, num_data):
         ghi[3], mode="drop")
 
 
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def _scores_from_phys_mc(ghi, num_data, num_class):
+    """Multiclass variant: rows 3..3+K-1 are the per-class score rows."""
+    rowid = jax.lax.bitcast_convert_type(ghi[2], jnp.int32)
+    return jnp.zeros((num_data, num_class), jnp.float32).at[rowid].set(
+        ghi[3:3 + num_class].T, mode="drop")
+
+
+def _renew_leaves_percentile(rec, resid, pweight, sel, alpha: float,
+                             Npad: int):
+    """Per-leaf (weighted) percentile of residuals over the PARTITIONED
+    row order — the device analog of the L1-family RenewTreeOutput
+    (regression_objective.hpp:18-80 PercentileFun/WeightedPercentileFun
+    applied through SerialTreeLearner::RenewTreeOutput).
+
+    Leaves are contiguous physical row ranges, so one global sort keyed
+    by ``(leaf_id << 23) | global_residual_rank`` groups every leaf's
+    IN-BAG rows contiguously in residual order (out-of-bag and pad rows
+    carry rank +inf and fall to each group's tail); the percentile then
+    reads one or two gathered elements per leaf.  Requires
+    N_pad <= 2^23 and <= 256 leaf slots so the key fits a non-negative
+    int32 (the caller gates on both).
+
+    resid/sel/pweight are (Npad,) physical-order arrays; sel False marks
+    out-of-bag and pad rows.  Returns the renewed leaf-value vector
+    (old values where a leaf has no in-bag rows)."""
+    leaf_start = rec["leaf_start"]
+    leaf_cnt = rec["leaf_cnt"]
+    old = rec["leaf_value"]
+    Lslots = old.shape[0]
+    iota = jax.lax.iota(jnp.int32, Npad)
+
+    # leaf id per physical position: count starts <= p, then map the
+    # ordinal through the starts sorted by position.  Pad rows attach to
+    # a neighboring leaf's group but always sort beyond its in-bag count.
+    starts_valid = jnp.where(leaf_cnt > 0, leaf_start, Npad + 1)
+    order_starts = jnp.argsort(starts_valid).astype(jnp.int32)
+    marks = jnp.zeros((Npad,), jnp.int32).at[starts_valid].add(
+        1, mode="drop")
+    o = jnp.cumsum(marks)
+    leaf_at = jnp.take(order_starts, jnp.clip(o - 1, 0, Lslots - 1))
+
+    sort_val = jnp.where(sel, resid, jnp.inf)
+    ord1 = jnp.argsort(sort_val).astype(jnp.int32)
+    rank = jnp.zeros((Npad,), jnp.int32).at[ord1].set(iota)
+    key = (leaf_at << 23) | rank
+    ord2 = jnp.argsort(key).astype(jnp.int32)
+    r_s = jnp.take(resid, ord2)
+
+    # group offsets: keys ascend with leaf id, so groups are laid out in
+    # id order and offsets are an exclusive prefix over group sizes
+    sizes = jnp.zeros((Lslots,), jnp.int32).at[leaf_at].add(1)
+    off = jnp.cumsum(sizes) - sizes
+
+    selc = jnp.concatenate([jnp.zeros((1,), jnp.float32),
+                            jnp.cumsum(sel.astype(jnp.float32))])
+    nb = (jnp.take(selc, leaf_start + leaf_cnt)
+          - jnp.take(selc, leaf_start)).astype(jnp.int32)
+
+    if pweight is None:
+        fp = (nb - 1).astype(jnp.float32) * alpha
+        lo = jnp.floor(fp).astype(jnp.int32)
+        bias = fp - lo.astype(jnp.float32)
+        i1 = off + jnp.clip(lo, 0, jnp.maximum(nb - 1, 0))
+        i2 = off + jnp.clip(lo + 1, 0, jnp.maximum(nb - 1, 0))
+        v1 = jnp.take(r_s, i1)
+        v2 = jnp.take(r_s, i2)
+        v = v1 + (v2 - v1) * bias
+        v = jnp.where(nb == 1, jnp.take(r_s, off), v)
+    else:
+        wsel = pweight * sel.astype(jnp.float32)
+        w_s = jnp.take(wsel, ord2)
+        wc = jnp.concatenate([jnp.zeros((1,), jnp.float32),
+                              jnp.cumsum(wsel)])
+        sw = jnp.take(wc, leaf_start + leaf_cnt) - jnp.take(wc, leaf_start)
+        wc_s = jnp.cumsum(w_s)
+        base = jnp.where(off > 0, jnp.take(wc_s, jnp.maximum(off - 1, 0)),
+                         0.0)
+        leaf_s = jnp.take(leaf_at, ord2)
+        local_j = iota - jnp.take(off, leaf_s)
+        cum_half = wc_s - jnp.take(base, leaf_s) - w_s * 0.5
+        cond = (cum_half >= alpha * jnp.take(sw, leaf_s)) \
+            & (local_j < jnp.take(nb, leaf_s))
+        big = jnp.int32(Npad + 1)
+        first = jnp.full((Lslots,), big, jnp.int32).at[leaf_s].min(
+            jnp.where(cond, iota, big))
+        pos = jnp.where(first < big, first, off + jnp.maximum(nb - 1, 0))
+        pos = jnp.clip(pos, off, off + jnp.maximum(nb - 1, 0))
+        v = jnp.take(r_s, pos)
+    return jnp.where(nb > 0, v, old)
+
+
+def _phys_leaf_delta(rec, Npad: int):
+    """Per-row score delta from the physical leaf ranges: leaves are
+    disjoint contiguous row windows, so scatter +/- leaf values at the
+    range boundaries and prefix-sum — the +v/-v pairs of each closed
+    range cancel exactly before the next range opens.  The flat prefix
+    sum runs as a 2-D lane cumsum + small row-carry pass (a 1-D cumsum
+    over N_pad lowers lane-serial on TPU, ~1.1 ms/Mrow measured)."""
+    d = jnp.zeros((Npad,), jnp.float32)
+    d = d.at[rec["leaf_start"]].add(rec["leaf_value"], mode="drop")
+    d = d.at[rec["leaf_start"] + rec["leaf_cnt"]].add(
+        -rec["leaf_value"], mode="drop")
+    d2 = d.reshape(Npad // 256, 256)
+    within = jnp.cumsum(d2, axis=1)
+    carry = jnp.cumsum(within[:, -1]) - within[:, -1]   # (rows,)
+    return (within + carry[:, None]).reshape(Npad)
+
+
 class GBDT:
     """Gradient Boosting Decision Tree engine (reference: src/boosting/gbdt.cpp)."""
 
@@ -150,7 +259,12 @@ class GBDT:
         if getattr(self, "_phys", None) is not None:
             ghi = self._phys[1]
             self._phys = None
-            self._scores_arr = _scores_from_phys(ghi, self.num_data)
+            K = self.num_tree_per_iteration
+            if K > 1:
+                self._scores_arr = _scores_from_phys_mc(
+                    ghi, self.num_data, K)
+            else:
+                self._scores_arr = _scores_from_phys(ghi, self.num_data)
         return self._scores_arr
 
     @scores.setter
@@ -199,8 +313,15 @@ class GBDT:
         # boost from average (reference: gbdt.cpp:313-336)
         if (self.objective is not None and not self.has_init_score
                 and cfg.boost_from_average):
+            from ..parallel import network
             for k in range(K):
                 s = self.objective.boost_from_score(k)
+                # ObtainAutomaticInitialScore (gbdt.cpp:303-311): the
+                # per-rank init scores agree by mean across processes
+                # (objectives with internal sum-syncs are already equal,
+                # the mean is then the identity)
+                if network.num_machines() > 1:
+                    s = network.global_sync_by_mean(s)
                 if abs(s) > K_EPSILON:
                     self.init_scores[k] = s
                     if K == 1:
@@ -226,6 +347,8 @@ class GBDT:
                         "iterations by the distributed learners")
         # lagged fused-iteration records awaiting host materialization
         self._pending_recs: List[Dict[str, Any]] = []
+        # consecutive empty trees (stop detection across class trees)
+        self._empty_run = 0
 
         # sampling state
         self.bag_rng = jax.random.PRNGKey(cfg.bagging_seed)
@@ -266,31 +389,39 @@ class GBDT:
         self._fused = None
         # GOSS and plain bagging fold into the fused physical program
         # (their masks are pure jnp); balanced/query bagging do not yet
-        plain_bagging = self.need_bagging and not self.balanced_bagging
-        if (self.sharded_builder is None and self.objective is not None
-                and getattr(self.objective, "is_jit_safe", True)
-                and K == 1
-                and not cfg.linear_tree
-                and not (self.need_bagging and self.balanced_bagging)
-                and not cfg.cegb_penalty_feature_lazy
-                and not self.objective.is_renew_tree_output):
+        common_ok = (
+            self.sharded_builder is None and self.objective is not None
+            and getattr(self.objective, "is_jit_safe", True)
+            and not cfg.linear_tree
+            and not cfg.cegb_penalty_feature_lazy)
+        if common_ok and K == 1:
             self._setup_fused_step()
+        elif (common_ok and K > 1 and not self.use_quant and not self.goss
+              and not (self.need_bagging and self.balanced_bagging)
+              and not self.objective.is_renew_tree_output
+              and self._mc_fused_kind() is not None):
+            # multiclass: all K class trees build inside ONE program per
+            # iteration (gbdt.cpp:379's per-class Train loop, device-side)
+            self._setup_fused_multiclass()
         if self._fused is None and train_data is not None:
             reasons = []
             if self.sharded_builder is not None:
                 reasons.append("tree_learner=" + cfg.tree_learner)
             if K != 1:
-                reasons.append(f"num_class={self.num_class}")
+                reasons.append(f"num_class={self.num_class} (payload rows "
+                               "or sampling combo unsupported)")
             if cfg.linear_tree:
                 reasons.append("linear_tree")
             if self.need_bagging and self.balanced_bagging:
-                reasons.append("balanced bagging")
+                reasons.append("balanced bagging (needs a label-sign "
+                               "payload row)")
             if cfg.cegb_penalty_feature_lazy:
                 reasons.append("cegb_penalty_feature_lazy")
             if self.objective is not None \
                     and self.objective.is_renew_tree_output:
                 reasons.append(f"objective={self.objective.name} "
-                               "(renews leaf outputs)")
+                               "(renewal needs the physical path: GOSS/"
+                               "quantized combo or size limits exceeded)")
             if self.objective is not None \
                     and not getattr(self.objective, "is_jit_safe", True):
                 reasons.append(f"objective={self.objective.name} "
@@ -315,6 +446,20 @@ class GBDT:
         # per-iteration row cost).  Requires the concrete objective class
         # to define gradients_from_payload (inheriting it would silently
         # pair a subclass's overridden gradients with the base formula).
+        if obj.is_renew_tree_output and (
+                self.use_quant or self.goss
+                or Npad > (1 << 23) or lr_.L > 255):
+            # leaf renewal fuses only through the physical path's packed
+            # percentile sort ((leaf << 23) | rank int32 key), and the
+            # GOSS in-bag set is not recoverable post-partition
+            return
+        if self.need_bagging and self.balanced_bagging:
+            # balanced bagging reads the label sign per row inside the
+            # program; only payloads carrying a sign row support it
+            fields = obj.payload_fields or ()
+            if not any(n in ("label", "signed_label_weight")
+                       for n in fields if getattr(obj, n, None) is not None):
+                return
         if (type(obj).__dict__.get("gradients_from_payload") is not None
                 and obj.gradient_payload() is not None):
             names = [n for n in obj.payload_fields
@@ -322,7 +467,8 @@ class GBDT:
             if 4 + len(names) <= lr_._ghi_rows:
                 self._setup_fused_phys(names)
                 return
-        if self.use_quant or self.goss or self.need_bagging:
+        if self.use_quant or self.goss or self.need_bagging \
+                or obj.is_renew_tree_output:
             # these fold only into the physical path (discretizer,
             # renewal and sampling masks live inside that program)
             return
@@ -406,11 +552,27 @@ class GBDT:
                           float(cfg.max_delta_step))
         use_goss = self.goss
         use_bag = self.need_bagging and not self.balanced_bagging
+        use_balanced = self.need_bagging and self.balanced_bagging
         bag_key = jax.random.PRNGKey(cfg.bagging_seed)
         bag_freq = max(int(cfg.bagging_freq), 1)
         bag_frac = float(cfg.bagging_fraction)
+        pos_frac = float(cfg.pos_bagging_fraction)
+        neg_frac = float(cfg.neg_bagging_fraction)
+        sign_idx = None
+        if use_balanced:
+            sign_idx = names.index("label") if "label" in names \
+                else names.index("signed_label_weight")
         g_top_k = max(int(N * cfg.top_rate), 1)
         g_other_k = max(int(N * cfg.other_rate), 1)
+        # L1-family renewal state (the gate in _setup_fused_step already
+        # excluded GOSS/quantized combos and oversize payloads)
+        renew_alpha = (float(obj.renew_leaf_alpha())
+                       if obj.is_renew_tree_output else None)
+        label_idx = names.index("label") if "label" in names else None
+        weight_idx = names.index("weight") if "weight" in names else None
+        renew_w_fn = (obj.renew_weights_from_payload
+                      if hasattr(type(obj), "renew_weights_from_payload")
+                      else None)
 
         def step(part_bins, ghi, feature_mask, seed, feat_used):
             rowid = jax.lax.bitcast_convert_type(ghi[2], jnp.int32)
@@ -441,13 +603,33 @@ class GBDT:
             elif use_bag:
                 # bag redrawn per bagging_freq period: the key depends on
                 # the PERIOD index, so iterations inside one period see
-                # the identical mask (bagging.hpp semantics)
+                # the identical mask (bagging.hpp semantics).  Draws are
+                # indexed by ORIGINAL row id — the physical permutation
+                # changes every iteration, so a draw over physical
+                # positions would silently re-bag mid-period
                 kb = jax.random.fold_in(bag_key, (seed - 1) // bag_freq)
-                sel = (jax.random.uniform(kb, g.shape) < bag_frac) \
+                u = jax.random.uniform(kb, (N + 1,))
+                sel = (jnp.take(u, jnp.minimum(rowid, N)) < bag_frac) \
                     & (vf > 0)
                 sf = sel.astype(jnp.float32)
                 g = g * sf
                 h = h * sf
+                bag_cnt = jnp.sum(sel.astype(jnp.int32))
+            elif use_balanced:
+                # per-class Bernoulli (reference: bagging.hpp
+                # BalancedBaggingHelper:180-200); label signs ride the
+                # payload, draws are indexed by original row id
+                kb = jax.random.fold_in(bag_key, (seed - 1) // bag_freq)
+                u = jnp.take(jax.random.uniform(kb, (N + 1,)),
+                             jnp.minimum(rowid, N))
+                posr = ghi[4 + sign_idx] > 0
+                sel = jnp.where(posr, u < pos_frac, u < neg_frac) \
+                    & (vf > 0)
+                sf = sel.astype(jnp.float32)
+                g = g * sf
+                h = h * sf
+                # the ACTUAL drawn count, not the sizing estimate
+                # (bagging.hpp:46 bag_data_cnt_ = left_cnt)
                 bag_cnt = jnp.sum(sel.astype(jnp.int32))
             hist_scale = None
             if use_quant:
@@ -499,20 +681,42 @@ class GBDT:
                 renewed = _leaf_out(sum_g, sum_h + 2e-15, l1_, l2_, mds_)
                 rec["leaf_value"] = jnp.where(lc > 0, renewed,
                                               rec["leaf_value"])
-            # per-row score delta from the physical leaf ranges (see the
-            # boundary-difference comment in the original-order step).
-            # The flat prefix sum runs as a 2-D lane cumsum + small
-            # row-carry pass: a 1-D cumsum over N_pad lowers lane-serial
-            # on TPU (~1.1 ms/Mrow measured).
-            d = jnp.zeros((Npad,), jnp.float32)
-            d = d.at[rec["leaf_start"]].add(rec["leaf_value"], mode="drop")
-            d = d.at[rec["leaf_start"] + rec["leaf_cnt"]].add(
-                -rec["leaf_value"], mode="drop")
-            d2 = d.reshape(Npad // 256, 256)
-            within = jnp.cumsum(d2, axis=1)
-            carry = jnp.cumsum(within[:, -1]) - within[:, -1]   # (rows,)
-            delta_phys = (within + carry[:, None]).reshape(Npad)
-            ghi_out = rec["part_ghi"].at[3].add(shrink * delta_phys)
+            if renew_alpha is not None:
+                # L1-family leaf renewal: per-leaf residual percentile in
+                # POST-partition order (RegressionL1loss::RenewTreeOutput)
+                ghi_p = rec["part_ghi"]
+                rowid_p = jax.lax.bitcast_convert_type(ghi_p[2], jnp.int32)
+                valid_p = rowid_p != N
+                if use_bag:
+                    kb = jax.random.fold_in(bag_key,
+                                            (seed - 1) // bag_freq)
+                    u = jax.random.uniform(kb, (N + 1,))
+                    sel_p = (jnp.take(u, jnp.minimum(rowid_p, N))
+                             < bag_frac) & valid_p
+                elif use_balanced:
+                    kb = jax.random.fold_in(bag_key,
+                                            (seed - 1) // bag_freq)
+                    u = jnp.take(jax.random.uniform(kb, (N + 1,)),
+                                 jnp.minimum(rowid_p, N))
+                    posr = ghi_p[4 + sign_idx] > 0
+                    sel_p = jnp.where(posr, u < pos_frac,
+                                      u < neg_frac) & valid_p
+                else:
+                    sel_p = valid_p
+                resid = ghi_p[4 + label_idx] - ghi_p[3]
+                if renew_w_fn is not None:
+                    pw = renew_w_fn(
+                        ghi_p[4 + label_idx],
+                        ghi_p[4 + weight_idx] if weight_idx is not None
+                        else None)
+                elif weight_idx is not None:
+                    pw = ghi_p[4 + weight_idx]
+                else:
+                    pw = None
+                rec["leaf_value"] = _renew_leaves_percentile(
+                    rec, resid, pw, sel_p, renew_alpha, Npad)
+            ghi_out = rec["part_ghi"].at[3].add(
+                shrink * _phys_leaf_delta(rec, Npad))
             small = {k: v for k, v in rec.items()
                      if k.startswith(("node_", "leaf_")) or k in
                      ("s", "feat_used")}
@@ -521,6 +725,133 @@ class GBDT:
 
         self._fused_phys = jax.jit(step, donate_argnums=(0, 1))
         self._fused = self._fused_phys    # gate for train_one_iter
+
+    def _mc_fused_kind(self):
+        """Which fused-multiclass formula the CONCRETE objective class
+        provides: 'snapshot' (softmax family) or 'perclass' (OVA), else
+        None.  Checked on the concrete class's own __dict__ — a subclass
+        overriding get_gradients must not silently inherit the base
+        fused formula (same guard as the K==1 payload gate)."""
+        d = type(self.objective).__dict__
+        if (d.get("fused_prob_snapshot") is not None
+                and d.get("fused_class_gradients_from_prob") is not None):
+            return "snapshot"
+        if d.get("fused_class_gradients") is not None:
+            return "perclass"
+        return None
+
+    def _setup_fused_multiclass(self) -> None:
+        """Physical-order fused multiclass iteration: all K class trees
+        build inside ONE jitted program (the device analog of gbdt.cpp:379's
+        per-class Train loop).  Payload rows: 0 grad, 1 hess, 2 rowid-bits,
+        3..3+K-1 per-class scores, 3+K label, [3+K+1 weight] — every row
+        rides each class tree's partition, so after tree k the whole block
+        (including the other classes' scores) is consistently permuted and
+        tree k+1 reads softmax inputs in the CURRENT physical order."""
+        lr_ = self.learner
+        obj = self.objective
+        cfg = self.config
+        K = self.num_tree_per_iteration
+        shrink = self.shrinkage_rate
+        N = self.num_data
+        Npad = lr_.N_pad
+        C = lr_.row0
+        has_w = obj.weight is not None
+        need = 4 + K + (1 if has_w else 0)
+        if need > lr_._ghi_rows:
+            return    # Pallas partition caps the payload at 8 f32 rows
+        lr_._ghi_live = need
+        lbl_row = 3 + K
+        w_row = lbl_row + 1
+        label_arr = jnp.asarray(obj.label, jnp.float32)
+        weight_arr = obj.weight
+
+        def init_phys(part_bins, scores):
+            iota = jax.lax.iota(jnp.int32, Npad)
+            rowid = jnp.where((iota >= C) & (iota < C + N), iota - C, N)
+            ghi = jnp.zeros((lr_._ghi_rows, Npad), jnp.float32)
+            ghi = ghi.at[2].set(
+                jax.lax.bitcast_convert_type(rowid, jnp.float32))
+            for k in range(K):
+                ghi = ghi.at[3 + k].set(
+                    jnp.pad(scores[:, k], (C, Npad - C - N)))
+            ghi = ghi.at[lbl_row].set(jnp.pad(label_arr, (C, Npad - C - N)))
+            if has_w:
+                ghi = ghi.at[w_row].set(
+                    jnp.pad(weight_arr, (C, Npad - C - N)))
+            # the bins copy keeps the learner's master buffer alive
+            # through the step's donation
+            return part_bins + jnp.zeros((), part_bins.dtype), ghi
+
+        self._init_phys = jax.jit(init_phys)
+
+        use_bag = self.need_bagging and not self.balanced_bagging
+        bag_key = jax.random.PRNGKey(cfg.bagging_seed)
+        bag_freq = max(int(cfg.bagging_freq), 1)
+        bag_frac = float(cfg.bagging_fraction)
+
+        needs_snap = self._mc_fused_kind() == "snapshot"
+
+        def step(part_bins, ghi, feature_mask, seed, feat_used):
+            smalls = []
+            P = None
+            if needs_snap:
+                # softmax couples the classes: ALL K gradients come from
+                # the PRE-iteration scores (gbdt.cpp Boosting computes
+                # them before any class tree).  Snapshot the
+                # probabilities by ORIGINAL row id; each class tree
+                # gathers them back through its own permutation.
+                rowid0 = jax.lax.bitcast_convert_type(ghi[2], jnp.int32)
+                p0 = obj.fused_prob_snapshot(ghi[3:3 + K])
+                P = jnp.zeros((K, N + 1), jnp.float32).at[
+                    :, jnp.minimum(rowid0, N)].set(p0)
+            for k in range(K):
+                rowid = jax.lax.bitcast_convert_type(ghi[2], jnp.int32)
+                vf = (rowid != N).astype(jnp.float32)
+                if needs_snap:
+                    p_k = jnp.take(P[k], jnp.minimum(rowid, N))
+                    g, h = obj.fused_class_gradients_from_prob(
+                        k, p_k, ghi[lbl_row],
+                        ghi[w_row] if has_w else None)
+                else:
+                    g, h = obj.fused_class_gradients(
+                        k, ghi[3:3 + K], ghi[lbl_row],
+                        ghi[w_row] if has_w else None)
+                bag_cnt = jnp.int32(N)
+                if use_bag:
+                    # one bag per ITERATION shared by all K class trees
+                    # (bagging.hpp), drawn by original row id (see the
+                    # binary fused step)
+                    kb = jax.random.fold_in(bag_key,
+                                            (seed - 1) // bag_freq)
+                    u = jax.random.uniform(kb, (N + 1,))
+                    sel = (jnp.take(u, jnp.minimum(rowid, N)) < bag_frac) \
+                        & (vf > 0)
+                    sf = sel.astype(jnp.float32)
+                    g = g * sf
+                    h = h * sf
+                    bag_cnt = jnp.sum(sel.astype(jnp.int32))
+                else:
+                    g = g * vf
+                    h = h * vf
+                ghi = ghi.at[0].set(g).at[1].set(h)
+                rec = lr_._build_tree_impl(part_bins, ghi, bag_cnt,
+                                           feature_mask, seed * K + k,
+                                           feat_used)
+                part_bins = rec["part_bins"]
+                ghi = rec["part_ghi"]
+                ghi = ghi.at[3 + k].add(
+                    shrink * _phys_leaf_delta(rec, Npad))
+                feat_used = rec["feat_used"]
+                small = {kk: v for kk, v in rec.items()
+                         if kk.startswith(("node_", "leaf_")) or kk in
+                         ("s", "feat_used")}
+                small["leaf_delta"] = rec["leaf_value"] * shrink
+                smalls.append(small)
+            return part_bins, ghi, smalls
+
+        self._fused_phys = jax.jit(step, donate_argnums=(0, 1))
+        self._fused = self._fused_phys
 
     def _train_one_iter_fused(self) -> bool:
         """Fast path: the whole iteration in one device program.
@@ -554,16 +885,18 @@ class GBDT:
                 self.scores, rec = self._fused(
                     self.learner._part0, self.scores, feature_mask,
                     self.iter + 1, feat_used)
+        recs = rec if isinstance(rec, list) else [rec]
         if self.learner.has_cegb:
-            self._cegb_feat_used = rec["feat_used"]
-        small = {k: v for k, v in rec.items()
-                 if k.startswith(("node_", "leaf_")) or k == "s"}
-        for v in small.values():
-            try:
-                v.copy_to_host_async()
-            except Exception:
-                break
-        self._pending_recs.append(small)
+            self._cegb_feat_used = recs[-1]["feat_used"]
+        for r in recs:
+            small = {k: v for k, v in r.items()
+                     if k.startswith(("node_", "leaf_")) or k == "s"}
+            for v in small.values():
+                try:
+                    v.copy_to_host_async()
+                except Exception:
+                    break
+            self._pending_recs.append(small)
         self.iter += 1
         # with validation sets the record is needed NOW (scores update per
         # iteration); otherwise records accumulate and are drained in
@@ -587,9 +920,12 @@ class GBDT:
         if n <= 0:
             return False
         batch_host = jax.device_get(self._pending_recs[:n])
+        K = self.num_tree_per_iteration
         for host_record in batch_host:
             if self._materialize_pending(host_record):
-                self.iter -= len(self._pending_recs)
+                # stop fires only at an iteration boundary, so the
+                # remaining records are whole discarded iterations
+                self.iter -= len(self._pending_recs) // K
                 self._pending_recs.clear()
                 return True
         return False
@@ -605,27 +941,37 @@ class GBDT:
                                   self.learner.row0)
         nodes = self.learner.node_arrays_for_predict(small)
         delta_leaf = small["leaf_delta"]
+        K = self.num_tree_per_iteration
+        k_cls = len(self.models) % K
         for vi, (vd, metrics, binned) in enumerate(self.valid_sets):
             leaf_v = predict_leaf_binned(binned, nodes)
-            self.valid_scores[vi] = self.valid_scores[vi] + \
-                jnp.take(delta_leaf, leaf_v)
+            if K == 1:
+                self.valid_scores[vi] = self.valid_scores[vi] + \
+                    jnp.take(delta_leaf, leaf_v)
+            else:
+                self.valid_scores[vi] = self.valid_scores[vi].at[
+                    :, k_cls].add(jnp.take(delta_leaf, leaf_v))
         tree = tree_from_device_record(
             host_record, num_nodes, self.train_data.bin_mappers,
             None, shrinkage=self.shrinkage_rate)
-        K = self.num_tree_per_iteration
-        if (len(self.models) < K and abs(self.init_scores[0]) > K_EPSILON):
+        if (len(self.models) < K
+                and abs(self.init_scores[k_cls]) > K_EPSILON):
             if num_nodes > 0:
-                tree.leaf_value = tree.leaf_value + self.init_scores[0]
-                tree.internal_value = tree.internal_value + self.init_scores[0]
+                tree.leaf_value = tree.leaf_value + self.init_scores[k_cls]
+                tree.internal_value = (tree.internal_value
+                                       + self.init_scores[k_cls])
             else:
-                tree.leaf_value = np.asarray([self.init_scores[0]])
+                tree.leaf_value = np.asarray([self.init_scores[k_cls]])
         self.models.append(tree)
         self.device_trees.append({
             "nodes": nodes, "leaf_value": delta_leaf,
             "has_cat_split": bool(
                 np.any(host_record["node_is_cat"][:num_nodes]))})
         self._model_version += 1
-        return num_nodes == 0
+        # stop only when a FULL iteration's K class trees are all empty
+        # (gbdt.cpp TrainOneIter's per-class should_continue)
+        self._empty_run = self._empty_run + 1 if num_nodes == 0 else 0
+        return self._empty_run >= K and len(self.models) % K == 0
 
     def _flush_pending(self) -> None:
         """Materialize all lagged fused-iteration records (no-op usually)."""
@@ -736,12 +1082,12 @@ class GBDT:
                 # is the reference's bag_data_cnt_ (:100)
                 label = jnp.asarray(self.train_data.metadata.label)
                 pos = label > 0
-                npos = int(jnp.sum(pos))
                 u = jax.random.uniform(sub, (N,))
                 mask = jnp.where(pos, u < cfg.pos_bagging_fraction,
                                  u < cfg.neg_bagging_fraction)
-                cnt = max(int(npos * cfg.pos_bagging_fraction) +
-                          int((N - npos) * cfg.neg_bagging_fraction), 1)
+                # the ACTUAL drawn count (bagging.hpp:46
+                # bag_data_cnt_ = left_cnt), not the sizing estimate
+                cnt = max(int(jnp.sum(mask.astype(jnp.int32))), 1)
             else:
                 cnt = max(int(N * cfg.bagging_fraction), 1)
                 mask = jnp.zeros((N,), bool).at[
@@ -1484,6 +1830,7 @@ class GBDT:
     def rollback_one_iter(self) -> None:
         """reference: gbdt.cpp RollbackOneIter:443."""
         self._flush_pending()
+        self._empty_run = 0
         if self.iter <= 0:
             return
         K = self.num_tree_per_iteration

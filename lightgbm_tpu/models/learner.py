@@ -448,6 +448,16 @@ class SerialTreeLearner:
                             "(%s); using the XLA partition",
                             str(exc).split("\n")[0][:120])
                 self._use_pallas_part = False
+        # fused multiclass carries K score rows + label (+ weight) through
+        # the partition; the XLA path takes any row count (its per-row
+        # gather cost is width-independent), the Pallas kernel is capped
+        # at its 8-row f32 tile (partition_pallas.py asserts GH == 8)
+        K_cls = max(int(config.num_class), 1)
+        if K_cls > 1 and not self._use_pallas_part:
+            need = 4 + K_cls + (1 if dataset.metadata.weight is not None
+                                else 0)
+            if need > self._ghi_rows:
+                self._ghi_rows = ((need + 7) // 8) * 8
 
         # Row layout: the binned matrix TRANSPOSED to (G, N_pad) in its
         # native bin dtype, plus a packed (3, N_pad) grad/hess/rowid matrix.

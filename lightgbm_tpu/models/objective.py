@@ -24,6 +24,17 @@ from ..utils import log
 K_EPSILON = 1e-15
 
 
+def _dist_sums(*vals: float) -> Tuple[float, ...]:
+    """Sum scalars across the process group (reference:
+    Network::GlobalSyncUpBySum calls inside binary_objective.hpp:75-77,
+    155-157 and multiclass_objective.hpp:75-78).  Identity when
+    single-process."""
+    from ..parallel import network
+    if network.num_machines() <= 1:
+        return vals
+    return tuple(float(v) for v in network.global_sum(list(vals)))
+
+
 class ObjectiveFunction:
     """Base objective (reference: include/LightGBM/objective_function.h)."""
 
@@ -151,6 +162,15 @@ class RegressionL1(RegressionL2):
         hess = jnp.ones_like(score)
         return self._apply_weight(grad, hess)
 
+    payload_fields = ("label", "weight")
+
+    def gradients_from_payload(self, score, label, weight=None):
+        grad = jnp.sign(score - label)
+        hess = jnp.ones_like(score)
+        if weight is not None:
+            return grad * weight, hess * weight
+        return grad, hess
+
     def boost_from_score(self, class_id):
         return _weighted_percentile_host(
             np.asarray(self.label), None if self.weight is None
@@ -240,6 +260,16 @@ class RegressionQuantile(ObjectiveFunction):
         hess = jnp.ones_like(score)
         return self._apply_weight(grad, hess)
 
+    payload_fields = ("label", "weight")
+
+    def gradients_from_payload(self, score, label, weight=None):
+        delta = score - label
+        grad = jnp.where(delta >= 0, 1.0 - self.alpha, -self.alpha)
+        hess = jnp.ones_like(score)
+        if weight is not None:
+            return grad * weight, hess * weight
+        return grad, hess
+
     def boost_from_score(self, class_id):
         return _weighted_percentile_host(
             np.asarray(self.label), None if self.weight is None
@@ -266,12 +296,28 @@ class RegressionMAPE(ObjectiveFunction):
         hess = self.weight if self.weight is not None else jnp.ones_like(score)
         return grad, hess
 
+    payload_fields = ("label", "weight")
+
+    def gradients_from_payload(self, score, label, weight=None):
+        lw = 1.0 / jnp.maximum(1.0, jnp.abs(label))
+        if weight is not None:
+            lw = lw * weight
+        grad = jnp.sign(score - label) * lw
+        hess = weight if weight is not None else jnp.ones_like(score)
+        return grad, hess
+
     def boost_from_score(self, class_id):
         return _weighted_percentile_host(
             np.asarray(self.label), np.asarray(self.label_weight), 0.5)
 
     def renew_weights(self):
         return self.label_weight
+
+    def renew_weights_from_payload(self, label, weight):
+        lw = 1.0 / jnp.maximum(1.0, jnp.abs(label))
+        if weight is not None:
+            lw = lw * weight
+        return lw
 
 
 class RegressionGamma(RegressionPoisson):
@@ -335,8 +381,10 @@ class BinaryLogloss(ObjectiveFunction):
     def init(self, metadata: Metadata) -> None:
         super().init(metadata)
         pos = self._is_pos(np.asarray(metadata.label))
-        cnt_pos = int(pos.sum())
-        cnt_neg = self.num_data - cnt_pos
+        # class counts are GLOBAL under multi-process training so the
+        # unbalance weights agree on every rank (binary_objective.hpp:75-77)
+        cnt_pos, cnt_neg = _dist_sums(int(pos.sum()),
+                                      self.num_data - int(pos.sum()))
         self.need_train = cnt_pos > 0 and cnt_neg > 0
         if not self.need_train:
             log.warning("Contains only one class")
@@ -348,6 +396,9 @@ class BinaryLogloss(ObjectiveFunction):
                 w_pos = cnt_neg / cnt_pos
         w_pos *= self.scale_pos_weight
         self.cnt_pos, self.cnt_neg = cnt_pos, cnt_neg
+        # scalar class weights kept for the fused-multiclass OVA path,
+        # which reconstructs per-row weights from the payload label row
+        self._w_pos, self._w_neg = float(w_pos), float(w_neg)
         self.sign_label = jnp.where(jnp.asarray(pos), 1.0, -1.0)
         self.label_weight = jnp.where(jnp.asarray(pos), w_pos, w_neg)
         # sign and combined weight packed into ONE payload row (the
@@ -389,11 +440,17 @@ class BinaryLogloss(ObjectiveFunction):
         return grad, hess
 
     def boost_from_score(self, class_id):
+        # suml/sumw are summed across ranks before the ratio
+        # (binary_objective.hpp:155-157 GlobalSyncUpBySum)
         pos = (self.sign_label > 0).astype(jnp.float32)
         if self.weight is not None:
-            pavg = float(jnp.sum(pos * self.weight) / jnp.sum(self.weight))
+            suml = float(jnp.sum(pos * self.weight))
+            sumw = float(jnp.sum(self.weight))
         else:
-            pavg = float(jnp.mean(pos))
+            suml = float(jnp.sum(pos))
+            sumw = float(self.num_data)
+        suml, sumw = _dist_sums(suml, sumw)
+        pavg = suml / max(sumw, K_EPSILON)
         pavg = min(max(pavg, K_EPSILON), 1.0 - K_EPSILON)
         init_score = math.log(pavg / (1.0 - pavg)) / self.sigmoid
         log.info("[binary:BoostFromScore]: pavg=%f -> initscore=%f", pavg, init_score)
@@ -426,8 +483,19 @@ class MulticlassSoftmax(ObjectiveFunction):
                       self.num_class, int(lbl.min() if lbl.min() < 0 else lbl.max()))
         self.label_int = jnp.asarray(lbl)
         self.onehot = jax.nn.one_hot(self.label_int, self.num_class, dtype=jnp.float32)
-        counts = np.bincount(lbl, minlength=self.num_class).astype(np.float64)
-        self.class_init_probs = counts / max(len(lbl), 1)
+        # weighted class counts, summed across ranks with the total weight
+        # (multiclass_objective.hpp:58-83 incl. the :75-78 GlobalSyncUpBySum)
+        if metadata.weight is not None:
+            w = np.asarray(metadata.weight, dtype=np.float64)
+            counts = np.bincount(lbl, weights=w, minlength=self.num_class)
+            sum_weight = float(w.sum())
+        else:
+            counts = np.bincount(lbl, minlength=self.num_class).astype(np.float64)
+            sum_weight = float(len(lbl))
+        synced = _dist_sums(*counts, sum_weight)
+        counts = np.asarray(synced[:-1], dtype=np.float64)
+        sum_weight = synced[-1]
+        self.class_init_probs = counts / max(sum_weight, K_EPSILON)
 
     def get_gradients(self, score):
         # score: (N, K)
@@ -441,6 +509,29 @@ class MulticlassSoftmax(ObjectiveFunction):
 
     def boost_from_score(self, class_id):
         return math.log(max(K_EPSILON, self.class_init_probs[class_id]))
+
+    def fused_prob_snapshot(self, score_rows):
+        """(K, N_pad) softmax of the pre-iteration score rows.
+
+        Softmax couples the classes, and the reference computes ALL K
+        gradients from the pre-iteration scores before any class tree
+        builds (gbdt.cpp Boosting -> GetGradients once per iteration),
+        so the fused iteration snapshots the probabilities first."""
+        m = jnp.max(score_rows, axis=0)
+        e = jnp.exp(score_rows - m)
+        return e / jnp.sum(e, axis=0)
+
+    def fused_class_gradients_from_prob(self, k, p_k, label_row,
+                                        weight_row):
+        """Class-k gradients from the snapshotted probability row
+        (multiclass_objective.hpp:86-130 restricted to one class)."""
+        y = (label_row == k).astype(jnp.float32)
+        grad = p_k - y
+        hess = self.factor * p_k * (1.0 - p_k)
+        if weight_row is not None:
+            grad = grad * weight_row
+            hess = hess * weight_row
+        return grad, hess
 
     def convert_output(self, raw):
         return jax.nn.softmax(raw, axis=-1)
@@ -475,6 +566,25 @@ class MulticlassOVA(ObjectiveFunction):
 
     def boost_from_score(self, class_id):
         return self.binaries[class_id].boost_from_score(0)
+
+    def fused_class_gradients(self, k, score_rows, label_row, weight_row):
+        """Per-class one-vs-all binary gradients from payload rows; the
+        class weights are host scalars from the binary init
+        (binary_objective.hpp:105-137 with is_pos = label == k)."""
+        b = self.binaries[k]
+        is_pos = label_row == k
+        sign = jnp.where(is_pos, 1.0, -1.0)
+        lw = jnp.where(is_pos, b._w_pos, b._w_neg)
+        if weight_row is not None:
+            lw = lw * weight_row
+        response = -sign * b.sigmoid / (
+            1.0 + jnp.exp(sign * b.sigmoid * score_rows[k]))
+        abs_response = jnp.abs(response)
+        grad = response * lw
+        hess = abs_response * (b.sigmoid - abs_response) * lw
+        if not b.need_train:
+            return jnp.zeros_like(grad), jnp.zeros_like(hess)
+        return grad, hess
 
     def convert_output(self, raw):
         return 1.0 / (1.0 + jnp.exp(-self.sigmoid * raw))

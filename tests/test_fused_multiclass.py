@@ -1,0 +1,135 @@
+"""Fused multiclass iteration: all K class trees build inside one jitted
+program (models/boosting.py _setup_fused_multiclass; reference analog:
+gbdt.cpp:379 per-class Train loop).  These tests pin the fused path's
+equivalence to the eager per-class dispatch path and its behavior across
+the sampling/weight/valid combinations."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+@pytest.fixture
+def mc_data(rng):
+    X = rng.normal(size=(2500, 8))
+    y = ((X[:, 0] > 0).astype(int) + (X[:, 1] > 0.5).astype(int))
+    return X, y.astype(float)
+
+
+def _train(X, y, params, rounds=12, force_eager=False, weight=None,
+           valid=False):
+    ds = lgb.Dataset(X, label=y, weight=weight)
+    bst = lgb.Booster(params=params, train_set=ds)
+    if force_eager:
+        bst._gbdt._fused = None
+        bst._gbdt._fused_phys = None
+    if valid:
+        vs = lgb.Dataset(X, label=y, weight=weight, reference=ds)
+        bst.add_valid(vs, "v0")
+    for _ in range(rounds):
+        bst.update()
+    return bst
+
+
+BASE = {"objective": "multiclass", "num_class": 3, "num_leaves": 15,
+        "min_data_in_leaf": 5, "verbosity": -1}
+
+
+def _logloss(p, y):
+    return -np.mean(np.log(np.maximum(
+        p[np.arange(len(y)), y.astype(int)], 1e-12)))
+
+
+def test_fused_multiclass_enabled_and_matches_eager(mc_data):
+    """The fused program and the eager per-class dispatch path see the
+    SAME pre-iteration gradients (the snapshot-by-rowid machinery), so
+    the first class tree of the first iteration is bit-identical; later
+    trees build histograms in permuted row order, so near-tie splits may
+    flip on f32 rounding — quality must still be equivalent."""
+    X, y = mc_data
+    fused = _train(X, y, dict(BASE))
+    assert fused._gbdt._fused is not None, "multiclass should fuse"
+    eager = _train(X, y, dict(BASE), force_eager=True)
+    fused._gbdt._flush_pending()
+    t_f, t_e = fused._gbdt.models[0], eager._gbdt.models[0]
+    assert t_f.num_leaves == t_e.num_leaves
+    assert np.array_equal(t_f.split_feature, t_e.split_feature)
+    assert np.allclose(t_f.leaf_value, t_e.leaf_value, atol=1e-6)
+    pf, pe = fused.predict(X), eager.predict(X)
+    assert len(fused._gbdt.models) == len(eager._gbdt.models) == 36
+    lf, le = _logloss(pf, y), _logloss(pe, y)
+    assert abs(lf - le) < 0.02 * max(le, 1e-3), (lf, le)
+    assert (pf.argmax(1) == y).mean() == pytest.approx(
+        (pe.argmax(1) == y).mean(), abs=0.01)
+
+
+def test_fused_ova_matches_eager(mc_data):
+    # class 0 builds before any permutation, so its first tree is
+    # bit-identical; later trees see permuted histogram summation order
+    # (see the softmax test's docstring) — quality must stay equivalent
+    X, y = mc_data
+    params = dict(BASE, objective="multiclassova")
+    fused = _train(X, y, params)
+    assert fused._gbdt._fused is not None
+    eager = _train(X, y, params, force_eager=True)
+    fused._gbdt._flush_pending()
+    t_f, t_e = fused._gbdt.models[0], eager._gbdt.models[0]
+    assert t_f.num_leaves == t_e.num_leaves
+    assert np.array_equal(t_f.split_feature, t_e.split_feature)
+    pf, pe = fused.predict(X), eager.predict(X)
+    lf, le = _logloss(pf / np.maximum(pf.sum(1, keepdims=True), 1e-12), y), \
+        _logloss(pe / np.maximum(pe.sum(1, keepdims=True), 1e-12), y)
+    assert abs(lf - le) < 0.02 * max(le, 1e-3), (lf, le)
+
+
+def test_fused_multiclass_weighted(mc_data, rng):
+    X, y = mc_data
+    w = rng.rand(len(y)) + 0.5
+    fused = _train(X, y, dict(BASE), weight=w)
+    assert fused._gbdt._fused is not None
+    eager = _train(X, y, dict(BASE), weight=w, force_eager=True)
+    pf, pe = fused.predict(X), eager.predict(X)
+    lf, le = _logloss(pf, y), _logloss(pe, y)
+    assert abs(lf - le) < 0.03 * max(le, 1e-3), (lf, le)
+
+
+def test_fused_multiclass_many_classes(rng):
+    # K=5 overflows the 8-row Pallas payload; the XLA partition widens
+    # its ghi block instead (learner.py _ghi_rows) and still fuses
+    X = rng.normal(size=(2000, 6))
+    y = rng.randint(0, 5, size=2000).astype(float)
+    bst = _train(X, y, dict(BASE, num_class=5), rounds=5)
+    assert bst._gbdt._fused is not None
+    p = bst.predict(X)
+    assert p.shape == (2000, 5)
+    assert np.allclose(p.sum(axis=1), 1.0, atol=1e-5)
+
+
+def test_fused_multiclass_bagging_and_valid(mc_data):
+    X, y = mc_data
+    params = dict(BASE, bagging_fraction=0.6, bagging_freq=2)
+    bst = _train(X, y, params, valid=True)
+    assert bst._gbdt._fused is not None
+    res = bst.eval_valid()
+    assert res and np.isfinite(res[0][2])
+    acc = (bst.predict(X).argmax(1) == y).mean()
+    assert acc > 0.9
+
+
+def test_fused_multiclass_stop_on_empty(rng):
+    # constant labels: every class tree is a stump after boost-from-avg,
+    # so training must stop (all-K-empty iteration), not loop forever
+    X = rng.normal(size=(500, 4))
+    y = np.ones(500)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.Booster(params=dict(BASE, min_data_in_leaf=600),
+                      train_set=ds)
+    stopped = False
+    for _ in range(5):
+        if bst.update():
+            stopped = True
+            break
+    bst._gbdt._flush_pending()
+    assert stopped or all(
+        t.num_leaves <= 1 for t in bst._gbdt.models)
