@@ -1,0 +1,62 @@
+"""Golden cross-artifact validation: reference-PRODUCED model files load
+into this framework and reproduce the reference CLI's own predictions;
+a model SAVED by this framework was consumed by the reference CLI
+(fixture captures its output).  See tests/golden/README.md for
+provenance.  Format spec: src/io/gbdt_model_text.cpp."""
+
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden")
+
+
+def _load(csv):
+    raw = np.genfromtxt(os.path.join(GOLDEN, csv), delimiter=",")
+    return raw[:, 1:], raw[:, 0]
+
+
+@pytest.mark.parametrize("name,csv", [
+    ("binary", "binary.csv"),
+    ("catbinary", "binary.csv"),          # categorical_feature=2
+    ("regression", "regression.csv"),
+    ("multiclass", "multiclass.csv"),
+])
+def test_reference_model_loads_and_predicts(name, csv):
+    X, _ = _load(csv)
+    bst = lgb.Booster(
+        model_file=os.path.join(GOLDEN, f"ref_{name}.model.txt"))
+    pred = np.asarray(bst.predict(X))
+    ref = np.loadtxt(os.path.join(GOLDEN, f"ref_{name}.pred.tsv"))
+    assert pred.shape == ref.shape
+    assert np.allclose(pred, ref, atol=5e-6), np.abs(pred - ref).max()
+
+
+def test_reference_model_roundtrips_through_save(tmp_path):
+    # load reference text -> save -> reload: predictions identical
+    X, _ = _load("binary.csv")
+    bst = lgb.Booster(
+        model_file=os.path.join(GOLDEN, "ref_binary.model.txt"))
+    p1 = np.asarray(bst.predict(X, raw_score=True))
+    out = tmp_path / "resaved.txt"
+    bst.save_model(str(out))
+    bst2 = lgb.Booster(model_file=str(out))
+    p2 = np.asarray(bst2.predict(X, raw_score=True))
+    assert np.allclose(p1, p2, atol=1e-7)
+
+
+def test_our_model_was_consumed_by_reference_cli():
+    """tpu_binary.refpred.tsv is the reference CLI's predict output when
+    loading tpu_binary.model.txt (a model THIS framework saved): the
+    reverse compatibility direction.  This framework must agree with
+    what the reference computed from its model file."""
+    X, _ = _load("binary.csv")
+    bst = lgb.Booster(
+        model_file=os.path.join(GOLDEN, "tpu_binary.model.txt"))
+    pred = np.asarray(bst.predict(X))
+    refpred = np.loadtxt(os.path.join(GOLDEN, "tpu_binary.refpred.tsv"))
+    assert np.allclose(pred, refpred, atol=5e-6), \
+        np.abs(pred - refpred).max()
