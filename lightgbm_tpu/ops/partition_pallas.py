@@ -99,17 +99,20 @@ def _compact(payload, flag, shift0, C, logc):
     """Stable compaction of flagged lanes to the front: binary shift
     network, moving each flagged lane left by its deficit (the number of
     unflagged lanes before it).  Monotone deficits make every step
-    collision-free; unflagged lanes are treated as holes."""
+    collision-free; unflagged lanes are treated as holes.
+
+    The live flag rides bit 16 of the shift vector so each step rolls and
+    selects ONE metadata row instead of two (deficits < C <= 2^15)."""
     cur = payload
-    shift = jnp.where(flag != 0, shift0, 0)
-    fl = flag
+    live = jnp.int32(1 << 16)
+    meta = jnp.where(flag != 0, shift0 | live, 0)
     for b in range(logc):
         bit = 1 << b
-        move = jnp.where((fl != 0) & ((shift & bit) != 0), 1, 0)
+        move = jnp.where((meta & live) != 0, meta & bit, 0)
         m_in = pltpu_roll(move, C - bit) != 0
         cur = jnp.where(m_in, pltpu_roll(cur, C - bit), cur)
-        shift = jnp.where(m_in, pltpu_roll(shift, C - bit), shift)
-        fl = jnp.where(m_in, 1, jnp.where(move != 0, 0, fl))
+        meta = jnp.where(m_in, pltpu_roll(meta, C - bit),
+                         jnp.where(move != 0, meta & (live - 1), meta))
     return cur
 
 

@@ -1,0 +1,88 @@
+"""Standalone cost of the XLA histogram formulation on a live TPU, with
+A/B variants of the one-hot generation.  Times R accumulations of a full
+N-row leaf."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+REPS = int(sys.argv[2]) if len(sys.argv) > 2 else 50
+G, B, C = 32, 255, 4096
+
+
+def variant_current(part_bins, ghi, start, cnt):
+    from lightgbm_tpu.ops.histogram import leaf_hist_slice
+    return leaf_hist_slice(part_bins, ghi, start, cnt,
+                           num_bins=B, row_chunk=C)
+
+
+def variant_fusedgen(part_bins, ghi, start, cnt):
+    """Weighted high-digit one-hots generated directly via where (no raw
+    oh_hi materialization)."""
+    Np = part_bins.shape[1]
+    BH = (B + 15) // 16
+    gblock = max(1, (4 * 1024 * 1024) // (C * (16 + 2 * BH) * 4))
+    nblk = (G + gblock - 1) // gblock
+    Gp = nblk * gblock
+    n_chunks = (cnt + C - 1) // C
+    iota_hi = jax.lax.broadcasted_iota(jnp.int32, (1, 1, BH), 2)
+    iota_lo = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 16), 2)
+
+    def body(ci, acc):
+        row0 = start + ci * C
+        bins = jax.lax.dynamic_slice(part_bins, (0, row0),
+                                     (G, C)).astype(jnp.int32)
+        gh3 = jax.lax.dynamic_slice(ghi, (0, row0), (ghi.shape[0], C))
+        valid = (ci * C + jax.lax.iota(jnp.int32, C)) < cnt
+        gv = (gh3[0] * valid)[None, :, None]
+        hv = (gh3[1] * valid)[None, :, None]
+        if Gp > G:
+            bins = jnp.pad(bins, ((0, Gp - G), (0, 0)), constant_values=-1)
+        out = []
+        for i in range(nblk):
+            blk = bins[i * gblock:(i + 1) * gblock, :]
+            m_hi = (blk >> 4)[:, :, None] == iota_hi
+            oh_lo = ((blk & 15)[:, :, None] == iota_lo).astype(jnp.float32)
+            wg = jnp.concatenate([jnp.where(m_hi, gv, 0.0),
+                                  jnp.where(m_hi, hv, 0.0)], axis=2)
+            out.append(jax.lax.dot_general(
+                wg, oh_lo, dimension_numbers=(((1,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32))
+        return acc + jnp.stack(out)
+
+    acc = jnp.zeros((nblk, gblock, 2 * BH, 16), jnp.float32)
+    acc = jax.lax.fori_loop(0, n_chunks, body, acc)
+    per = acc.reshape(Gp, 2 * BH, 16)[:G].reshape(G, 2, BH * 16)
+    return jnp.moveaxis(per[:, :, :B], 1, 2)
+
+
+def run(name, fn):
+    Npad = ((N + 2 * C + 127) // 128) * 128 + 2 * C
+    rng = np.random.RandomState(0)
+    bins = jnp.asarray(rng.randint(0, B, size=(G, Npad)).astype(np.uint8))
+    ghi = jnp.asarray(rng.normal(size=(8, Npad)).astype(np.float32))
+
+    @jax.jit
+    def many(b, g):
+        def one(i, acc):
+            return acc + fn(b, g, jnp.int32(C), jnp.int32(N))[0, 0, 0]
+        return jax.lax.fori_loop(0, REPS, one, jnp.float32(0.0))
+
+    float(many(bins, ghi))
+    t0 = time.time()
+    float(many(bins, ghi))
+    wall = time.time() - t0 - 0.105
+    print(f"{name:12s} per-pass={wall / REPS * 1e3:.2f} ms/Mrow-pass")
+
+
+if __name__ == "__main__":
+    print(f"N={N} reps={REPS} {jax.devices()}")
+    run("current", variant_current)
+    run("fusedgen", variant_fusedgen)

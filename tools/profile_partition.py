@@ -1,0 +1,96 @@
+"""Per-chunk cost attribution for the Pallas partition kernel on a live
+TPU.  Times R back-to-back partitions of an N-row leaf under each
+_profile_variant ("full" / "onenet" / "nonet" — the latter two produce
+wrong layouts by design) and several chunk sizes, with the
+many-reps-in-one-program + single-materialization discipline PERF.md
+prescribes for this tunnel.
+
+Usage: python tools/profile_partition.py [N] [reps]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lightgbm_tpu.ops import partition_pallas as pp
+from lightgbm_tpu.ops.partition_pallas import (partition_leaf_pallas,
+                                               make_scalars, sc_rows_for)
+
+_REAL_COMPACT = pp._compact
+
+
+def _set_variant(variant):
+    """Monkeypatch the compaction networks for A/B attribution (the
+    ablated kernels produce WRONG partitions by design; they exist only
+    here, never in the shipped kernel)."""
+    if variant == "full":
+        pp._compact = _REAL_COMPACT
+    elif variant == "onenet":
+        calls = {"n": 0}
+
+        def one(payload, flag, shift0, C, logc):
+            calls["n"] ^= 1
+            return (_REAL_COMPACT(payload, flag, shift0, C, logc)
+                    if calls["n"] else payload)
+        pp._compact = one
+    elif variant == "nonet":
+        pp._compact = lambda payload, flag, shift0, C, logc: payload
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+REPS = int(sys.argv[2]) if len(sys.argv) > 2 else 30
+G32 = 32
+GHL = 5      # bench-like payload: grad, hess, rowid, score, slw
+
+
+def run(C, variant):
+    Npad = ((N + 2 * C + 127) // 128) * 128 + 2 * C
+    rng = np.random.RandomState(0)
+    bins = rng.randint(0, 255, size=(G32, Npad)).astype(np.uint8)
+    ghi = rng.normal(size=(8, Npad)).astype(np.float32)
+    sc = np.zeros((sc_rows_for(G32), Npad), np.int32)
+    scal = make_scalars(jnp.int32(C), jnp.int32(N), 3, 0, 0, 255, 0, 0,
+                        128, 1)
+
+    _set_variant(variant)
+
+    def one(c, _):
+        pb, pg, sp = c
+        pb, pg, sp, nl = partition_leaf_pallas(
+            pb, pg, sp, scal, row_chunk=C, ghi_live=GHL)
+        return (pb, pg, sp), nl[0, 0]
+
+    @jax.jit
+    def many(pb, pg, sp):
+        (pb, pg, sp), nls = jax.lax.scan(
+            one, (pb, pg, sp), None, length=REPS)
+        return pb, pg, sp, jnp.sum(nls)
+
+    args = (jnp.asarray(bins), jnp.asarray(ghi), jnp.asarray(sc))
+    out = many(*args)
+    float(out[3])                      # compile + settle
+    t0 = time.time()
+    out = many(*args)
+    float(out[3])                      # host materialization barrier
+    wall = time.time() - t0 - 0.105    # subtract the tunnel round trip
+    chunks = (N + C - 1) // C
+    per_chunk = wall / REPS / chunks * 1e6
+    print(f"C={C:5d} variant={variant:7s} wall={wall:.3f}s "
+          f"per-pass={wall / REPS * 1e3:.2f}ms per-chunk={per_chunk:.2f}us")
+    return per_chunk
+
+
+if __name__ == "__main__":
+    print(f"N={N} reps={REPS} device={jax.devices()}")
+    for C in (4096, 2048, 8192):
+        for variant in ("full", "onenet", "nonet"):
+            try:
+                run(C, variant)
+            except Exception as e:
+                print(f"C={C} variant={variant} FAILED: "
+                      + str(e).split(chr(10))[0][:100])
