@@ -87,15 +87,166 @@ def _concat_parts(parts: List[Any]) -> np.ndarray:
     return np.concatenate([np.asarray(p) for p in parts], axis=0)
 
 
+def _group_parts_by_worker(futures, client):
+    """{worker_address: [future, ...]} in deterministic partition order
+    (the reference's _split_to_parts + worker grouping, dask.py:95-160)."""
+    who_has = client.who_has(futures)
+    by_worker: dict = {}
+    for i, f in enumerate(futures):
+        owners = sorted(who_has.get(f.key, ()))
+        w = owners[0] if owners else None
+        by_worker.setdefault(w, []).append(f)
+    return by_worker
+
+
+def _train_part(params, num_boost_round, x_parts, y_parts, w_parts,
+                g_parts, classes, rank, num_machines, coordinator):
+    """One rank of the distributed training job, executed ON a dask
+    worker against its LOCAL partitions (reference: dask.py:182-360
+    _train_part + LGBM_NetworkInit — here the network layer is
+    jax.distributed + the multi-process mesh trainer, so the client
+    never materializes any data).  Class encoding uses the CLUSTER-wide
+    class set (a shard missing a class must not collapse num_class).
+    Returns the model text on rank 0."""
+    import numpy as np
+
+    import jax
+
+    try:
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=num_machines,
+                                   process_id=rank)
+    except RuntimeError:
+        # the XLA backend is already up on this worker (a prior task
+        # touched JAX): acceptable only if this process already belongs
+        # to an equivalent process group
+        if (jax.process_count() != num_machines
+                or jax.process_index() != rank):
+            raise
+    import lightgbm_tpu as lgb
+
+    X = np.concatenate([np.asarray(p) for p in x_parts], axis=0)
+    y = np.concatenate([np.asarray(p).reshape(-1) for p in y_parts])
+    if classes is not None:
+        y = np.searchsorted(np.asarray(classes), y).astype(np.float64)
+    w = (None if w_parts is None else np.concatenate(
+        [np.asarray(p).reshape(-1) for p in w_parts]))
+    g = (None if g_parts is None else np.concatenate(
+        [np.asarray(p).reshape(-1) for p in g_parts]))
+    ds = lgb.Dataset(X, label=y, weight=w, group=g, params=params)
+    bst = lgb.train(params, ds, num_boost_round=num_boost_round)
+    try:
+        jax.distributed.shutdown()
+    except Exception:
+        pass
+    if rank == 0:
+        return bst.model_to_string()
+    return None
+
+
 class _DaskLGBMModel:
     """Mixin implementing fit/predict over dask collections."""
 
+    def _dask_fit_distributed(self, model_cls, X, y, sample_weight, group,
+                              client, **kwargs):
+        """Per-worker training: each dask worker becomes a
+        jax.distributed rank over ITS resident partitions; nothing is
+        gathered to the client (reference posture: dask.py:182-360, one
+        socket rank per worker — out-of-core by construction).  Requires
+        every aligned collection to share X's partitioning."""
+        unsupported = sorted(k for k, v in kwargs.items() if v is not None)
+        if unsupported:
+            raise ValueError(
+                f"fit arguments {unsupported} are not supported by "
+                "distributed dask training (each worker trains its own "
+                "rank via the native engine); pass distributed=False to "
+                "use the gather-to-client path instead")
+        X_fut = _materialize_parts(X, client)
+        by_worker = _group_parts_by_worker(X_fut, client)
+        workers = sorted(k for k in by_worker if k is not None)
+        n_machines = len(workers)
+        pos_of = {f.key: i for i, f in enumerate(X_fut)}
+
+        def aligned_parts(collection, name):
+            if collection is None:
+                return {w: None for w in workers}
+            fut = _materialize_parts(collection, client)
+            if len(fut) != len(X_fut):
+                raise ValueError(
+                    f"{name} has {len(fut)} partitions but X has "
+                    f"{len(X_fut)}; repartition them identically")
+            out = {}
+            for w in workers:
+                idxs = [pos_of[f.key] for f in by_worker[w]]
+                out[w] = [fut[i] for i in idxs]
+            return out
+
+        y_by = aligned_parts(y, "y")
+        w_by = aligned_parts(sample_weight, "sample_weight")
+        g_by = aligned_parts(group, "group")
+
+        # estimator-type preparation normally done by the subclass fit
+        # (class set, objective); classes come from small PER-PART uniques
+        # so labels never gather to the client
+        classes = None
+        if isinstance(self, LGBMClassifier):
+            y_fut = _materialize_parts(y, client)
+            uniqs = client.gather([
+                client.submit(lambda p: np.unique(np.asarray(p)), f,
+                              pure=False) for f in y_fut])
+            classes = np.unique(np.concatenate(
+                [np.asarray(u).reshape(-1) for u in uniqs]))
+            self._classes = classes
+            self._n_classes = len(classes)
+            if self._n_classes > 2:
+                self._objective = self.objective or "multiclass"
+                self._other_params["num_class"] = self._n_classes
+            elif self.objective is None:
+                self._objective = "binary"
+        elif isinstance(self, LGBMRanker):
+            if self.objective is None:
+                self._objective = "lambdarank"
+        elif self.objective is None:
+            self._objective = "regression"
+        params = self._process_params(stage="fit")
+        params.setdefault("tree_learner", "data")
+        params.pop("n_estimators", None)
+
+        # rank 0's worker hosts the jax.distributed coordinator
+        host0 = workers[0].split("://")[-1].rsplit(":", 1)[0]
+        port = int(params.get("local_listen_port") or 12723)
+        coordinator = f"{host0}:{port}"
+        log.info("lightgbm_tpu.dask: distributed fit over %d workers "
+                 "(%d partitions), coordinator %s",
+                 n_machines, len(X_fut), coordinator)
+        futures = []
+        for rank, w in enumerate(workers):
+            futures.append(client.submit(
+                _train_part, params, self.n_estimators, by_worker[w],
+                y_by[w], w_by[w], g_by[w], classes, rank, n_machines,
+                coordinator, workers=[w], allow_other_workers=False,
+                pure=False))
+        results = client.gather(futures)
+        model_str = next(r for r in results if r is not None)
+        from .basic import Booster
+        self._Booster = Booster(model_str=model_str)
+        self._n_features = int(self._Booster.num_feature())
+        self.fitted_ = True
+        return self
+
     def _dask_fit(self, model_cls, X, y, sample_weight=None, group=None,
-                  client: Optional["Client"] = None, **kwargs):
+                  client: Optional["Client"] = None,
+                  distributed: Optional[bool] = None, **kwargs):
         _require_dask()
         client = client or default_client()
         if not _is_dask_collection(X):
             raise TypeError("X must be a dask Array or DataFrame")
+        n_workers = len(client.scheduler_info()["workers"])
+        if distributed is None:
+            distributed = n_workers > 1
+        if distributed and n_workers > 1:
+            return self._dask_fit_distributed(
+                model_cls, X, y, sample_weight, group, client, **kwargs)
         # ONE placement permutation, derived from X and applied to every
         # aligned collection: ordering each collection by its OWN placement
         # silently misaligns rows and labels whenever corresponding

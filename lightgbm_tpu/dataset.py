@@ -443,14 +443,26 @@ class BinnedDataset:
                               default=2)
         return np.uint8 if max_bin_overall <= 256 else np.uint16
 
-    def bin_matrix(self, data: np.ndarray) -> np.ndarray:
+    def bin_matrix(self, data: np.ndarray,
+                   cat_oov_sentinel: bool = False) -> np.ndarray:
         """Bin NEW raw rows with this dataset's mappers into the packed
         (n, num_groups) layout — the same transform validation sets get
         (reference: LoadFromFileAlignWithOtherDataset).  For trees trained
         against this dataset, bin-space traversal of the result is EXACT
-        (split thresholds are bin uppers)."""
+        (split thresholds are bin uppers).
+
+        cat_oov_sentinel: prediction-path flag — unseen categories map to
+        an out-of-range sentinel bin so categorical splits send them to
+        the right child like the reference's raw-value predictor (see
+        BinMapper.values_to_bins).  Only valid when no categorical
+        feature is EFB-bundled (the caller checks)."""
         data = np.asarray(data)
-        cols = {f: self.bin_mappers[f].values_to_bins(data[:, f])
+        from .ops.binning import BIN_CATEGORICAL
+        cols = {f: self.bin_mappers[f].values_to_bins(
+                    data[:, f],
+                    oov_sentinel=(cat_oov_sentinel and
+                                  self.bin_mappers[f].bin_type
+                                  == BIN_CATEGORICAL))
                 for f in self.used_features}
         return self._pack_groups(cols, data.shape[0]).astype(
             self._bin_dtype())

@@ -342,9 +342,6 @@ class GBDT:
         self._cegb_feat_used = None
         # model-lifetime cegb-lazy per-(row, feature) used bitset
         self._cegb_lazy_aux = None
-        if self.learner.cegb_lazy is not None and self.sharded_builder:
-            log.warning("cegb_penalty_feature_lazy is not persisted across "
-                        "iterations by the distributed learners")
         # lagged fused-iteration records awaiting host materialization
         self._pending_recs: List[Dict[str, Any]] = []
         # consecutive empty trees (stop detection across class trees)
@@ -1388,7 +1385,10 @@ class GBDT:
                     record = self.sharded_builder.build_tree(
                         gk, hk, feature_mask, seed=tree_seed,
                         feat_used=self._cegb_feat_used,
-                        bag_mask=self._bag_mask_host)
+                        bag_mask=self._bag_mask_host,
+                        lazy_aux=self._cegb_lazy_aux)
+                    if isinstance(record, tuple):
+                        record, self._cegb_lazy_aux = record
                 else:
                     record = self.learner.build_tree(
                         gk, hk, bag_cnt, feature_mask, seed=tree_seed,
@@ -1396,7 +1396,9 @@ class GBDT:
                         lazy_aux=self._cegb_lazy_aux,
                         hist_scale=qscale)
             if self.learner.has_cegb:
-                # coupled penalties persist for the model lifetime
+                # coupled AND lazy penalties persist for the model
+                # lifetime (the sharded builder already returned its
+                # mesh-layout lazy aux above)
                 self._cegb_feat_used = record["feat_used"]
                 if (not use_sharded
                         and self.learner.cegb_lazy is not None):
@@ -1552,6 +1554,27 @@ class GBDT:
     def current_iteration(self) -> int:
         return self.iter
 
+    def _cat_sentinel_ok(self) -> bool:
+        """Whether the categorical OOV-sentinel device-predict scheme is
+        sound for this dataset: every categorical feature must be alone
+        in its group (EFB bundling folds bins so an out-of-range sentinel
+        can't ride through) and leave headroom for one extra bin code in
+        the binned dtype."""
+        td = self.train_data
+        if td is None or not getattr(td, "groups", None):
+            return False
+        from ..ops.binning import BIN_CATEGORICAL
+        u8 = td._bin_dtype() == np.uint8
+        for grp in td.groups:
+            for f in grp.feature_indices:
+                bm = td.bin_mappers[f]
+                if bm.bin_type == BIN_CATEGORICAL:
+                    if len(grp.feature_indices) > 1:
+                        return False
+                    if u8 and bm.num_bin >= 256:
+                        return False
+        return True
+
     def _predict_raw_device(self, data: np.ndarray, start_iteration: int,
                             end_iter: int):
         """Batch prediction on device: bin the rows with the TRAINING
@@ -1565,27 +1588,34 @@ class GBDT:
                 or getattr(self.train_data, "bin_mappers", None) is None
                 or end_iter <= start_iteration):
             return None
-        # the stacked traversal compiles per tree COUNT; only batches big
-        # enough to amortize that (and the binning) take the device path
-        if np.asarray(data).shape[0] < 4096:
+        ckey = (start_iteration, end_iter, len(self.models),
+                self._model_version)
+        cache = getattr(self, "_stack_cache", None)
+        # the stacked traversal compiles per tree COUNT and the node
+        # stacking costs a device round trip; a COLD cache only pays for
+        # itself on big batches, but once warm the same program serves
+        # any batch size
+        if np.asarray(data).shape[0] < 4096 and \
+                (cache is None or cache[0] != ckey):
             return None
         dts = self.device_trees[start_iteration * K:end_iter * K]
         if len(dts) != (end_iter - start_iteration) * K or \
                 any(d is None for d in dts):
             return None
-        # trees with categorical SPLITS: the bin-space traversal maps
-        # unseen categories (and NaN) to bin 0 — the most-frequent
-        # category — while the host walk and the reference predictor
-        # (tree.h CategoricalDecision) send them to the default side;
-        # refuse the device path rather than silently diverge on
-        # out-of-vocabulary data.  Trees that merely COULD have split
-        # categorically (the "is_cat" key exists whenever the dataset
-        # declares a categorical column) keep the fast path.
-        if any(d.get("has_cat_split", "is_cat" in d["nodes"])
-               for d in dts):
+        # categorical splits traverse on device via the OOV-sentinel bin
+        # (bin_matrix(cat_oov_sentinel=True)): unseen categories and NaN
+        # bin to num_bin, fail every category-set membership test, and
+        # fall to the right child — the reference predictor's
+        # CategoricalDecision (tree.h) on raw values.  The sentinel can't
+        # survive EFB bundling or a full 256-bin u8 feature, so those
+        # configurations keep the host walk.
+        has_cat = any(d.get("has_cat_split", "is_cat" in d["nodes"])
+                      for d in dts)
+        if has_cat and not self._cat_sentinel_ok():
             return None
         try:
-            binned = self.train_data.bin_matrix(np.asarray(data))
+            binned = self.train_data.bin_matrix(np.asarray(data),
+                                                cat_oov_sentinel=has_cat)
         except Exception:
             return None
         binned_dev = jnp.asarray(binned)
@@ -1599,9 +1629,6 @@ class GBDT:
         # stack the per-tree node arrays on the HOST with ONE device_get
         # (per-tree jnp.stack dispatches hundreds of tiny tunnel ops) and
         # cache per (range, model length)
-        cache = getattr(self, "_stack_cache", None)
-        ckey = (start_iteration, end_iter, len(self.models),
-                self._model_version)
         if cache is None or cache[0] != ckey:
             sel_all = self.device_trees[start_iteration * K:end_iter * K]
             host = jax.device_get([(d["nodes"], d["leaf_value"])
@@ -1642,7 +1669,15 @@ class GBDT:
         from ..ops.predict import predict_leaf_thridx
         from .tree import K_CATEGORICAL_MASK
         K = self.num_tree_per_iteration
-        if np.asarray(data).shape[0] < 4096 or end_iter <= start_iteration:
+        if end_iter <= start_iteration:
+            return None
+        ckey0 = (start_iteration, end_iter, len(self.models),
+                 self._model_version)
+        warm = getattr(self, "_loaded_cache", None)
+        # cold-cache stacking only pays for itself on big batches (see
+        # _predict_raw_device); a warm cache serves any size
+        if np.asarray(data).shape[0] < 4096 and \
+                (warm is None or warm[0] != ckey0):
             return None
         trees = self.models[start_iteration * K:end_iter * K]
         if any(t.is_linear or

@@ -433,13 +433,24 @@ class BinMapper:
                 lo = mid + 1
         return lo
 
-    def values_to_bins(self, values: np.ndarray) -> np.ndarray:
-        """Vectorized ValueToBin over a column."""
+    def values_to_bins(self, values: np.ndarray,
+                       oov_sentinel: bool = False) -> np.ndarray:
+        """Vectorized ValueToBin over a column.
+
+        oov_sentinel: categorical mappers only — map out-of-vocabulary
+        categories (and NaN) to the out-of-range bin ``num_bin`` instead
+        of bin 0.  Bin 0 is the most-frequent category, so a bin-space
+        traversal would send unseen categories wherever THAT category
+        goes; the sentinel fails every category-set membership test and
+        falls to the right child, matching the reference's raw-value
+        CategoricalDecision (tree.h) on unseen data.  Training/validation
+        binning keeps the reference's bin-0 mapping."""
         values = np.asarray(values, dtype=np.float64)
         out = np.zeros(values.shape, dtype=np.int32)
         if self.bin_type == BIN_CATEGORICAL:
+            miss = np.int32(self.num_bin) if oov_sentinel else np.int32(0)
             if not self.categorical_2_bin:
-                return out
+                return np.full(values.shape, miss, dtype=np.int32)
             cats = np.array(list(self.categorical_2_bin.keys()), dtype=np.int64)
             bins = np.array(list(self.categorical_2_bin.values()), dtype=np.int32)
             iv = np.where(np.isnan(values), -1, values).astype(np.int64)
@@ -447,7 +458,7 @@ class BinMapper:
             pos = np.searchsorted(cats[sorter], iv)
             pos = np.clip(pos, 0, len(cats) - 1)
             hit = cats[sorter[pos]] == iv
-            out = np.where(hit, bins[sorter[pos]], 0).astype(np.int32)
+            out = np.where(hit, bins[sorter[pos]], miss).astype(np.int32)
             return out
         nan_mask = np.isnan(values)
         vals = np.where(nan_mask, 0.0, values)

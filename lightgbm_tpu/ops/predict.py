@@ -141,10 +141,17 @@ def predict_leaf_binned(binned: jnp.ndarray, node: dict,
                 fb, threshold, default_left == 1, missing_type,
                 default_bin, nb - 1)
             if "is_cat" in node:
-                # categorical: membership of fb in the node's category set
+                # categorical: membership of fb in the node's category
+                # set.  Out-of-range bins (the prediction-path OOV
+                # sentinel num_bin — see BinMapper.values_to_bins — whose
+                # take_along_axis read would clip onto a REAL bin) fail
+                # membership explicitly and fall right, the reference's
+                # CategoricalDecision behavior for unseen categories.
                 cat_rows = jnp.take(node["cat_set"], nid, axis=0)
                 member = jnp.take_along_axis(
-                    cat_rows, fb[:, None], axis=1)[:, 0]
+                    cat_rows, jnp.minimum(fb, cat_rows.shape[1] - 1)[:, None],
+                    axis=1)[:, 0]
+                member = member & (fb <= nb - 1)
                 goes_left = jnp.where(rows[10] == 1, member, goes_left)
             nxt = jnp.where(goes_left, left, right)
             return jnp.where(active, nxt, c)

@@ -132,7 +132,7 @@ class ShardedTreeBuilder:
         lr = self.learner
 
         def build_shard(binned, grad, hess, bag_cnt, feature_mask, seed,
-                        feat_used):
+                        feat_used, lazy_aux):
             # binned: (local_n+1, G); grad/hess: (local_n,); bag_cnt: (1,)
             # local in-bag rows (== local valid rows without sampling)
             C = lr.row0
@@ -148,16 +148,31 @@ class ShardedTreeBuilder:
                 fidx = jnp.arange(F)
                 mine = (fidx >= d * per) & (fidx < (d + 1) * per)
                 feature_mask = feature_mask & mine
+            aux0 = lazy_aux[:, : lr.N] if lazy_aux is not None else None
             return lr._build_impl(part_bins, grad_l, hess_l,
-                                  bag_cnt[0], feature_mask, seed, feat_used)
+                                  bag_cnt[0], feature_mask, seed, feat_used,
+                                  aux0)
 
         row_spec = P() if self.mode == "feature" else P(AXIS)
-        in_specs = (row_spec, row_spec, row_spec, P(AXIS), P(), P(), P())
+        has_lazy = lr.cegb_lazy is not None
+        aux_spec = (P(None, AXIS) if self.mode != "feature" else P()) \
+            if has_lazy else None
+        in_specs = (row_spec, row_spec, row_spec, P(AXIS), P(), P(), P()) \
+            + ((aux_spec,) if has_lazy else ())
+        out_specs = (P(), aux_spec) if has_lazy else P()
 
         def wrapper(binned, grad, hess, bag_cnt, feature_mask, seed,
-                    feat_used):
+                    feat_used, *maybe_aux):
             rec = build_shard(binned, grad, hess, bag_cnt, feature_mask,
-                              seed, feat_used)
+                              seed, feat_used,
+                              maybe_aux[0] if maybe_aux else None)
+            # model-lifetime cegb-lazy persistence: scatter this shard's
+            # partitioned used-feature bitset back to ITS original rows
+            # (shards own contiguous row blocks, so row-sharded output
+            # reassembles the full original-order aux)
+            aux_out = None
+            if has_lazy:
+                aux_out = lr.lazy_aux_to_original_order(rec)
             # drop per-shard-varying state (partition arrays and LOCAL leaf
             # offsets/counts) — only globally-identical values may be
             # replicated out; consumers must use leaf_cnt_g
@@ -178,11 +193,17 @@ class ShardedTreeBuilder:
                     return jax.lax.pmax(x.astype(jnp.int32), AXIS).astype(jnp.bool_)
                 return jax.lax.pmax(x, AXIS)
 
-            return jax.tree.map(replicate, rec)
+            rec = jax.tree.map(replicate, rec)
+            if has_lazy:
+                if self.mode == "feature":
+                    # rows replicated: the aux is identical on every device
+                    aux_out = jax.lax.pmax(aux_out, AXIS)
+                return rec, aux_out
+            return rec
 
         self._build_sharded = jax.jit(jax.shard_map(
             wrapper, mesh=self.mesh,
-            in_specs=in_specs, out_specs=P()))
+            in_specs=in_specs, out_specs=out_specs))
 
     # ------------------------------------------------------------------
     def pad_rows(self, arr: np.ndarray) -> jnp.ndarray:
@@ -196,9 +217,35 @@ class ShardedTreeBuilder:
             arr = np.concatenate([arr, np.zeros(total - len(arr), np.float32)])
         return self._put(arr, NamedSharding(self.mesh, P(AXIS)))
 
+    def pad_aux(self, aux) -> jnp.ndarray:
+        """Shard the (aux_rows, N) cegb-lazy bitset over the mesh rows
+        (replicated under feature-parallel).  The previous iteration's
+        sharded output passes through untouched — build_tree returns the
+        aux in mesh layout so it never materializes on the host (the
+        shards may not even be host-addressable under multi-process)."""
+        lr = self.learner
+        # the pass-through check sees the GLOBAL array shape (all mesh
+        # devices), while host-side padding below builds the LOCAL block
+        total_global = (self.N if self.mode == "feature"
+                        else self.ndev * self.local_n)
+        if isinstance(aux, jax.Array) and aux.ndim == 2 \
+                and aux.shape[1] == total_global and aux.dtype == jnp.int32:
+            return aux
+        if aux is None:
+            aux = np.zeros((lr.aux_rows, self.N), np.int32)
+        aux = np.asarray(aux, dtype=np.int32)
+        if self.mode == "feature":
+            return self._put(aux, NamedSharding(self.mesh, P()))
+        total_local = self.local_ndev * self.local_n
+        if aux.shape[1] < total_local:
+            aux = np.concatenate(
+                [aux, np.zeros((aux.shape[0], total_local - aux.shape[1]),
+                               np.int32)], axis=1)
+        return self._put(aux, NamedSharding(self.mesh, P(None, AXIS)))
+
     def build_tree(self, grad, hess, feature_mask=None,
                    seed: int = 0, feat_used=None,
-                   bag_mask=None) -> Dict[str, Any]:
+                   bag_mask=None, lazy_aux=None):
         lr = self.learner
         if feature_mask is None:
             feature_mask = jnp.ones((lr.F,), dtype=bool)
@@ -218,6 +265,9 @@ class ShardedTreeBuilder:
                               .sum()) for d in range(self.local_ndev)]
             bag_counts = self._put(np.asarray(counts, np.int32),
                                    NamedSharding(self.mesh, P(AXIS)))
-        return self._build_sharded(self.binned_sharded, self.pad_rows(grad),
-                                   self.pad_rows(hess), bag_counts,
-                                   feature_mask, jnp.int32(seed), feat_used)
+        args = (self.binned_sharded, self.pad_rows(grad),
+                self.pad_rows(hess), bag_counts,
+                feature_mask, jnp.int32(seed), feat_used)
+        if self.learner.cegb_lazy is not None:
+            return self._build_sharded(*args, self.pad_aux(lazy_aux))
+        return self._build_sharded(*args)

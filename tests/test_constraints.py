@@ -202,3 +202,30 @@ def test_cegb_coupled_penalty_persists_across_trees():
                     enumerate(np.asarray(lr2.ctx.feature_index))}
     acquired = {orig_of_enum[i] for i in np.nonzero(used_model)[0]}
     assert acquired == used_trees and len(acquired) > 0
+
+
+def test_cegb_lazy_persists_under_sharded_learners():
+    """cegb-lazy's per-(row, feature) used bitset persists across
+    iterations under the distributed learners too (the psum'd aux rides
+    the mesh between trees), so a sharded run matches serial training
+    exactly (reference: cost_effective_gradient_boosting.hpp)."""
+    import jax
+    if len(jax.devices()) < 2:
+        import pytest
+        pytest.skip("needs a multi-device mesh")
+    X, y = _make_data(n=1200)
+    pen = ",".join(["0.05"] * 6)
+    params = {**BASE, "cegb_tradeoff": 0.8,
+              "cegb_penalty_feature_lazy": pen, "num_leaves": 7}
+    serial = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                       num_boost_round=8)
+    sharded = lgb.train(dict(params, tree_learner="data"),
+                        lgb.Dataset(X, label=y), num_boost_round=8)
+    p_s = np.asarray(serial.predict(X))
+    p_d = np.asarray(sharded.predict(X))
+    assert np.allclose(p_s, p_d, rtol=1e-5, atol=1e-5), \
+        np.abs(p_s - p_d).max()
+    # and the lazy penalty actually biased the model (vs no penalty)
+    plain = lgb.train({**BASE, "num_leaves": 7},
+                      lgb.Dataset(X, label=y), num_boost_round=8)
+    assert not np.allclose(np.asarray(plain.predict(X)), p_d)

@@ -98,7 +98,7 @@ def test_fake_dask_classifier_roundtrip(fake_dask):
     X, y = _make_data()
     dX, dy = _FakeArray(X), _FakeArray(y)
     est = DaskLGBMClassifier(n_estimators=10, num_leaves=15, verbosity=-1)
-    est.fit(dX, dy, client=_FakeClient(4))
+    est.fit(dX, dy, client=_FakeClient(4), distributed=False)
     pred = est.predict(_FakeArray(X))
     assert pred.shape == (len(y),)
     assert np.mean(pred == y) > 0.9
@@ -111,9 +111,111 @@ def test_fake_dask_regressor(fake_dask):
     X, y = _make_data()
     yr = X[:, 0] * 2.0 + X[:, 2]
     est = DaskLGBMRegressor(n_estimators=15, num_leaves=15, verbosity=-1)
-    est.fit(_FakeArray(X), _FakeArray(yr), client=_FakeClient(4))
+    est.fit(_FakeArray(X), _FakeArray(yr), client=_FakeClient(4),
+            distributed=False)
     pred = est.predict(_FakeArray(X))
     assert np.mean((pred - yr) ** 2) < 0.3 * np.var(yr)
+
+
+import os as _os
+
+_REPO_ROOT = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+
+
+class _FakeDistClient(_FakeClient):
+    """Fake client whose submit() runs `_train_part` ranks in REAL
+    subprocesses (each becomes a jax.distributed process), so the
+    per-worker data plane is exercised end-to-end without dask: the
+    client process never touches partition contents.  Worker names are
+    real host:port addresses so the coordinator derivation works."""
+
+    WORKERS = ("tcp://127.0.0.1:40101", "tcp://127.0.0.1:40102")
+
+    def __init__(self, nparts, tmp_path):
+        super().__init__(nparts)
+        self.tmp = tmp_path
+
+    def compute(self, parts):
+        return [_FakeFuture(p._value, f"k{i}",
+                            self.WORKERS[i % len(self.WORKERS)])
+                for i, p in enumerate(parts)]
+
+    def scheduler_info(self):
+        return {"workers": {w: {} for w in self.WORKERS}}
+
+    def submit(self, fn, *args, workers=None, allow_other_workers=None,
+               pure=None, **kw):
+        import lightgbm_tpu.dask as mod
+        if fn is not mod._train_part:
+            # small helper submissions (per-part uniques) run inline
+            val = fn(*[a.result() if isinstance(a, _FakeFuture) else a
+                       for a in args])
+            return _FakeFuture(val, f"inline-{id(val)}", None)
+        import pickle
+        import subprocess
+        import sys
+
+        def resolve(a):
+            if isinstance(a, list):
+                return [x.result() if isinstance(x, _FakeFuture) else x
+                        for x in a]
+            return a.result() if isinstance(a, _FakeFuture) else a
+
+        rank = args[7]
+        argfile = self.tmp / f"rank{rank}.pkl"
+        outfile = self.tmp / f"rank{rank}.out.pkl"
+        with open(argfile, "wb") as f:
+            pickle.dump([resolve(a) for a in args], f)
+        code = (
+            "import os, pickle, sys\n"
+            "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+            "os.environ.pop('XLA_FLAGS', None)\n"
+            "import tempfile\n"
+            "os.environ['JAX_COMPILATION_CACHE_DIR'] = "
+            "tempfile.mkdtemp(prefix='jax-dask-')\n"
+            "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+            f"args = pickle.load(open({str(argfile)!r}, 'rb'))\n"
+            # initialize BEFORE the package import can touch the backend
+            "jax.distributed.initialize(coordinator_address=args[9],\n"
+            "    num_processes=args[8], process_id=args[7])\n"
+            f"sys.path.insert(0, {_REPO_ROOT!r})\n"
+            "from lightgbm_tpu.dask import _train_part\n"
+            "out = _train_part(*args)\n"
+            f"pickle.dump(out, open({str(outfile)!r}, 'wb'))\n")
+        p = subprocess.Popen([sys.executable, "-c", code],
+                             stdout=subprocess.PIPE,
+                             stderr=subprocess.STDOUT)
+        fut = _FakeFuture(None, f"train{rank}", workers[0])
+        fut._proc, fut._outfile = p, outfile
+        return fut
+
+    def gather(self, futures):
+        import pickle
+        out = []
+        for f in futures:
+            if getattr(f, "_proc", None) is not None:
+                log = f._proc.communicate(timeout=900)[0].decode()
+                assert f._proc.returncode == 0, log[-3000:]
+                out.append(pickle.load(open(f._outfile, "rb")))
+            else:
+                out.append(f.result())
+        return out
+
+
+def test_fake_dask_distributed_per_worker_plane(fake_dask, tmp_path):
+    """The distributed fit path: partitions stay on their workers, each
+    worker trains as a jax.distributed rank (a real 2-process run via
+    the subprocess-backed fake), and the client only ever receives the
+    model text."""
+    X, y = _make_data(n=1200)
+    port = 12600 + _os.getpid() % 300
+    est = DaskLGBMClassifier(n_estimators=10, num_leaves=15, verbosity=-1,
+                             min_child_samples=5, local_listen_port=port)
+    client = _FakeDistClient(4, tmp_path)
+    est.fit(_FakeArray(X), _FakeArray(y), client=client)
+    assert est._Booster is not None
+    pred = est.predict(_FakeArray(X))
+    assert np.mean(pred == y) > 0.9
 
 
 @pytest.mark.skipif(not DASK_INSTALLED, reason="dask not installed")

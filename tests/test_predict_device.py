@@ -91,3 +91,60 @@ def test_shap_additivity(rng):
     raw = bst.predict(X[:100], raw_score=True)
     np.testing.assert_allclose(contrib.sum(axis=1), raw,
                                rtol=1e-5, atol=1e-5)
+
+
+def test_device_predict_categorical_oov(rng):
+    """Categorical-split trees predict on device via the OOV-sentinel
+    bin: unseen categories and NaN fall to the RIGHT child like the
+    reference's raw-value CategoricalDecision (tree.h), matching the
+    host walk exactly."""
+    n = 6000
+    X = rng.normal(size=(n, 5))
+    X[:, 1] = rng.randint(0, 12, size=n)           # categorical
+    y = (X[:, 0] + np.where(np.isin(X[:, 1], [2, 3, 7]), 2.0, -1.0)
+         + 0.1 * rng.normal(size=n))
+    bst = lgb.train({"objective": "regression", "num_leaves": 31,
+                     "verbosity": -1, "min_data_in_leaf": 20,
+                     "categorical_feature": [1], "enable_bundle": False},
+                    lgb.Dataset(X, label=y), num_boost_round=12)
+    g = bst._gbdt
+    g._flush_pending()
+    assert any(d["has_cat_split"] for d in g.device_trees), \
+        "fixture must produce categorical splits"
+    # OOV categories (99, -5) and NaN in the categorical column
+    Xq = X.copy()
+    Xq[::7, 1] = 99.0
+    Xq[1::7, 1] = -5.0
+    Xq[2::7, 1] = np.nan
+    p_dev = g._predict_raw_device(Xq, 0, 12)
+    assert p_dev is not None, "categorical device path must engage"
+    saved = g.device_trees
+    g.device_trees = [None] * len(saved)
+    p_host = g.predict_raw(Xq)
+    g.device_trees = saved
+    np.testing.assert_allclose(p_dev[:, 0], np.asarray(p_host),
+                               rtol=2e-6, atol=2e-6)
+
+
+def test_device_predict_small_batch_warm_cache(rng):
+    X, y = _data(rng)
+    bst = lgb.train({"objective": "regression", "num_leaves": 31,
+                     "verbosity": -1, "min_data_in_leaf": 20},
+                    lgb.Dataset(X, label=y), num_boost_round=10)
+    g = bst._gbdt
+    g._flush_pending()
+    small = X[:64]
+    # cold cache: small batches decline the device path
+    assert not hasattr(g, "_stack_cache")
+    assert g._predict_raw_device(small, 0, 10) is None
+    # a big batch warms the cache; the SAME compiled traversal then
+    # serves small batches
+    assert g._predict_raw_device(X, 0, 10) is not None
+    p_small = g._predict_raw_device(small, 0, 10)
+    assert p_small is not None
+    saved = g.device_trees
+    g.device_trees = [None] * len(saved)
+    p_host = g.predict_raw(small)
+    g.device_trees = saved
+    np.testing.assert_allclose(p_small[:, 0], np.asarray(p_host),
+                               rtol=2e-6, atol=2e-6)
