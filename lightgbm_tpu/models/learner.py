@@ -236,24 +236,16 @@ class SerialTreeLearner:
         self.mc_mode = "basic"
         if self.use_mc and config.monotone_constraints_method in (
                 "intermediate", "advanced"):
-            self.mc_mode = "intermediate"
+            # `advanced` additionally evaluates candidate children against
+            # PER-THRESHOLD bound arrays (the vectorized analog of
+            # AdvancedLeafConstraints' constraint segments,
+            # monotone_constraints.hpp:858) in the per-split children
+            # searches; leaf OUTPUT bounds (the refresh) stay the
+            # whole-box scalars in both modes, which is what the
+            # reference enforces for leaf values too.
+            self.mc_mode = config.monotone_constraints_method
             self.mono_enums = [int(i) for i in np.where(mono_used != 0)[0]]
             self.mono_signs = [int(mono_used[i]) for i in self.mono_enums]
-            if config.monotone_constraints_method == "advanced":
-                # the reference's advanced mode keeps PER-THRESHOLD
-                # constraint segments (AdvancedLeafConstraints,
-                # monotone_constraints.hpp:858) so different thresholds
-                # of one candidate feature see different bounds; the
-                # region-exact refresh here applies one [min,max] box per
-                # leaf — a sound but coarser constraint.  Say so loudly
-                # instead of silently aliasing.
-                log.warning(
-                    "monotone_constraints_method=advanced: this framework "
-                    "runs the region-exact intermediate mode (one output "
-                    "bound pair per leaf) instead of the reference's "
-                    "per-threshold constraint segments; constraints are "
-                    "enforced soundly but some splits the advanced mode "
-                    "would allow may be rejected")
         if self.F:
             self._fmeta_np[7] = mono_used
         self._fmeta = jnp.asarray(self._fmeta_np)
@@ -852,7 +844,8 @@ class SerialTreeLearner:
             return jnp.stack([accl, accr])
 
         counts = jax.lax.fori_loop(0, n_chunks, body,
-                                   jnp.zeros((2, F), jnp.int32))
+                                   self._pvary(jnp.zeros((2, F),
+                                                         jnp.int32)))
         # data/voting parallel: counts are shard-local but _sync_best is a
         # no-op there (devices rely on identical psum'd inputs to pick
         # identical splits) — the lazy penalty must therefore be GLOBAL or
@@ -1085,6 +1078,175 @@ class SerialTreeLearner:
                                        cat[:L]))
         return lm, cat
 
+    def _child_boxes(self, st, bl_oh, f_enum, is_cat, mtype, nb, dbin,
+                     dl, thr):
+        """The two children's bin-range boxes for the split being applied:
+        parent box tightened along the split feature for numerical splits
+        (categorical boxes stay whole — conservative).  Rows in the
+        default/missing bin follow default_left regardless of the
+        threshold: when that bin falls on the far side, the
+        default-direction child's box must stay un-tightened along the
+        split feature or the pairwise comparability test would wrongly
+        exclude rows the child actually contains."""
+        F = self.F
+        prow_lo = jnp.max(
+            jnp.where(bl_oh[:, None], st["leaf_lo"], 0), axis=0)
+        prow_hi = jnp.max(
+            jnp.where(bl_oh[:, None], st["leaf_hi"], 0), axis=0)
+        f1h = jax.lax.broadcasted_iota(jnp.int32, (F,), 0) == f_enum
+        tighten = f1h & ~is_cat
+        d_eff = jnp.where(mtype == 2, nb - 1, dbin)
+        has_miss = mtype != 0
+        miss_l = has_miss & dl & (d_eff > thr)
+        miss_r = has_miss & (~dl) & (d_eff <= thr)
+        l_hi = jnp.where(tighten & ~miss_l,
+                         jnp.minimum(prow_hi, thr), prow_hi)
+        r_lo = jnp.where(tighten & ~miss_r,
+                         jnp.maximum(prow_lo, thr + 1), prow_lo)
+        return prow_lo, prow_hi, l_hi, r_lo
+
+    def _advanced_bounds(self, lo_all, hi_all, vals, exist, c_lo, c_hi):
+        """Per-(feature, threshold) output bounds for ONE candidate child
+        box — the vectorized analog of the reference's advanced
+        constraint segments (AdvancedLeafConstraints::UpdateConstraints +
+        ComputeConstraintsPerThreshold, monotone_constraints.hpp:858).
+
+        For a split of this child's box on feature f at threshold t, the
+        LEFT grandchild covers f-bins [c_lo[f], t] and the RIGHT
+        (t+1, c_hi[f]]; a leaf X constrains a grandchild iff X's box is
+        disjoint from the child's range along the monotone feature m,
+        overlaps it in every other feature, and overlaps the
+        grandchild's f-range.  The t-dependence is monotone in t, so
+        each bound array is a scatter of leaf outputs at box edges
+        followed by a prefix (left) / shifted-suffix (right) running
+        extremum over the bin axis.
+
+        Args:
+          lo_all/hi_all: (L, F) all leaves' bin boxes; vals: (L,) leaf
+          outputs; exist: (L,) liveness; c_lo/c_hi: (F,) this child's box.
+        Returns (cmin_l, cmax_l, cmin_r, cmax_r), each (F, BF).
+        """
+        F, BF, L = self.F, self.BF, lo_all.shape[0]
+        inter = (lo_all <= c_hi[None, :]) & (c_lo[None, :] <= hi_all)
+        miss = jnp.sum(~inter, axis=1)                    # (L,)
+        f_idx = jnp.broadcast_to(jnp.arange(F)[None, :], (L, F))
+        lo_c = jnp.clip(lo_all, 0, BF - 1)
+        hi_c = jnp.clip(hi_all, 0, BF - 1)
+        neg = jnp.float32(-jnp.inf)
+        pos = jnp.float32(jnp.inf)
+        cmin_l = jnp.full((F, BF), neg)
+        cmax_l = jnp.full((F, BF), pos)
+        cmin_r = jnp.full((F, BF), neg)
+        cmax_r = jnp.full((F, BF), pos)
+
+        def scat_max(mask, at):
+            return jnp.full((F, BF), neg).at[f_idx, at].max(
+                jnp.where(mask, vals[:, None], neg))
+
+        def scat_min(mask, at):
+            return jnp.full((F, BF), pos).at[f_idx, at].min(
+                jnp.where(mask, vals[:, None], pos))
+
+        def prefix_max(a):
+            return jax.lax.associative_scan(jnp.maximum, a, axis=1)
+
+        def prefix_min(a):
+            return jax.lax.associative_scan(jnp.minimum, a, axis=1)
+
+        def shifted_suffix_max(a):
+            # out[t] = max over b > t of a[b]
+            s = jax.lax.associative_scan(jnp.maximum, a, axis=1,
+                                         reverse=True)
+            return jnp.concatenate(
+                [s[:, 1:], jnp.full((F, 1), neg)], axis=1)
+
+        def shifted_suffix_min(a):
+            s = jax.lax.associative_scan(jnp.minimum, a, axis=1,
+                                         reverse=True)
+            return jnp.concatenate(
+                [s[:, 1:], jnp.full((F, 1), pos)], axis=1)
+
+        for m, sign in zip(self.mono_enums, self.mono_signs):
+            miss_ex_m = miss - (~inter[:, m]).astype(jnp.int32)
+            x_below = hi_all[:, m] < c_lo[m]
+            x_above = lo_all[:, m] > c_hi[m]
+            # X whose outputs FLOOR this child (lower set) / CAP it
+            lower = (x_below if sign > 0 else x_above) & exist
+            upper = (x_above if sign > 0 else x_below) & exist
+
+            # --- split feature f != m: X disjoint along m vs the FULL
+            # child range, overlap in every feature except m and f, and
+            # f-range overlap with the grandchild's shrunken f-range
+            ok_f = (miss_ex_m[:, None]
+                    - (~inter).astype(jnp.int32)) == 0     # (L, F)
+            not_m = jnp.arange(F)[None, :] != m
+            base_l = ok_f & not_m & (hi_all >= c_lo[None, :])
+            base_r = ok_f & not_m & (lo_all <= c_hi[None, :])
+            # left grandchild [c_lo, t]: applies once t >= X.lo[f]
+            cmin_l = jnp.maximum(cmin_l, prefix_max(
+                scat_max(base_l & lower[:, None], lo_c)))
+            cmax_l = jnp.minimum(cmax_l, prefix_min(
+                scat_min(base_l & upper[:, None], lo_c)))
+            # right grandchild (t, c_hi]: applies while t < X.hi[f]
+            cmin_r = jnp.maximum(cmin_r, shifted_suffix_max(
+                scat_max(base_r & lower[:, None], hi_c)))
+            cmax_r = jnp.minimum(cmax_r, shifted_suffix_min(
+                scat_min(base_r & upper[:, None], hi_c)))
+
+            # --- split ON m itself (the reference's inner-feature case):
+            # the grandchild's m-range shrinks, so disjointness is judged
+            # against it; only overlap-except-m is required of X
+            ok_m = (miss_ex_m == 0) & exist
+            onec = (jnp.arange(F) == m).astype(jnp.float32)[:, None]
+            # left grandchild [c_lo[m], t]:
+            #   X above it iff X.lo[m] > t  (bound fades as t grows)
+            #   X below it iff X.hi[m] < c_lo[m]  (t-independent)
+            above_l = shifted_suffix_max(
+                scat_max((ok_m & ~x_below)[:, None]
+                         & (jnp.arange(F)[None, :] == m), lo_c)) \
+                if sign < 0 else shifted_suffix_min(
+                scat_min((ok_m & ~x_below)[:, None]
+                         & (jnp.arange(F)[None, :] == m), lo_c))
+            below_vals_min = jnp.max(jnp.where(ok_m & x_below, vals, neg)) \
+                if sign > 0 else None
+            below_vals_max = jnp.min(jnp.where(ok_m & x_below, vals, pos)) \
+                if sign < 0 else None
+            if sign > 0:
+                # above-X caps the left grandchild; below-X floors it
+                cmax_l = jnp.minimum(cmax_l, jnp.where(
+                    onec > 0, above_l, pos))
+                cmin_l = jnp.maximum(cmin_l, jnp.where(
+                    onec > 0, below_vals_min, neg))
+            else:
+                cmin_l = jnp.maximum(cmin_l, jnp.where(
+                    onec > 0, above_l, neg))
+                cmax_l = jnp.minimum(cmax_l, jnp.where(
+                    onec > 0, below_vals_max, pos))
+            # right grandchild (t, c_hi[m]]:
+            #   X below it iff X.hi[m] <= t  (bound grows with t)
+            #   X above it iff X.lo[m] > c_hi[m]  (t-independent)
+            below_r = prefix_max(
+                scat_max((ok_m & ~x_above)[:, None]
+                         & (jnp.arange(F)[None, :] == m), hi_c)) \
+                if sign > 0 else prefix_min(
+                scat_min((ok_m & ~x_above)[:, None]
+                         & (jnp.arange(F)[None, :] == m), hi_c))
+            above_vals_max = jnp.min(jnp.where(ok_m & x_above, vals, pos)) \
+                if sign > 0 else None
+            above_vals_min = jnp.max(jnp.where(ok_m & x_above, vals, neg)) \
+                if sign < 0 else None
+            if sign > 0:
+                cmin_r = jnp.maximum(cmin_r, jnp.where(
+                    onec > 0, below_r, neg))
+                cmax_r = jnp.minimum(cmax_r, jnp.where(
+                    onec > 0, above_vals_max, pos))
+            else:
+                cmax_r = jnp.minimum(cmax_r, jnp.where(
+                    onec > 0, below_r, pos))
+                cmin_r = jnp.maximum(cmin_r, jnp.where(
+                    onec > 0, above_vals_min, neg))
+        return cmin_l, cmax_l, cmin_r, cmax_r
+
     def _leaf_best_split_voting(self, hist_local, sum_g, sum_h, cnt,
                                 local_cnt, depth, cmin, cmax, parent_out,
                                 feature_mask, feat_used=None, lazy_cnt=None,
@@ -1283,7 +1445,7 @@ class SerialTreeLearner:
             state["part_aux"] = aux0
             state["sc_aux"] = jnp.zeros_like(aux0)
 
-        if self.use_mc and self.mc_mode == "intermediate":
+        if self.use_mc and self.mc_mode in ("intermediate", "advanced"):
             # root box covers every bin of every used feature
             state["leaf_lo"] = jnp.zeros((L + 1, F), jnp.int32)
             state["leaf_hi"] = jnp.broadcast_to(
@@ -1343,6 +1505,78 @@ class SerialTreeLearner:
             # one read of the chosen leaf's packed scalars
             pcol = jax.lax.dynamic_slice(lm, (0, best_leaf), (NLF, 1))[:, 0]
 
+            adv_cat_set = None
+            if self.use_mc and self.mc_mode == "advanced":
+                # re-search the CHOSEN leaf with per-threshold bounds
+                # before executing its split: the stored (refresh) search
+                # used whole-box scalars, which both clamps child outputs
+                # and can reject splits the advanced segments allow.
+                # Leaf SELECTION keeps the conservative stored gains (one
+                # advanced search per executed split keeps the cost
+                # linear; the reference's advanced mode is similarly the
+                # slow path).
+                bl1 = jax.lax.iota(jnp.int32, L + 1) == best_leaf
+                y_lo = jnp.max(jnp.where(bl1[:, None], st["leaf_lo"], 0),
+                               axis=0)
+                y_hi = jnp.max(jnp.where(bl1[:, None], st["leaf_hi"], 0),
+                               axis=0)
+                ab = self._advanced_bounds(
+                    st["leaf_lo"][:L], st["leaf_hi"][:L],
+                    lm[LM_VALUE, :L],
+                    jax.lax.iota(jnp.int32, L) < (st["s"] + 1),
+                    y_lo, y_hi)
+                # the advanced arrays already encode every comparable
+                # leaf; the leaf's own whole-box scalars (LM_CMIN/CMAX)
+                # bound its VALUE, not its children, and folding them in
+                # would collapse advanced back to intermediate
+                cmin_t = (ab[0], ab[2])
+                cmax_t = (ab[1], ab[3])
+                maskY = feature_mask
+                if "leaf_fmask" in st:
+                    maskY = maskY & jnp.any(
+                        st["leaf_fmask"] & bl1[:, None], axis=0)
+                adv_extra = ()
+                if self.cegb_lazy is not None:
+                    # cegb-lazy counts are not re-derived here (same
+                    # stance as the constraint refresh)
+                    adv_extra = (jnp.zeros((2, F), jnp.int32),)
+                if self.extra_trees:
+                    adv_extra = adv_extra + (self._rand_bins(
+                        jax.random.fold_in(
+                            jax.random.PRNGKey(self.extra_seed ^ 0x51AD),
+                            st["s"])),)
+                adv = self._sync_best(self._leaf_best_split(
+                    st["hist"][best_leaf], pcol[LM_SUM_G],
+                    pcol[LM_SUM_H], _f2i(pcol[LM_CNT_G]),
+                    _f2i(pcol[LM_CNT]), _f2i(pcol[LM_DEPTH]),
+                    cmin_t, cmax_t, pcol[LM_VALUE], maskY,
+                    st["feat_used"], *adv_extra))
+                pcol = pcol.at[LM_BGAIN].set(adv.gain) \
+                    .at[LM_BFEAT].set(_i2f(adv.feature)) \
+                    .at[LM_BTHR].set(_i2f(adv.threshold)) \
+                    .at[LM_BDL].set(adv.default_left.astype(jnp.float32)) \
+                    .at[LM_BLCNT].set(_i2f(adv.left_count)) \
+                    .at[LM_BRCNT].set(_i2f(adv.right_count)) \
+                    .at[LM_BLSG].set(adv.left_sum_g) \
+                    .at[LM_BLSH].set(adv.left_sum_h) \
+                    .at[LM_BRSG].set(adv.right_sum_g) \
+                    .at[LM_BRSH].set(adv.right_sum_h) \
+                    .at[LM_BLOUT].set(adv.left_output) \
+                    .at[LM_BROUT].set(adv.right_output) \
+                    .at[LM_BISCAT].set(adv.is_cat.astype(jnp.float32))
+                if self.has_categorical:
+                    adv_cat_set = adv.cat_set
+                gain = jnp.where(forced_ok, gain, adv.gain)
+                valid = forced_ok | ((gain > 0) & ~skip_pending)
+                # persist the advanced gain into the leafmat: when the
+                # re-search REJECTS a split the stored (conservative)
+                # positive gain would re-select this leaf forever; the
+                # write also keeps future leaf selection on the advanced
+                # basis.  (Lane-dynamic column write — the fast pattern.)
+                lm = jnp.where(forced_ok, lm,
+                               lm.at[LM_BGAIN, best_leaf].set(adv.gain))
+                st = {**st, "leafmat": lm}
+
             if True:
                 s = st["s"]
                 new_leaf = s + 1
@@ -1359,8 +1593,9 @@ class SerialTreeLearner:
                 # measured; the masked forms are plain VPU passes)
                 bl_oh = jax.lax.iota(jnp.int32, L + 1) == best_leaf
                 if self.has_categorical:
-                    cat_set = jnp.any(st["best_cat_set"] & bl_oh[:, None],
-                                      axis=0)
+                    cat_set = (adv_cat_set if adv_cat_set is not None else
+                               jnp.any(st["best_cat_set"] & bl_oh[:, None],
+                                       axis=0))
                 else:
                     cat_set = jnp.zeros((1,), jnp.bool_)
                 if forced_info is not None:
@@ -1574,14 +1809,61 @@ class SerialTreeLearner:
                         [head_r, tile[1, :13],
                          _i2f(forced_r)[None]])
                 else:
+                    if self.use_mc and self.mc_mode in ("intermediate",
+                                                        "advanced"):
+                        child_boxes = self._child_boxes(
+                            st, bl_oh, f_enum, is_cat, mtype, nb, dbin,
+                            dl, thr)
+                    if self.use_mc and self.mc_mode == "advanced":
+                        # per-threshold children bounds (the reference's
+                        # AdvancedLeafConstraints segments) for the TWO
+                        # candidate children, folded with their scalar
+                        # (basic + refresh) bounds
+                        prow_lo, prow_hi, l_hi_box, r_lo_box = child_boxes
+                        lo_all = st["leaf_lo"][:L]
+                        hi_all = st["leaf_hi"][:L]
+                        vals_all = lm[LM_VALUE, :L]
+                        exist_l = jax.lax.iota(jnp.int32, L) < (s + 1)
+                        abl = self._advanced_bounds(
+                            lo_all, hi_all, vals_all, exist_l,
+                            prow_lo, l_hi_box)
+                        abr = self._advanced_bounds(
+                            lo_all, hi_all, vals_all, exist_l,
+                            r_lo_box, prow_hi)
+                        # fold ONLY the sibling mid-refinement (the
+                        # reference's BasicLeafConstraints::Update for the
+                        # split just applied); the parent's whole-box
+                        # scalars would collapse advanced to intermediate
+                        mid_v = (lout + rout) * 0.5
+                        num_sp = ~is_cat
+                        lmin_m = jnp.where(num_sp & (mono_f < 0), mid_v,
+                                           -jnp.inf)
+                        lmax_m = jnp.where(num_sp & (mono_f > 0), mid_v,
+                                           jnp.inf)
+                        rmin_m = jnp.where(num_sp & (mono_f > 0), mid_v,
+                                           -jnp.inf)
+                        rmax_m = jnp.where(num_sp & (mono_f < 0), mid_v,
+                                           jnp.inf)
+                        cmin_arg = (
+                            jnp.stack([jnp.maximum(abl[0], lmin_m),
+                                       jnp.maximum(abr[0], rmin_m)]),
+                            jnp.stack([jnp.maximum(abl[2], lmin_m),
+                                       jnp.maximum(abr[2], rmin_m)]))
+                        cmax_arg = (
+                            jnp.stack([jnp.minimum(abl[1], lmax_m),
+                                       jnp.minimum(abr[1], rmax_m)]),
+                            jnp.stack([jnp.minimum(abl[3], lmax_m),
+                                       jnp.minimum(abr[3], rmax_m)]))
+                    else:
+                        cmin_arg = jnp.stack([l_cmin, r_cmin])
+                        cmax_arg = jnp.stack([l_cmax, r_cmax])
                     both = self._best_split_vmapped(
                         jnp.stack([hist_left, hist_right]),
                         jnp.stack([lsg, rsg]), jnp.stack([lsh, rsh]),
                         jnp.stack([left_cnt_g, right_cnt_g]),
                         jnp.stack([left_cnt, right_cnt]),
                         jnp.stack([depth_child, depth_child]),
-                        jnp.stack([l_cmin, r_cmin]),
-                        jnp.stack([l_cmax, r_cmax]),
+                        cmin_arg, cmax_arg,
                         jnp.stack([lout, rout]),
                         jnp.stack([mask_l, mask_r]), feat_used_new,
                         *lazy_pair)
@@ -1626,38 +1908,16 @@ class SerialTreeLearner:
                                   best_r.cat_set[None, :],
                                   st["best_cat_set"]))
                     upd["best_cat_set"] = new_cat
-                if (self.use_mc and self.mc_mode == "intermediate"
+                if (self.use_mc and self.mc_mode in ("intermediate", "advanced")
                         and "leaf_fmask" in st):
                     upd["leaf_fmask"] = jnp.where(
                         (iot_l1 == wr_a)[:, None], mask_l[None, :],
                         jnp.where((iot_l1 == wr_b)[:, None],
                                   mask_r[None, :], st["leaf_fmask"]))
-                if self.use_mc and self.mc_mode == "intermediate":
-                    # per-leaf bin-range boxes: children inherit the parent
-                    # box, tightened along the split feature for numerical
-                    # splits (categorical boxes stay whole — conservative)
-                    prow_lo = jnp.max(
-                        jnp.where(bl_oh[:, None], st["leaf_lo"], 0), axis=0)
-                    prow_hi = jnp.max(
-                        jnp.where(bl_oh[:, None], st["leaf_hi"], 0), axis=0)
-                    f1h = jax.lax.broadcasted_iota(
-                        jnp.int32, (F,), 0) == f_enum
-                    tighten = f1h & ~is_cat
-                    # rows in the default/missing bin follow default_left
-                    # regardless of the threshold: when that bin falls on
-                    # the far side, the default-direction child's box must
-                    # stay un-tightened along the split feature or the
-                    # pairwise comparability test would wrongly exclude
-                    # rows the child actually contains
-                    d_eff = jnp.where(mtype == 2, nb - 1, dbin)
-                    has_miss = mtype != 0
-                    miss_l = has_miss & dl & (d_eff > thr)
-                    miss_r = has_miss & (~dl) & (d_eff <= thr)
-                    l_hi = jnp.where(tighten & ~miss_l,
-                                     jnp.minimum(prow_hi, thr), prow_hi)
-                    r_lo = jnp.where(tighten & ~miss_r,
-                                     jnp.maximum(prow_lo, thr + 1),
-                                     prow_lo)
+                if self.use_mc and self.mc_mode in ("intermediate", "advanced"):
+                    # per-leaf bin-range boxes (computed once before the
+                    # children search — see _child_boxes)
+                    prow_lo, prow_hi, l_hi, r_lo = child_boxes
                     leaf_lo = jnp.where(
                         (iot_l1 == wr_a)[:, None], prow_lo[None, :],
                         jnp.where((iot_l1 == wr_b)[:, None], r_lo[None, :],

@@ -531,6 +531,21 @@ def find_best_split(feat_hist: jnp.ndarray, ctx: SplitContext,
 
     use_mc = monotone is not None
     use_smooth = path_smooth > 0.0
+    # advanced monotone mode passes PER-SIDE, per-(feature, threshold)
+    # bound arrays ((cmin_left, cmin_right) tuples of (F, BF)); the
+    # intermediate/basic modes pass scalars shared by both children
+    # (monotone_constraints.hpp:858 AdvancedLeafConstraints vs :488)
+    if isinstance(cmin, tuple):
+        cmin_l, cmin_r = cmin
+        cmax_l, cmax_r = cmax
+        # the parent's own (whole-box) bounds are the loosest per-side
+        # bounds: min over thresholds of each side's bound envelope
+        cmin_p = jnp.minimum(jnp.min(cmin_l), jnp.min(cmin_r))
+        cmax_p = jnp.maximum(jnp.max(cmax_l), jnp.max(cmax_r))
+    else:
+        cmin_l = cmin_r = cmin
+        cmax_l = cmax_r = cmax
+        cmin_p, cmax_p = cmin, cmax
     if use_smooth:
         # reference: USE_SMOOTHING arm of FindBestThresholdSequentially —
         # gain shift is evaluated at the leaf's CURRENT output
@@ -538,14 +553,15 @@ def find_best_split(feat_hist: jnp.ndarray, ctx: SplitContext,
                                              parent_output)
     elif use_mc:
         parent_out_est = jnp.clip(
-            leaf_output(sum_g, sum_h_tot, l1, l2, max_delta_step), cmin, cmax)
+            leaf_output(sum_g, sum_h_tot, l1, l2, max_delta_step),
+            cmin_p, cmax_p)
         gain_shift = _leaf_gain_given_output(sum_g, sum_h_tot, l1, l2,
                                              parent_out_est)
     else:
         gain_shift = leaf_gain(sum_g, sum_h_tot, l1, l2, max_delta_step)
     min_gain_shift = gain_shift + min_gain_to_split
 
-    def child_output(g, h, c):
+    def child_output(g, h, c, side):
         out = leaf_output(g, h, l1, l2, max_delta_step)
         if use_smooth:
             # reference: CalculateSplittedLeafOutput smoothing arm
@@ -554,15 +570,16 @@ def find_best_split(feat_hist: jnp.ndarray, ctx: SplitContext,
             f = c.astype(jnp.float32) / path_smooth
             out = out * f / (f + 1.0) + parent_output / (f + 1.0)
         if use_mc:
-            out = jnp.clip(out, cmin, cmax)
+            out = jnp.clip(out, cmin_l if side == "l" else cmin_r,
+                           cmax_l if side == "l" else cmax_r)
         return out
 
     def side_gain(gl, hl, gr, hr, cl, cr):
         if not (use_mc or use_smooth):
             return (leaf_gain(gl, hl, l1, l2, max_delta_step) +
                     leaf_gain(gr, hr, l1, l2, max_delta_step))
-        lo = child_output(gl, hl, cl)
-        ro = child_output(gr, hr, cr)
+        lo = child_output(gl, hl, cl, "l")
+        ro = child_output(gr, hr, cr, "r")
         g = (_leaf_gain_given_output(gl, hl, l1, l2, lo) +
              _leaf_gain_given_output(gr, hr, l1, l2, ro))
         if use_mc:
@@ -634,8 +651,8 @@ def find_best_split(feat_hist: jnp.ndarray, ctx: SplitContext,
                 cat_params["max_cat_threshold"], cat_params["cat_l2"],
                 cat_params["cat_smooth"], cat_params["max_cat_to_onehot"],
                 cat_params["min_data_per_group"],
-                cmin=cmin if use_mc else None,
-                cmax=cmax if use_mc else None)
+                cmin=cmin_p if use_mc else None,
+                cmax=cmax_p if use_mc else None)
         if feature_mask is not None:
             gain_c = jnp.where(feature_mask, gain_c, neg)
         feat_gain = jnp.where(cat_mask, gain_c, feat_gain)
@@ -705,8 +722,18 @@ def find_best_split(feat_hist: jnp.ndarray, ctx: SplitContext,
         lout_best = lout_best * fl / (fl + 1.0) + parent_output / (fl + 1.0)
         rout_best = rout_best * fr / (fr + 1.0) + parent_output / (fr + 1.0)
     if use_mc:
-        lout_best = jnp.clip(lout_best, cmin, cmax)
-        rout_best = jnp.clip(rout_best, cmin, cmax)
+        def _at_best(b, parent):
+            # per-threshold (F, BF) bound arrays (advanced mode) index at
+            # the chosen split; a categorical winner's best_t is leftover
+            # from the masked numerical scan, so categorical splits use
+            # the whole-box parent bound instead.  Scalars pass through.
+            if getattr(b, "ndim", 0) != 2:
+                return b
+            return jnp.where(is_cat, parent, b[best_f, best_t])
+        lout_best = jnp.clip(lout_best, _at_best(cmin_l, cmin_p),
+                             _at_best(cmax_l, cmax_p))
+        rout_best = jnp.clip(rout_best, _at_best(cmin_r, cmin_p),
+                             _at_best(cmax_r, cmax_p))
 
     best = BestSplit(
         gain=jnp.where(best_gain > neg, best_gain - min_gain_shift, neg),

@@ -102,29 +102,76 @@ def test_monotone_penalty_discourages_splits():
     assert imp[2] >= imp[1]
 
 
-def test_advanced_mode_warns_and_enforces(rng):
-    """`advanced` runs the region-exact refresh with a loud downgrade
-    warning (reference: AdvancedLeafConstraints per-threshold segments,
-    monotone_constraints.hpp:858)."""
-    from lightgbm_tpu.utils import log as _log
+def test_advanced_mode_enforces(rng):
+    """`advanced` evaluates candidate children against per-threshold
+    bound segments (reference: AdvancedLeafConstraints,
+    monotone_constraints.hpp:858) and still enforces monotonicity."""
     import lightgbm_tpu as lgb
     n = 2000
     X = rng.normal(size=(n, 4))
     y = 2 * X[:, 0] + np.sin(X[:, 1]) + 0.1 * rng.normal(size=n)
-    msgs = []
-    _log.register_callback(msgs.append)
-    try:
-        bst = lgb.train({"objective": "regression", "num_leaves": 15,
-                         "verbosity": 0, "monotone_constraints": "1,0,0,0",
-                         "monotone_constraints_method": "advanced",
-                         "metric": ""},
-                        lgb.Dataset(X, label=y), num_boost_round=10)
-    finally:
-        _log.register_callback(None)
-    assert any("advanced" in m for m in msgs)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "verbosity": -1, "monotone_constraints": "1,0,0,0",
+                     "monotone_constraints_method": "advanced",
+                     "metric": ""},
+                    lgb.Dataset(X, label=y), num_boost_round=10)
     # monotonicity holds along feature 0
     base = np.zeros((50, 4))
     base[:, 1:] = rng.normal(size=(1, 3))
     base[:, 0] = np.linspace(-2, 2, 50)
     p = bst.predict(base)
     assert np.all(np.diff(p) >= -1e-6)
+    # and advanced is never WORSE on train loss than intermediate
+    inter = lgb.train({"objective": "regression", "num_leaves": 15,
+                       "verbosity": -1,
+                       "monotone_constraints": "1,0,0,0",
+                       "monotone_constraints_method": "intermediate",
+                       "metric": ""},
+                      lgb.Dataset(X, label=y), num_boost_round=10)
+    mse_a = np.mean((bst.predict(X) - y) ** 2)
+    mse_i = np.mean((inter.predict(X) - y) ** 2)
+    assert mse_a <= mse_i * 1.05
+
+
+def test_advanced_finds_split_intermediate_clamps(tmp_path):
+    """The reference's motivating case for advanced mode
+    (monotone_constraints.hpp:858 AdvancedLeafConstraints): two upper
+    leaves with different f-ranges cap the lower leaf DIFFERENTLY per
+    threshold of a candidate split on f.  Intermediate's single scalar
+    cap (the min over both) clamps the right child's output; advanced's
+    per-threshold segments see only the overlapping upper leaf and let
+    the right child take its true value."""
+    import json
+    # 2-D grid; x0 monotone +1, x1 free.  True function (monotone in x0):
+    #   x0>=.5: 1 if x1<=.5 else 5       x0<.5: 0 if x1<=.5 else 4
+    g = np.linspace(0.05, 0.95, 10)
+    xx0, xx1 = np.meshgrid(g, g)
+    X = np.column_stack([xx0.ravel(), xx1.ravel()])
+    X = np.repeat(X, 4, axis=0)
+    y = np.where(X[:, 0] >= 0.5,
+                 np.where(X[:, 1] <= 0.5, 1.0, 5.0),
+                 np.where(X[:, 1] <= 0.5, 0.0, 4.0))
+    # force root x0@.5, then the upper branch x1@.5 — the lower branch's
+    # own x1 split is where the two modes diverge
+    forced = {"feature": 0, "threshold": 0.5,
+              "right": {"feature": 1, "threshold": 0.5}}
+    fpath = tmp_path / "forced.json"
+    fpath.write_text(json.dumps(forced))
+    out = {}
+    for mode in ("intermediate", "advanced"):
+        bst = lgb.train({"objective": "regression", "num_leaves": 5,
+                         "min_data_in_leaf": 5, "learning_rate": 1.0,
+                         "verbosity": -1,
+                         "monotone_constraints": "1,0",
+                         "monotone_constraints_method": mode,
+                         "forcedsplits_filename": str(fpath)},
+                        lgb.Dataset(X, label=y), num_boost_round=1)
+        pred = bst.predict(X)
+        out[mode] = float(np.mean((pred - y) ** 2))
+        # monotonicity in x0 must hold in BOTH modes
+        assert is_increasing(bst, X, 0, +1), mode
+    # intermediate clamps the (x0<.5, x1>.5) region to the min upper cap
+    # (1.0), a large train error; advanced recovers the true value 4.0
+    assert out["advanced"] < 0.5
+    assert out["intermediate"] > 1.0
+    assert out["advanced"] < out["intermediate"] * 0.5
