@@ -114,6 +114,36 @@ def main():
         jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
     import lightgbm_tpu as lgb
 
+    # kernel self-check FIRST, in a subprocess, before this process
+    # touches the backend (single-host TPUs enforce single-process
+    # ownership): the Pallas partition/search kernels' bug class (Mosaic
+    # addressing / DMA windows, e.g. the round-3 pass-2 OOB) is
+    # invisible to the CPU suite, so the bench — the one thing that
+    # ALWAYS runs on TPU — guards it.  The child prints SKIP and exits 0
+    # off-TPU; skip entirely with BENCH_SKIP_SELFCHECK=1.
+    if not os.environ.get("BENCH_SKIP_SELFCHECK"):
+        import subprocess
+        here = os.path.dirname(os.path.abspath(__file__))
+        try:
+            r = subprocess.run([sys.executable,
+                                os.path.join(here, "tpu_selfcheck.py")],
+                               capture_output=True, timeout=1200)
+            out = r.stdout.decode()
+            tail = out[-400:] + r.stderr.decode()[-400:]
+            ok = r.returncode == 0 and ("ALL OK" in out or "SKIP" in out)
+        except subprocess.TimeoutExpired as exc:
+            tail = "tpu_selfcheck timed out after 1200s: " + \
+                str(exc.stdout or b"")[-400:]
+            ok = False
+        if not ok:
+            print(json.dumps({
+                "metric": "tpu_selfcheck", "value": 0.0,
+                "unit": "failed", "vs_baseline": 0.0,
+                "detail": {"tail": tail}}))
+            return
+        print("tpu_selfcheck:", "ALL OK" if "ALL OK" in tail else "skip",
+              file=sys.stderr)
+
     tunnel = _dispatch_probe()
     blocks, warm = _train_blocks(lgb, ROWS, ITERS, REPEATS)
     per_iter = float(np.median(blocks))

@@ -95,3 +95,112 @@ def test_continuation_x_multiclass_x_valid(rng):
     assert p.shape == (1000, 3)
     acc = float((np.argmax(p, axis=1) == y[2000:]).mean())
     assert acc > 0.7
+
+
+def test_efb_x_distributed(rng):
+    """EFB-bundled sparse features under the mesh data-parallel learner
+    must match the serial learner (round-3's categorical x sharded bug
+    class: combinations are where bugs land)."""
+    n = 4000
+    # mutually-exclusive sparse columns (exactly one nonzero per row):
+    # EFB's zero-conflict rule bundles them into one group
+    # low-cardinality values keep each feature's bin count small enough
+    # for the 256-bin-per-group cap the TPU layout imposes on bundles
+    Xs = np.zeros((n, 4))
+    kcol = rng.randint(0, 4, size=n)
+    Xs[np.arange(n), kcol] = rng.randint(1, 40, size=n).astype(float)
+    Xd = rng.normal(size=(n, 2))
+    X = np.column_stack([Xs, Xd])
+    y = Xs[:, 0] * 2.0 + Xd[:, 0] + 0.1 * rng.normal(size=n)
+    base = {"objective": "regression", "num_leaves": 15, "verbosity": -1,
+            "min_data_in_leaf": 20, "metric": ""}
+    ds = lgb.Dataset(X, label=y)
+    ds.construct(base)
+    assert any(len(g.feature_indices) > 1 for g in ds._inner.groups), \
+        "fixture must actually bundle"
+    serial = lgb.train(base, lgb.Dataset(X, label=y), num_boost_round=8)
+    dist = lgb.train(dict(base, tree_learner="data"),
+                     lgb.Dataset(X, label=y), num_boost_round=8)
+    np.testing.assert_allclose(serial.predict(X[:500]),
+                               dist.predict(X[:500]), rtol=1e-4, atol=1e-5)
+
+
+def test_voting_x_quantized(rng):
+    n = 4000
+    X = rng.normal(size=(n, 8))
+    y = X[:, 0] * 2 + np.sin(X[:, 1] * 2) + 0.2 * rng.normal(size=n)
+    base = {"objective": "regression", "num_leaves": 15, "verbosity": -1,
+            "use_quantized_grad": True, "num_grad_quant_bins": 16,
+            "metric": ""}
+    serial = lgb.train(base, lgb.Dataset(X, label=y), num_boost_round=10)
+    voting = lgb.train(dict(base, tree_learner="voting", top_k=4),
+                       lgb.Dataset(X, label=y), num_boost_round=10)
+    # voting elects a feature subset per leaf, so trees may differ from
+    # serial; quality must stay comparable
+    mse_s = float(np.mean((serial.predict(X) - y) ** 2))
+    mse_v = float(np.mean((voting.predict(X) - y) ** 2))
+    assert mse_v < max(2.0 * mse_s, 0.3 * np.var(y))
+
+
+def test_forced_splits_x_categorical(rng, tmp_path):
+    n = 3000
+    Xc = rng.randint(0, 6, size=n).astype(float)
+    Xn = rng.normal(size=(n, 3))
+    X = np.column_stack([Xc, Xn])
+    y = ((Xc == 2) | (Xc == 4)) * 2.0 + Xn[:, 0] + 0.1 * rng.normal(size=n)
+    forced = {"feature": 1, "threshold": 0.0}
+    fp = tmp_path / "forced.json"
+    fp.write_text(json.dumps(forced))
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "verbosity": -1, "min_data_in_leaf": 10,
+                     "min_data_per_group": 5, "metric": "",
+                     "forcedsplits_filename": str(fp)},
+                    lgb.Dataset(X, label=y, categorical_feature=[0]),
+                    num_boost_round=8)
+    model = bst.dump_model()
+    cats = 0
+    for t in model["tree_info"]:
+        root = t["tree_structure"]
+        assert root["split_feature"] == 1          # forced root
+        def walk(node):
+            nonlocal cats
+            if "split_feature" in node:
+                if node.get("decision_type") == "==":
+                    cats += 1
+                walk(node["left_child"]); walk(node["right_child"])
+        walk(root)
+    assert cats > 0, "categorical splits must appear under the forced root"
+    mse = float(np.mean((bst.predict(X) - y) ** 2))
+    assert mse < 0.3 * np.var(y)
+
+
+def test_continuation_x_dart_x_valid(rng, tmp_path):
+    """init_model continuation of a DART model with a valid set: the
+    continued booster must extend the loaded trees, keep evaluating the
+    valid set, and improve on it."""
+    n = 3000
+    X = rng.normal(size=(n, 6))
+    y = X[:, 0] * 2 + np.sin(X[:, 1]) + 0.2 * rng.normal(size=n)
+    Xv = rng.normal(size=(800, 6))
+    yv = Xv[:, 0] * 2 + np.sin(Xv[:, 1]) + 0.2 * rng.normal(size=800)
+    params = {"objective": "regression", "boosting": "dart",
+              "num_leaves": 15, "verbosity": -1, "drop_rate": 0.2,
+              "metric": "l2"}
+    ds = lgb.Dataset(X, label=y)
+    b1 = lgb.train(params, ds, num_boost_round=8)
+    mpath = tmp_path / "dart.txt"
+    b1.save_model(str(mpath))
+    evals = {}
+    from lightgbm_tpu.callback import record_evaluation
+    ds2 = lgb.Dataset(X, label=y)
+    vs = lgb.Dataset(Xv, label=yv, reference=ds2)
+    b2 = lgb.train(params, ds2, num_boost_round=8,
+                   valid_sets=[vs], valid_names=["v"],
+                   init_model=str(mpath),
+                   callbacks=[record_evaluation(evals)])
+    assert b2.num_trees() > b1.num_trees()
+    curve = evals["v"]["l2"]
+    assert len(curve) == 8
+    mse_cont = float(np.mean((b2.predict(Xv) - yv) ** 2))
+    mse_init = float(np.mean((b1.predict(Xv) - yv) ** 2))
+    assert mse_cont <= mse_init * 1.05
