@@ -1506,6 +1506,7 @@ class SerialTreeLearner:
             pcol = jax.lax.dynamic_slice(lm, (0, best_leaf), (NLF, 1))[:, 0]
 
             adv_cat_set = None
+            adv_reject = jnp.bool_(False)
             if self.use_mc and self.mc_mode == "advanced":
                 # re-search the CHOSEN leaf with per-threshold bounds
                 # before executing its split: the stored (refresh) search
@@ -1566,6 +1567,7 @@ class SerialTreeLearner:
                     .at[LM_BISCAT].set(adv.is_cat.astype(jnp.float32))
                 if self.has_categorical:
                     adv_cat_set = adv.cat_set
+                stored_gain = gain
                 gain = jnp.where(forced_ok, gain, adv.gain)
                 valid = forced_ok | ((gain > 0) & ~skip_pending)
                 # persist the advanced gain into the leafmat: when the
@@ -1576,6 +1578,11 @@ class SerialTreeLearner:
                 lm = jnp.where(forced_ok, lm,
                                lm.at[LM_BGAIN, best_leaf].set(adv.gain))
                 st = {**st, "leafmat": lm}
+                # a rejection consumes NO split step and must not end
+                # the tree: other leaves may still carry positive gains
+                # (their next argmax sees the demoted gain just written)
+                adv_reject = ~forced_ok & ~skip_pending \
+                    & (adv.gain <= 0) & (stored_gain > 0)
 
             if True:
                 s = st["s"]
@@ -1891,7 +1898,7 @@ class SerialTreeLearner:
                 iot_l1 = jax.lax.iota(jnp.int32, L + 1)
                 upd.update({
                     "s": s + valid.astype(jnp.int32),
-                    "done": ~valid & ~skip_pending,
+                    "done": ~valid & ~skip_pending & ~adv_reject,
                     "hist": hist,
                     "leafmat": lm2,
                     "feat_used": jnp.where(valid, feat_used_new,
