@@ -882,6 +882,14 @@ class GBDT:
         lr_ = sb.learner
         obj = self.objective
         cfg = self.config
+        if jax.process_count() > 1:
+            # multi-process meshes keep the eager path: the fused state
+            # layout indexes rows by single-process global ids and pads
+            # host-side blocks to the full mesh width, neither of which
+            # holds for rank-sharded processes (the 2-process training
+            # equality test pins the eager path's correctness)
+            self._fused_sharded_reason = "multi-process mesh (eager path)"
+            return
         if (type(obj).__dict__.get("gradients_from_payload") is None
                 or obj.gradient_payload() is None):
             self._fused_sharded_reason = \
