@@ -30,7 +30,7 @@ ROWS2 = int(os.environ.get("BENCH_ROWS2", 1_000_000))
 FEATURES = 28
 NUM_LEAVES = int(os.environ.get("BENCH_LEAVES", 255))
 ITERS = int(os.environ.get("BENCH_ITERS", 20))
-REPEATS = int(os.environ.get("BENCH_REPEATS", 3))
+REPEATS = int(os.environ.get("BENCH_REPEATS", 5))
 BASELINE_WALL_S = 130.094
 BASELINE_ROWS = 10_500_000
 BASELINE_ITERS = 500
@@ -156,9 +156,12 @@ def main():
     blocks, warm = _train_blocks(lgb, ROWS, ITERS, REPEATS)
     per_iter = float(np.median(blocks))
 
+    mad = float(np.median(np.abs(np.asarray(blocks) - per_iter)))
     detail = {
         "iters_per_block": ITERS,
         "blocks_s_per_iter": [round(b, 4) for b in blocks],
+        "mad_s_per_iter": round(mad, 5),
+        "mad_pct": round(100.0 * mad / per_iter, 2),
         "spread_pct": round(100.0 * (max(blocks) - min(blocks))
                             / per_iter, 1),
         "warmup_compile_s": round(warm, 2),

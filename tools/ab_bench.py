@@ -1,0 +1,140 @@
+"""Interleaved A/B benchmark harness (round-5 measurement discipline).
+
+The TPU is attached through a tunnel whose dispatch latency drifts by
++/-6% day-to-day (PERF.md "tunnel health note"), which is larger than
+most single-change wins.  Comparing two runs taken at different times is
+therefore blind below ~15 ms/iter.  This harness removes the
+between-attachment variance by interleaving the two arms WITHIN one
+attachment:
+
+    settle, A, B, A, B, ... (>= 5 blocks per arm), one completion
+    barrier per block
+
+and reporting median + MAD per arm plus the paired per-position deltas
+(the tunnel drift is slow, so adjacent A/B blocks see the same tunnel
+state and the PAIRED delta cancels it).
+
+Arms differ by booster params only: land a perf change behind a config
+flag, A/B it here, then flip the default.  Usage:
+
+    python tools/ab_bench.py --rows 1000000 --iters 20 --blocks 5 \
+        --b tpu_row_chunk=8192
+
+With no --b overrides the two arms run identical code — the self-test
+that the harness resolves below 2% (VERDICT round-4 ask #2).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _parse_overrides(items):
+    out = {}
+    for it in items or []:
+        k, v = it.split("=", 1)
+        try:
+            v = int(v)
+        except ValueError:
+            try:
+                v = float(v)
+            except ValueError:
+                pass
+        out[k] = v
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=1_000_000)
+    ap.add_argument("--features", type=int, default=28)
+    ap.add_argument("--leaves", type=int, default=255)
+    ap.add_argument("--iters", type=int, default=20,
+                    help="boosting iterations per timed block")
+    ap.add_argument("--blocks", type=int, default=5,
+                    help="timed blocks PER ARM (interleaved)")
+    ap.add_argument("--settle", type=int, default=5)
+    ap.add_argument("--a", action="append", metavar="K=V",
+                    help="param override for arm A (repeatable)")
+    ap.add_argument("--b", action="append", metavar="K=V",
+                    help="param override for arm B (repeatable)")
+    args = ap.parse_args()
+
+    import jax.numpy as jnp
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(7)
+    X = rng.normal(size=(args.rows, args.features)).astype(np.float32)
+    w = rng.normal(size=args.features)
+    y = ((X.dot(w) * 0.5 + rng.normal(size=args.rows)) > 0).astype(np.float32)
+
+    base = {"objective": "binary", "num_leaves": args.leaves,
+            "learning_rate": 0.1, "max_bin": 255, "verbosity": -1,
+            "metric": ""}
+    pa = {**base, **_parse_overrides(args.a)}
+    pb = {**base, **_parse_overrides(args.b)}
+
+    ds = lgb.Dataset(X, label=y)
+    ds.construct(pa)
+    boosters = {"A": lgb.Booster(params=pa, train_set=ds),
+                "B": lgb.Booster(params=pb, train_set=ds)}
+
+    def sync(bst):
+        # host materialization: the only reliable completion barrier on
+        # remote-attached TPUs (PERF.md measurement pitfalls)
+        return float(jnp.sum(bst._gbdt.scores))
+
+    # warm both compiles, then settle both arms
+    for name in ("A", "B"):
+        boosters[name].update()
+        sync(boosters[name])
+    for _ in range(args.settle):
+        for name in ("A", "B"):
+            boosters[name].update()
+    for name in ("A", "B"):
+        sync(boosters[name])
+
+    times = {"A": [], "B": []}
+    for _ in range(args.blocks):
+        for name in ("A", "B"):
+            bst = boosters[name]
+            t0 = time.time()
+            for _ in range(args.iters):
+                bst.update()
+            sync(bst)
+            times[name].append((time.time() - t0) / args.iters)
+
+    def stats(v):
+        v = np.asarray(v)
+        med = float(np.median(v))
+        mad = float(np.median(np.abs(v - med)))
+        return {"median_s_per_iter": round(med, 5),
+                "mad_s_per_iter": round(mad, 5),
+                "mad_pct": round(100 * mad / med, 2),
+                "blocks": [round(x, 5) for x in v]}
+
+    sa, sb = stats(times["A"]), stats(times["B"])
+    paired = np.asarray(times["B"]) - np.asarray(times["A"])
+    delta_med = float(np.median(paired))
+    report = {
+        "rows": args.rows, "iters_per_block": args.iters,
+        "blocks_per_arm": args.blocks,
+        "a_params": _parse_overrides(args.a), "b_params": _parse_overrides(args.b),
+        "A": sa, "B": sb,
+        "paired_delta_s_per_iter": round(delta_med, 5),
+        "paired_delta_pct_of_A": round(
+            100 * delta_med / sa["median_s_per_iter"], 2),
+        "paired_delta_mad": round(float(np.median(np.abs(
+            paired - delta_med))), 5),
+    }
+    print(json.dumps(report))
+
+
+if __name__ == "__main__":
+    main()
