@@ -570,6 +570,33 @@ class Booster:
         elif num_iteration == 0:
             num_iteration = -1
         mat = _to_matrix(data, self.pandas_categorical)
+        if getattr(mat, "ndim", 2) == 1:
+            # a single row vector predicts as one sample (reference
+            # wrapper promotes 1-D input before the shape check)
+            mat = np.asarray(mat).reshape(1, -1)
+        # feature-count validation (reference: c_api Predictor checks
+        # ncol against the model's max_feature_idx; bypassed by
+        # predict_disable_shape_check, config.h predict section)
+        nf = self.num_feature()
+        if mat.ndim == 2 and mat.shape[1] != nf:
+            if not kwargs.get("predict_disable_shape_check",
+                              bool(getattr(self.config,
+                                           "predict_disable_shape_check",
+                                           False))):
+                raise LightGBMError(
+                    f"The number of features in data ({mat.shape[1]}) is "
+                    f"not the same as it was in training data ({nf}).\n"
+                    "You can set ``predict_disable_shape_check=true`` to "
+                    "discard this error, but please be aware what you are "
+                    "doing.")
+            if mat.shape[1] > nf:
+                mat = mat[:, :nf]
+            else:
+                pad = np.full((mat.shape[0], nf - mat.shape[1]), np.nan,
+                              dtype=mat.dtype if np.issubdtype(
+                                  mat.dtype, np.floating) else np.float64)
+                mat = np.concatenate([np.asarray(mat, pad.dtype), pad],
+                                     axis=1)
         if pred_leaf:
             return self._gbdt.predict_leaf_index(mat)
         if pred_contrib:
@@ -625,12 +652,19 @@ class Booster:
         body = "\n".join(lines) + "\n"
         body += "\n".join(tree_strs)
         body += "end of trees\n"
-        imp = self.feature_importance(importance_type="split")
+        # saved_feature_importance_type: 0 = split counts, 1 = total gain
+        # (reference: GBDT::FeatureImportance via config.h
+        # saved_feature_importance_type, tree.cpp DumpModel)
+        imp_type = ("gain" if int(getattr(self.config,
+                                          "saved_feature_importance_type",
+                                          0) or 0) == 1 else "split")
+        imp = self.feature_importance(importance_type=imp_type)
         pairs = [(imp[i], g.feature_names[i]) for i in range(len(imp)) if imp[i] > 0]
         pairs.sort(key=lambda x: -x[0])
         body += "\nfeature_importances:\n"
         for v, n in pairs:
-            body += f"{n}={int(v)}\n"
+            body += (f"{n}={int(v)}\n" if imp_type == "split"
+                     else f"{n}={float(v):g}\n")
         body += "\nparameters:\n" + self.config.save_to_string() + "\nend of parameters\n"
         if self.pandas_categorical is not None:
             # final line, like the reference Python wrapper (basic.py

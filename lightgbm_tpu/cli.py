@@ -160,6 +160,18 @@ class Application:
 
             _snapshot.order = 100
             callbacks = [_snapshot]
+        if cfg.is_provide_training_metric:
+            # reference: training_metric adds the train set to the
+            # evaluated sets (Application::LoadData train_metric path)
+            valid_sets = [train_set] + valid_sets
+            valid_names = ["training"] + valid_names
+        if valid_sets or cfg.is_provide_training_metric:
+            # periodic metric output every metric_freq iterations
+            # (reference: Application::Train -> Boosting::Train
+            # OutputMetric cadence, config.h metric_freq)
+            from .callback import log_evaluation
+            callbacks = (callbacks or []) + [
+                log_evaluation(period=max(int(cfg.metric_freq), 1))]
         booster = _train(dict(self.raw_params), train_set,
                          num_boost_round=cfg.num_iterations,
                          valid_sets=valid_sets or None,
@@ -183,7 +195,10 @@ class Application:
             loaded.X, raw_score=bool(cfg.predict_raw_score),
             pred_leaf=bool(cfg.predict_leaf_index),
             pred_contrib=bool(cfg.predict_contrib),
+            start_iteration=int(cfg.start_iteration_predict),
             num_iteration=cfg.num_iteration_predict,
+            predict_disable_shape_check=bool(
+                cfg.predict_disable_shape_check),
             pred_early_stop=bool(cfg.pred_early_stop),
             pred_early_stop_freq=int(cfg.pred_early_stop_freq),
             pred_early_stop_margin=float(cfg.pred_early_stop_margin))

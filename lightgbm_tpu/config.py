@@ -226,6 +226,15 @@ _PARAMS: List[_Param] = [
     # --- TPU-specific (new in this framework) ---
     _p("tpu_hist_dtype", "float32", str),       # float32 | bfloat16_pair
     _p("tpu_hist_kernel", "xla", str),          # xla | pallas
+    # per-leaf histogram state: "auto" = lane-flattened state updated in
+    # place by the Pallas RMW kernel (ops/hist_state_pallas.py) when the
+    # fast serial path is active; "xla" = (L+1, G, B, 2) dynamic-slice
+    # state (the fallback and the A/B baseline)
+    _p("tpu_hist_state", "auto", str),
+    # measurement-only: duplicate one component inside the compiled tree
+    # loop with a runtime-opaque select so tools/ab_bench.py can read its
+    # IN-CONTEXT cost as the paired e2e delta ("" | "hist" | "search")
+    _p("tpu_ab_double", "", str),
     _p("tpu_partition_kernel", "pallas", str),  # pallas | xla
     # rows per partition/histogram chunk; 4096 measured best end-to-end
     # on v5e (round 3: fixed cost 15.9 -> 12.1 ms/iter vs 8192 at equal
@@ -327,6 +336,9 @@ def _check_value(param: _Param, v: Any) -> None:
         log.fatal("Parameter %s should satisfy %s, got %s", param.name, c, v)
 
 
+_WARNED_UNKNOWN: set = set()
+
+
 class Config:
     """Resolved training configuration (reference: include/LightGBM/config.h)."""
 
@@ -361,6 +373,16 @@ class Config:
             else:
                 setattr(self, p.name, p.default)
         self._post_process()
+        # reference: Config surfaces unrecognized keys instead of
+        # silently dropping them (include/LightGBM/config.h:1242
+        # "Unknown parameter: %s"); a typo'd key (num_leafs) must not
+        # train silently with defaults.  Deduped per process: one train
+        # call legitimately rebuilds Config several times (Dataset,
+        # Booster, engine) from the same raw params.
+        for k in self._unknown:
+            if k not in _WARNED_UNKNOWN:
+                _WARNED_UNKNOWN.add(k)
+                log.warning("Unknown parameter: %s", k)
 
     # -- derived state (reference: Config::Set, src/io/config.cpp) --
     def _post_process(self) -> None:

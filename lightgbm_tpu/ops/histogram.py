@@ -44,7 +44,7 @@ import numpy as np
 def leaf_hist_slice(part_bins, part_ghi, start, cnt, *,
                     num_bins: int, row_chunk: int,
                     gblock: int = 0, dtype=jnp.float32, vary=lambda x: x,
-                    num_groups: int = 0):
+                    num_groups: int = 0, flat_geom=None):
     """(G, B, 2) histogram of the contiguous partitioned rows
     [start, start+cnt) of the (G, N_pad) binned matrix with matching
     (>=2, N_pad) packed (grad, hess, ...) rows; rows beyond ``cnt``
@@ -117,6 +117,13 @@ def leaf_hist_slice(part_bins, part_ghi, start, cnt, *,
     acc = jax.lax.fori_loop(0, n_chunks, body, acc)
     per = acc.reshape(Gp, 2 * BH, 16)[:G]               # block-major == G
     per = per.reshape(G, 2, Bp)                         # b = hi*16 + lo
+    if flat_geom is not None:
+        # (8, WL) lane-flattened (2, Gf, Bf) slot for the Pallas
+        # hist-state RMW kernel (ops/hist_state_pallas.py)
+        Gf, Bf, WL = flat_geom
+        jg = jnp.moveaxis(per, 1, 0)                    # (2, G, Bp)
+        jg = jnp.pad(jg, ((0, 0), (0, Gf - G), (0, Bf - Bp)))
+        return jg.reshape(8, WL)
     return jnp.moveaxis(per[:, :, :B], 1, 2)            # (G, B, 2)
 
 

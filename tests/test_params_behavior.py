@@ -116,3 +116,67 @@ def test_bagging_by_query_warns(rng):
     finally:
         _log.register_callback(None)
     assert any("bagging_by_query" in m for m in msgs)
+
+
+def test_unknown_parameter_warns(rng, capsys):
+    """Unknown keys must surface, not silently drop (reference:
+    config.h:1242 "Unknown parameter: %s"; round-4 verdict item 2)."""
+    from lightgbm_tpu.config import Config, _WARNED_UNKNOWN
+    from lightgbm_tpu.utils import log
+    _WARNED_UNKNOWN.clear()            # warnings dedupe per process
+    log.set_verbosity(1)
+    Config({"num_leafs": 31})          # classic typo of num_leaves
+    err = capsys.readouterr().err
+    assert "Unknown parameter: num_leafs" in err
+    # negative verbosity in the same dict suppresses, like the reference
+    Config({"verbosity": -1, "bogus_key_xyz": 1})
+    assert "bogus_key_xyz" not in capsys.readouterr().err
+    log.set_verbosity(1)
+    # aliases and tpu-specific params are NOT unknown
+    Config({"n_estimators": 5, "tpu_row_chunk": 4096})
+    assert "Unknown parameter" not in capsys.readouterr().err
+
+
+def test_predict_shape_check(rng):
+    """Feature-count mismatch raises unless predict_disable_shape_check
+    (reference: c_api predictor ncol check, config.h predict section)."""
+    X, y = _data(rng)
+    bst = lgb.train(BASE, lgb.Dataset(X, label=y), num_boost_round=5)
+    with pytest.raises(lgb.LightGBMError, match="number of features"):
+        bst.predict(X[:, :4])
+    with pytest.raises(lgb.LightGBMError, match="number of features"):
+        bst.predict(np.concatenate([X, X[:, :1]], axis=1))
+    # disabled: extra columns ignored; missing columns ride as NaN
+    p_ref = bst.predict(X)
+    p_wide = bst.predict(np.concatenate([X, X[:, :1]], axis=1),
+                         predict_disable_shape_check=True)
+    np.testing.assert_allclose(p_wide, p_ref)
+    p_narrow = bst.predict(X[:, :4], predict_disable_shape_check=True)
+    assert p_narrow.shape == p_ref.shape
+    # 1-D input predicts as a single row (and still shape-checks)
+    np.testing.assert_allclose(bst.predict(X[0]), p_ref[:1])
+    with pytest.raises(lgb.LightGBMError, match="number of features"):
+        bst.predict(X[0, :4])
+
+
+def test_saved_feature_importance_type_gain(rng, tmp_path):
+    """saved_feature_importance_type=1 writes gain importances to the
+    model file (reference: GBDT::FeatureImportance, config.h)."""
+    X, y = _data(rng)
+    f = str(tmp_path / "m.txt")
+    bst = lgb.train(dict(BASE, saved_feature_importance_type=1),
+                    lgb.Dataset(X, label=y), num_boost_round=5)
+    bst.save_model(f)
+    sec = open(f).read().split("feature_importances:")[1]
+    first = sec.strip().splitlines()[0]
+    gains = bst.feature_importance("gain")
+    top = max(range(len(gains)), key=lambda i: gains[i])
+    assert first.split("=")[0] == f"Column_{top}"
+    assert float(first.split("=")[1]) == pytest.approx(gains[top], rel=1e-5)
+    # split-count mode (default) writes integer counts
+    bst2 = lgb.train(BASE, lgb.Dataset(X, label=y), num_boost_round=5)
+    f2 = str(tmp_path / "m2.txt")
+    bst2.save_model(f2)
+    first2 = open(f2).read().split("feature_importances:")[1] \
+        .strip().splitlines()[0]
+    assert float(first2.split("=")[1]) == int(float(first2.split("=")[1]))

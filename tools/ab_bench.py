@@ -80,6 +80,19 @@ def main():
     pa = {**base, **_parse_overrides(args.a)}
     pb = {**base, **_parse_overrides(args.b)}
 
+    # the two arms share ONE binned dataset (constructed with arm A's
+    # params); overrides that change the binning itself would be
+    # silently vacuous, so reject them
+    _DATASET_KEYS = {"max_bin", "min_data_in_bin", "bin_construct_sample_cnt",
+                     "max_bin_by_feature", "feature_pre_filter",
+                     "categorical_feature", "use_missing", "zero_as_missing",
+                     "enable_bundle", "min_data_per_group"}
+    bad = (_DATASET_KEYS & set(_parse_overrides(args.a))) | \
+          (_DATASET_KEYS & set(_parse_overrides(args.b)))
+    if bad:
+        raise SystemExit(f"dataset-construction params {sorted(bad)} cannot "
+                         "be A/B'd here: both arms share one binned dataset")
+
     ds = lgb.Dataset(X, label=y)
     ds.construct(pa)
     boosters = {"A": lgb.Booster(params=pa, train_set=ds),

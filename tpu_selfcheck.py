@@ -116,15 +116,36 @@ assert np.array_equal(np.sort(idx[r0:r0+N]), np.arange(N)), \
     "rowid row corrupted (stack+concat miscompile regression?)"
 print("[3/4] rowid integrity: OK", flush=True)
 
-# ---- 4. E2E pallas vs xla ----
-def train(pallas):
+# ---- 4. hist-state RMW kernel vs numpy ----
+from lightgbm_tpu.ops.hist_state_pallas import flat_geometry, hist_rmw_pallas
+Gf, Bf, WL = flat_geometry(28, 255)
+st_h = rng.randn(34, 8, WL).astype(np.float32)
+small = rng.randn(8, WL).astype(np.float32)
+for (bl, wa, wb, sil) in [(3, 3, 7, 1), (5, 5, 9, 0), (2, 33, 33, 1)]:
+    out, lft, rgt = hist_rmw_pallas(
+        jnp.asarray(st_h), jnp.asarray(small),
+        jnp.asarray([bl, wa, wb, sil], jnp.int32))
+    large = st_h[bl] - small
+    el = small if sil else large
+    er = large if sil else small
+    np.testing.assert_array_equal(np.asarray(lft), el)
+    np.testing.assert_array_equal(np.asarray(rgt), er)
+    exp = st_h.copy(); exp[wa] = el; exp[wb] = er
+    np.testing.assert_array_equal(np.asarray(out), exp)
+print("[4/5] hist-state RMW kernel: OK", flush=True)
+
+# ---- 5. E2E pallas (flat + xla hist state) vs xla ----
+def train(pallas, hist_state="auto"):
     params = {"objective": "binary", "num_leaves": 63, "verbosity": -1,
-              "min_data_in_leaf": 20}
+              "min_data_in_leaf": 20, "tpu_hist_state": hist_state}
     if not pallas:
         params["tpu_partition_kernel"] = "xla"
     b = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=8)
     return b.predict(X[:3000], raw_score=True)
-d = float(np.abs(train(True) - train(False)).max())
-assert d == 0.0, d
-print("[4/4] end-to-end pallas vs xla: OK (max diff 0.0)", flush=True)
+ref = train(False)
+d1 = float(np.abs(train(True) - ref).max())
+d2 = float(np.abs(train(True, "xla") - ref).max())
+assert d1 == 0.0 and d2 == 0.0, (d1, d2)
+print("[5/5] end-to-end pallas(flat/xla-state) vs xla: OK (diff 0.0)",
+      flush=True)
 print("TPU SELF-CHECK: ALL OK")
