@@ -89,6 +89,14 @@ def reset_parameter(**kwargs) -> Callable:
     return _callback
 
 
+def _is_train_row(item) -> bool:
+    """True for training-set eval rows, incl. cv aggregate rows labeled
+    ("cv_agg", "train <metric>", ...) (reference: callback.py
+    _EarlyStoppingCallback._is_train_set)."""
+    return item[0] == "training" or (
+        item[0] == "cv_agg" and str(item[1]).startswith("train "))
+
+
 def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
                    verbose: bool = True, min_delta: float = 0.0) -> Callable:
     """reference: callback.py early_stopping:87 (_EarlyStoppingCallback)."""
@@ -109,7 +117,13 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
         if verbose:
             log.info("Training until validation scores don't improve for %d rounds",
                      stopping_rounds)
-        first_metric[0] = env.evaluation_result_list[0][1]
+        # first metric = first NON-train entry's metric (reference
+        # _EarlyStoppingCallback: train sets never drive stopping; under
+        # cv the rows are ("cv_agg", "train <m>"/"valid <m>", ...))
+        non_train = [it for it in env.evaluation_result_list
+                     if not _is_train_row(it)]
+        first_metric[0] = (non_train[0][1].split(" ")[-1] if non_train
+                           else env.evaluation_result_list[0][1])
         for item in env.evaluation_result_list:
             best_iter.append(0)
             best_score_list.append(None)
@@ -131,9 +145,9 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
                 best_score[i] = score
                 best_iter[i] = env.iteration
                 best_score_list[i] = env.evaluation_result_list
-            if first_metric_only and first_metric[0] != item[1]:
+            if first_metric_only and first_metric[0] != item[1].split(" ")[-1]:
                 continue
-            if item[0] == "training":
+            if _is_train_row(item):
                 continue
             if env.iteration - best_iter[i] >= stopping_rounds:
                 if verbose:

@@ -240,6 +240,19 @@ _PARAMS: List[_Param] = [
     # on v5e (round 3: fixed cost 15.9 -> 12.1 ms/iter vs 8192 at equal
     # slope — smaller per-split padding waste)
     _p("tpu_row_chunk", 4096, int, (), ">0"),
+    # ride the rowid row inside the spare packed-bin bytes when G <= G32-4
+    # (one fewer payload sublane through the partition roll networks)
+    _p("tpu_pack_rowid", False, bool),
+    # disable the fused single-program iteration (A/B + debugging; the
+    # eager per-stage dispatch path is the fallback)
+    _p("tpu_fused_iteration", True, bool),
+    # data-parallel histogram sync: "scatter" = ReduceScatter ownership
+    # (psum_scatter + per-device feature ownership + winner election),
+    # preserving the reference's placement decision
+    # (data_parallel_tree_learner.cpp:282-296) — each histogram element
+    # crosses the wire once instead of ndev times; "psum" = full-hist
+    # allreduce (the round-4 behavior)
+    _p("tpu_data_hist_sync", "scatter", str),
     _p("tpu_feature_block", 64, int, (), ">0"),  # feature groups per histogram block
     _p("tpu_min_bucket_log2", 10, int, (), ">=0"),  # smallest partition bucket
     _p("tpu_donate_state", True, bool),

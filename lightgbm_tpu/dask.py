@@ -164,6 +164,14 @@ class _DaskLGBMModel:
         X_fut = _materialize_parts(X, client)
         by_worker = _group_parts_by_worker(X_fut, client)
         workers = sorted(k for k in by_worker if k is not None)
+        if not workers:
+            # who_has resolved no owners (dask-version-dependent key
+            # stringification, or futures released between wait and
+            # who_has) — fail clearly instead of IndexError below
+            raise RuntimeError(
+                "could not resolve partition placement via "
+                "client.who_has; re-run with distributed=False to use "
+                "the gather-to-client path")
         n_machines = len(workers)
         pos_of = {f.key: i for i, f in enumerate(X_fut)}
 
@@ -212,9 +220,16 @@ class _DaskLGBMModel:
         params.setdefault("tree_learner", "data")
         params.pop("n_estimators", None)
 
-        # rank 0's worker hosts the jax.distributed coordinator
+        # rank 0's worker hosts the jax.distributed coordinator.  With no
+        # explicit local_listen_port, derive a per-fit port so two
+        # concurrent distributed fits on one cluster don't collide at
+        # jax.distributed.initialize
         host0 = workers[0].split("://")[-1].rsplit(":", 1)[0]
-        port = int(params.get("local_listen_port") or 12723)
+        if params.get("local_listen_port"):
+            port = int(params["local_listen_port"])
+        else:
+            import uuid
+            port = 12400 + (uuid.uuid4().int % 4000)
         coordinator = f"{host0}:{port}"
         log.info("lightgbm_tpu.dask: distributed fit over %d workers "
                  "(%d partitions), coordinator %s",
@@ -243,7 +258,14 @@ class _DaskLGBMModel:
             raise TypeError("X must be a dask Array or DataFrame")
         n_workers = len(client.scheduler_info()["workers"])
         if distributed is None:
-            distributed = n_workers > 1
+            # explicit opt-in: per-worker jax.distributed training
+            # requires every dask worker to own its own accelerator /
+            # process slot (single-host TPUs enforce single-process
+            # ownership), so a multi-worker LocalCluster on one device
+            # would crash or hang on the initialize barrier if this
+            # defaulted on.  The gather-to-client path is the safe
+            # default; pass distributed=True for the per-worker ranks.
+            distributed = False
         if distributed and n_workers > 1:
             return self._dask_fit_distributed(
                 model_cls, X, y, sample_weight, group, client, **kwargs)

@@ -117,10 +117,27 @@ class CVBooster:
 
 
 def _make_n_folds(full_data: Dataset, nfold: int, params, seed,
-                  stratified: bool, shuffle: bool):
+                  stratified: bool, shuffle: bool, ranking: bool = False):
+    """Fold index generator (reference: engine.py _make_n_folds:491-546):
+    ranking objectives split by whole query groups, stratified splits
+    per class, otherwise plain splits."""
     full_data.construct(params)
     num_data = full_data.num_data()
     rng = np.random.RandomState(seed)
+    if ranking:
+        # split according to groups so no query straddles folds
+        # (reference: _LGBMGroupKFold path, engine.py:529-532)
+        group_info = np.asarray(full_data.get_group(), dtype=np.int64)
+        ngroups = len(group_info)
+        starts = np.concatenate([[0], np.cumsum(group_info)])
+        gidx = np.arange(ngroups)
+        if shuffle:
+            rng.shuffle(gidx)
+        for chunk in np.array_split(gidx, nfold):
+            test_idx = np.concatenate(
+                [np.arange(starts[g], starts[g + 1]) for g in sorted(chunk)])
+            yield np.setdiff1d(np.arange(num_data), test_idx), test_idx
+        return
     if stratified and full_data.get_label() is not None:
         label = np.asarray(full_data.get_label())
         folds = [[] for _ in range(nfold)]
@@ -161,26 +178,63 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
     if cfg.objective in ("lambdarank", "rank_xendcg") and stratified:
         stratified = False
 
+    ranking = cfg.objective in ("lambdarank", "rank_xendcg")
     if folds is not None:
-        fold_iter = folds
+        # sklearn splitter objects expose .split; ranking groups ride as
+        # the ``groups`` argument (reference: engine.py:507-517)
+        if hasattr(folds, "split"):
+            train_set.construct(params)
+            num_data = train_set.num_data()
+            group_info = train_set.get_group()
+            if group_info is not None:
+                group_info = np.asarray(group_info, dtype=np.int64)
+                flatted_group = np.repeat(
+                    np.arange(len(group_info)), repeats=group_info)
+            else:
+                flatted_group = np.zeros(num_data, dtype=np.int32)
+            fold_iter = folds.split(X=np.empty(num_data),
+                                    y=train_set.get_label(),
+                                    groups=flatted_group)
+        elif hasattr(folds, "__iter__"):
+            fold_iter = folds
+        else:
+            raise AttributeError(
+                "folds should be a generator or iterator of (train_idx, "
+                "test_idx) tuples or scikit-learn splitter object with "
+                "split method")
     else:
         fold_iter = _make_n_folds(train_set, nfold, params, seed,
                                   stratified and cfg.objective in (
                                       "binary", "multiclass", "multiclassova"),
-                                  shuffle)
+                                  shuffle, ranking=ranking)
 
     cvbooster = CVBooster()
     fold_data = []
     for train_idx, test_idx in fold_iter:
-        tr = train_set.subset(train_idx, params)
-        te = train_set.subset(test_idx, params)
+        tr = train_set.subset(np.sort(np.asarray(train_idx)), params)
+        te = train_set.subset(np.sort(np.asarray(test_idx)), params)
         te.reference = tr
-        bst = Booster(params=params, train_set=tr)
+        # per-fold preprocessing hook (reference: engine.py:553-556)
+        tparam = params
+        if fpreproc is not None:
+            tr, te, tparam = fpreproc(tr, te, dict(params))
+        bst = Booster(params=tparam, train_set=tr)
+        if init_model is not None:
+            # before add_valid, so the valid scores seed from the init
+            # model's predictions (same order as train(), engine.py:43)
+            bst._continue_from(init_model)
+        if eval_train_metric:
+            bst._gbdt.config = bst._gbdt.config.update(
+                {"is_provide_training_metric": True})
         bst.add_valid(te, "valid")
         cvbooster.append(bst)
         fold_data.append((tr, te))
 
     callbacks = list(callbacks) if callbacks else []
+    callbacks_before = [cb for cb in callbacks
+                        if getattr(cb, "before_iteration", False)]
+    callbacks_after = [cb for cb in callbacks
+                       if not getattr(cb, "before_iteration", False)]
     es_cb = None
     if cfg.early_stopping_round and cfg.early_stopping_round > 0:
         es_cb = cfg.early_stopping_round
@@ -192,17 +246,29 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
     best_mean: Dict[str, float] = {}
     best_round: Dict[str, int] = {}
     for i in range(num_boost_round):
+        for cb in callbacks_before:
+            cb(callback_mod.CallbackEnv(
+                model=cvbooster, params=params, iteration=i,
+                begin_iteration=0, end_iteration=num_boost_round,
+                evaluation_result_list=None))
         all_evals: Dict[str, List[float]] = {}
         for bst in cvbooster.boosters:
             bst.update(fobj=fobj)
-            for dname, mname, val, is_max in bst.eval_valid():
-                all_evals.setdefault((mname, is_max), []).append(val)
+            evals = []
+            if eval_train_metric:
+                evals.extend(("train", m, v, hb)
+                             for _, m, v, hb in bst.eval_train(feval))
+            evals.extend(bst.eval_valid(feval))
+            for dname, mname, val, is_max in evals:
+                all_evals.setdefault((dname, mname, is_max), []).append(val)
+        agg = []     # reference _agg_cv_result rows for the callbacks
         stop_now = False
-        for (mname, is_max), vals in all_evals.items():
+        for (dname, mname, is_max), vals in all_evals.items():
             mean, std = float(np.mean(vals)), float(np.std(vals))
-            results.setdefault(f"valid {mname}-mean", []).append(mean)
-            results.setdefault(f"valid {mname}-stdv", []).append(std)
-            if es_cb is not None:
+            results.setdefault(f"{dname} {mname}-mean", []).append(mean)
+            results.setdefault(f"{dname} {mname}-stdv", []).append(std)
+            agg.append(("cv_agg", f"{dname} {mname}", mean, is_max, std))
+            if es_cb is not None and dname == "valid":
                 cur = mean if is_max else -mean
                 if mname not in best_mean or cur > best_mean[mname]:
                     best_mean[mname] = cur
@@ -210,9 +276,26 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
                 elif i - best_round[mname] >= es_cb:
                     stop_now = True
                     best_iter = best_round[mname] + 1
+        try:
+            for cb in callbacks_after:
+                cb(callback_mod.CallbackEnv(
+                    model=cvbooster, params=params, iteration=i,
+                    begin_iteration=0, end_iteration=num_boost_round,
+                    evaluation_result_list=agg))
+        except EarlyStopException as es:
+            best_iter = es.best_iteration + 1
+            stop_now = True
         if stop_now:
             break
     cvbooster.best_iteration = best_iter
+    if best_iter < num_boost_round:
+        # reference (engine.py:843-848): truncate the aggregate series
+        # to the best iteration and stamp it on the fold boosters so
+        # len(results[...]) and predict() defaults are consistent
+        for k in results:
+            results[k] = results[k][:best_iter]
+        for bst in cvbooster.boosters:
+            bst.best_iteration = best_iter
     if return_cvbooster:
         results["cvbooster"] = cvbooster
     return results

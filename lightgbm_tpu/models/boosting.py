@@ -100,6 +100,29 @@ def _scores_from_phys(ghi, num_data):
         ghi[3], mode="drop")
 
 
+def _scores_from_phys_multiproc(ghi, local_num_data, sb):
+    """Rank-sharded fused state -> this process's LOCAL scores, on the
+    host: rowids are GLOBAL mesh ids (device d owns [d*local_n, ...)),
+    so under multi-process each rank folds only its addressable shards
+    back to its local row order.  (A single SPMD scatter cannot produce
+    a per-rank local array from global ids.)"""
+    out = np.zeros((local_num_data,), np.float32)
+    if sb.mode == "feature":
+        # rows replicated: any shard carries every row with ids 0..N
+        blk = np.asarray(ghi.addressable_shards[0].data)
+        rowid = blk[2].view(np.int32)
+        valid = (rowid >= 0) & (rowid < local_num_data)
+        out[rowid[valid]] = blk[3][valid]
+        return jnp.asarray(out)
+    proc_off = jax.process_index() * sb.local_ndev * sb.local_n
+    for shard in ghi.addressable_shards:
+        blk = np.asarray(shard.data)
+        lid = blk[2].view(np.int32) - proc_off
+        valid = (lid >= 0) & (lid < local_num_data)
+        out[lid[valid]] = blk[3][valid]
+    return jnp.asarray(out)
+
+
 @functools.partial(jax.jit, static_argnums=(1, 2))
 def _scores_from_phys_mc(ghi, num_data, num_class):
     """Multiclass variant: rows 3..3+K-1 are the per-class score rows."""
@@ -170,6 +193,10 @@ def _renew_leaves_percentile(rec, resid, pweight, sel, alpha: float,
         v = v1 + (v2 - v1) * bias
         v = jnp.where(nb == 1, jnp.take(r_s, off), v)
     else:
+        # reference WeightedPercentileFun (regression_objective.hpp:50-88):
+        # pos = upper_bound(weighted cdf, alpha * total), interpolate
+        # only when the next point's weight >= 1 and pos is interior.
+        # Matches _weighted_percentile_host exactly (stable sort order).
         wsel = pweight * sel.astype(jnp.float32)
         w_s = jnp.take(wsel, ord2)
         wc = jnp.concatenate([jnp.zeros((1,), jnp.float32),
@@ -180,15 +207,26 @@ def _renew_leaves_percentile(rec, resid, pweight, sel, alpha: float,
                          0.0)
         leaf_s = jnp.take(leaf_at, ord2)
         local_j = iota - jnp.take(off, leaf_s)
-        cum_half = wc_s - jnp.take(base, leaf_s) - w_s * 0.5
-        cond = (cum_half >= alpha * jnp.take(sw, leaf_s)) \
-            & (local_j < jnp.take(nb, leaf_s))
+        cum = wc_s - jnp.take(base, leaf_s)        # inclusive per-leaf cdf
+        thr_s = alpha * jnp.take(sw, leaf_s)
+        cond = (cum > thr_s) & (local_j < jnp.take(nb, leaf_s))
         big = jnp.int32(Npad + 1)
         first = jnp.full((Lslots,), big, jnp.int32).at[leaf_s].min(
             jnp.where(cond, iota, big))
-        pos = jnp.where(first < big, first, off + jnp.maximum(nb - 1, 0))
-        pos = jnp.clip(pos, off, off + jnp.maximum(nb - 1, 0))
-        v = jnp.take(r_s, pos)
+        last = off + jnp.maximum(nb - 1, 0)
+        pos = jnp.where(first < big, first, last)
+        pos = jnp.clip(pos, off, last)
+        lpos = pos - off
+        v2 = jnp.take(r_s, pos)
+        v1 = jnp.take(r_s, jnp.maximum(pos - 1, off))
+        w_next = jnp.take(w_s, jnp.minimum(pos + 1, last))
+        cdf_pos = jnp.take(wc_s, pos) - base
+        cdf_next = cdf_pos + w_next
+        thr = alpha * sw
+        interp = (thr - cdf_pos) / jnp.maximum(cdf_next - cdf_pos,
+                                               jnp.float32(1e-30)) * (v2 - v1) + v1
+        use_i = (lpos > 0) & (lpos < nb - 1) & (w_next >= 1.0)
+        v = jnp.where(use_i, interp, v2)
     return jnp.where(nb > 0, v, old)
 
 
@@ -261,9 +299,13 @@ class GBDT:
             ghi = self._phys[1]
             self._phys = None
             K = self.num_tree_per_iteration
+            sb = self.sharded_builder
             if K > 1:
                 self._scores_arr = _scores_from_phys_mc(
                     ghi, self.num_data, K)
+            elif sb is not None and sb.nproc > 1:
+                self._scores_arr = _scores_from_phys_multiproc(
+                    ghi, self.num_data, sb)
             else:
                 self._scores_arr = _scores_from_phys(ghi, self.num_data)
         return self._scores_arr
@@ -387,8 +429,10 @@ class GBDT:
         self._fused = None
         # GOSS and plain bagging fold into the fused physical program
         # (their masks are pure jnp); balanced/query bagging do not yet
+        fused_on = bool(getattr(cfg, "tpu_fused_iteration", True))
         common_ok = (
-            self.sharded_builder is None and self.objective is not None
+            fused_on
+            and self.sharded_builder is None and self.objective is not None
             and getattr(self.objective, "is_jit_safe", True)
             and not cfg.linear_tree
             and not cfg.cegb_penalty_feature_lazy)
@@ -401,7 +445,9 @@ class GBDT:
             # multiclass: all K class trees build inside ONE program per
             # iteration (gbdt.cpp:379's per-class Train loop, device-side)
             self._setup_fused_multiclass()
-        elif (self.sharded_builder is not None and self.objective is not None
+        elif (fused_on
+              and self.sharded_builder is not None
+              and self.objective is not None
               and getattr(self.objective, "is_jit_safe", True)
               and K == 1 and not cfg.linear_tree
               and not cfg.cegb_penalty_feature_lazy
@@ -882,14 +928,6 @@ class GBDT:
         lr_ = sb.learner
         obj = self.objective
         cfg = self.config
-        if jax.process_count() > 1:
-            # multi-process meshes keep the eager path: the fused state
-            # layout indexes rows by single-process global ids and pads
-            # host-side blocks to the full mesh width, neither of which
-            # holds for rank-sharded processes (the 2-process training
-            # equality test pins the eager path's correctness)
-            self._fused_sharded_reason = "multi-process mesh (eager path)"
-            return
         if (type(obj).__dict__.get("gradients_from_payload") is None
                 or obj.gradient_payload() is None):
             self._fused_sharded_reason = \
@@ -902,7 +940,15 @@ class GBDT:
             return
         lr_._ghi_live = 4 + len(names)
         shrink = self.shrinkage_rate
-        N = self.num_data
+        # rowid space is GLOBAL across the whole mesh so bagging draws
+        # agree on every process.  Mesh ids are GAPPED when ranks hold
+        # unequal row counts (device d owns [d*local_n, d*local_n+cnt_d)
+        # with local_n the max over ranks), so the pad sentinel must sit
+        # ABOVE the whole id space — ndev*local_n — not at sb.N: a
+        # sentinel of sb.N would collide with a real row's id and
+        # silently drop it from training
+        N = sb.N
+        SENT = sb.ndev * sb.local_n
         Npad = lr_.N_pad
         C = lr_.row0
         ndev = sb.ndev
@@ -914,10 +960,14 @@ class GBDT:
                         for n in names]
 
         def shard_rows(arr):
+            # this process's rows, laid out as one local_n block per
+            # LOCAL device (mirroring the builder's binned blocking);
+            # sb._put assembles the global mesh array across processes
             arr = np.asarray(arr, np.float32)
             if repl_rows:
                 return sb._put(arr, NamedSharding(mesh, P()))
-            total = ndev * local_n
+            total = sb.local_ndev * local_n if sb.nproc > 1 \
+                else ndev * local_n
             if len(arr) < total:
                 arr = np.concatenate(
                     [arr, np.zeros(total - len(arr), np.float32)])
@@ -937,7 +987,7 @@ class GBDT:
             valid = (li >= 0) & (li < counts[0])
             base = (jnp.int32(0) if repl_rows
                     else jax.lax.axis_index(AXIS) * local_n)
-            rowid = jnp.where(valid, base + li, N)
+            rowid = jnp.where(valid, base + li, SENT)
             nrows = scores.shape[0]
 
             def rowpad(a):
@@ -967,8 +1017,8 @@ class GBDT:
         def init_fn():
             scores_sh = shard_rows(np.asarray(self._scores_arr))
             pays = [shard_rows(p) for p in payload_arrs]
-            counts = (jax.device_put(np.asarray([N], np.int32),
-                                     NamedSharding(mesh, P()))
+            counts = (sb._put(np.asarray([N], np.int32),
+                              NamedSharding(mesh, P()))
                       if repl_rows else sb.local_counts)
             return init_sharded(sb.binned_sharded, scores_sh,
                                 counts, *pays)
@@ -984,7 +1034,7 @@ class GBDT:
 
         def step_shard(pb, ghi, feature_mask, seed, feat_used):
             rowid = jax.lax.bitcast_convert_type(ghi[2], jnp.int32)
-            vf = (rowid != N).astype(jnp.float32)
+            vf = (rowid != SENT).astype(jnp.float32)
             payload = {n: ghi[4 + i] for i, n in enumerate(names)}
             g, h = obj.gradients_from_payload(ghi[3], **payload)
             g = g * vf
@@ -993,8 +1043,8 @@ class GBDT:
                 # draws by GLOBAL row id: every shard layout sees the
                 # same bag for a given period (bagging.hpp semantics)
                 kb = jax.random.fold_in(bag_key, (seed - 1) // bag_freq)
-                u = jax.random.uniform(kb, (N + 1,))
-                sel = (jnp.take(u, jnp.minimum(rowid, N)) < bag_frac) \
+                u = jax.random.uniform(kb, (SENT + 1,))
+                sel = (jnp.take(u, jnp.minimum(rowid, SENT)) < bag_frac) \
                     & (vf > 0)
                 sf = sel.astype(jnp.float32)
                 g = g * sf

@@ -424,6 +424,9 @@ class SerialTreeLearner:
                                                     make_scalars,
                                                     sc_rows_for)
                 g32 = ((self.G + 31) // 32) * 32
+                self._pack_rowid = (bool(getattr(config, "tpu_pack_rowid",
+                                                 True))
+                                    and g32 - self.G >= 4 and g32 >= 16)
                 cpr = self.row_chunk
                 tiny = 4 * cpr
                 out = partition_leaf_pallas(
@@ -431,7 +434,7 @@ class SerialTreeLearner:
                     jnp.zeros((8, tiny), jnp.float32),
                     jnp.zeros((sc_rows_for(g32), tiny), jnp.int32),
                     make_scalars(cpr, cpr, 0, 0, 0, 255, 0, 0, 128, 0),
-                    row_chunk=cpr)
+                    row_chunk=cpr, pack_rowid=self._pack_rowid)
                 jax.block_until_ready(out)
                 self._pb_rows = g32
                 self._ghi_rows = 8
@@ -490,6 +493,21 @@ class SerialTreeLearner:
                              and not self.has_cegb
                              and self.path_smooth <= 0.0
                              and self.N < (1 << 24))
+
+        # ReduceScatter histogram ownership (reference placement:
+        # data_parallel_tree_learner.cpp:282-296) — see _psum.  Plain
+        # fast-search geometry only; the forced/monotone/categorical
+        # paths read whole-histogram state and keep the full psum.
+        self._scatter_per = 0
+        self._scatter_groups = (
+            parallel_mode == "data" and self.axis_name is not None
+            and getattr(config, "tpu_data_hist_sync",
+                        "scatter") == "scatter"
+            and self._fast_search and self._plain_view
+            and self.forced is None
+            and num_shards > 1 and self.F >= num_shards)
+        if self._scatter_groups:
+            self._scatter_per = -(-self.G // num_shards)
 
         # Pallas split-search kernel: one program per split evaluates
         # both children (ops/split_pallas.py).  Plain serial TPU path
@@ -798,7 +816,8 @@ class SerialTreeLearner:
                                mtype, thr, dl)
         pb, pg, sp, nl = partition_leaf_pallas(
             st["part_bins"], st["part_ghi"], st["sc_packed"],
-            scalars, row_chunk=self.row_chunk, ghi_live=self._ghi_live)
+            scalars, row_chunk=self.row_chunk, ghi_live=self._ghi_live,
+            pack_rowid=getattr(self, "_pack_rowid", False))
         moved = {"part_bins": pb, "part_ghi": pg, "sc_packed": sp}
         return moved, nl[0, 0]
 
@@ -984,6 +1003,13 @@ class SerialTreeLearner:
                 hist_group, sum_g, sum_h, cnt, local_cnt, depth, cmin, cmax,
                 parent_out, feature_mask, feat_used, lazy_cnt=lazy_cnt,
                 rand_bins=rand_bins)
+        if self._scatter_groups:
+            # each device searches only the groups it owns post-scatter;
+            # the election in _sync_best agrees on the global winner
+            d = jax.lax.axis_index(self.axis_name)
+            owned = (jax.lax.iota(jnp.int32, self.F)
+                     // self._scatter_per) == d
+            feature_mask = feature_mask & owned
         feat_hist = self._feat_view(hist_group, sum_g, sum_h)
         best = self._find_best(feat_hist, sum_g, sum_h, cnt, depth,
                                cmin, cmax, feature_mask, feat_used=feat_used,
@@ -1377,8 +1403,28 @@ class SerialTreeLearner:
     def _psum(self, x):
         """Histogram sync: global sums only in data-parallel mode (voting
         keeps leaf histograms LOCAL and syncs only elected features at
-        split-evaluation time)."""
+        split-evaluation time).
+
+        With tpu_data_hist_sync="scatter" the reference's ReduceScatter
+        ownership is preserved (data_parallel_tree_learner.cpp:282-296):
+        psum_scatter hands each device the GLOBAL sums of its OWN group
+        slice only (each element crosses the wire once, vs ndev times
+        for the full psum), the non-owned groups stay zero, the search
+        masks to owned features, and the winner is elected by the same
+        all-gather arg-max the feature-parallel mode uses."""
         if self.axis_name is not None and self.parallel_mode == "data":
+            if self._scatter_groups:
+                per = self._scatter_per
+                Gp = per * self.num_shards
+                xp = jnp.pad(x, ((0, Gp - self.G), (0, 0), (0, 0)))
+                own = jax.lax.psum_scatter(
+                    xp.reshape(self.num_shards, per, *x.shape[1:]),
+                    self.axis_name, scatter_dimension=0, tiled=False)
+                d = jax.lax.axis_index(self.axis_name)
+                full = jnp.zeros((Gp,) + x.shape[1:], x.dtype)
+                full = jax.lax.dynamic_update_slice(
+                    full, own, (d * per,) + (0,) * (x.ndim - 1))
+                return full[:self.G]
             return jax.lax.psum(x, self.axis_name)
         return x
 
@@ -1392,8 +1438,13 @@ class SerialTreeLearner:
 
     def _sync_best(self, best):
         """Agree on the global best split across feature-sharded devices
-        (reference: SyncUpGlobalBestSplit, parallel_tree_learner.h:209-232)."""
-        if self.axis_name is None or self.parallel_mode != "feature":
+        (reference: SyncUpGlobalBestSplit, parallel_tree_learner.h:209-232).
+        Also elects the winner under ReduceScatter histogram ownership
+        (data-parallel scatter mode): devices are ordered by owned
+        feature range, so the arg-max's first-max tie-break matches the
+        serial scan order."""
+        if self.axis_name is None or not (
+                self.parallel_mode == "feature" or self._scatter_groups):
             return best
         gathered = jax.tree.map(
             lambda a: jax.lax.all_gather(a, self.axis_name), best)
@@ -1423,15 +1474,20 @@ class SerialTreeLearner:
         feat_used0 = (jnp.zeros((F,), jnp.bool_) if feat_used_init is None
                       else feat_used_init)
 
-        root_hist = self._psum(self._hist_leaf(
+        root_local = self._hist_leaf(
             part_bins, part_ghi0, jnp.int32(self.row0), jnp.int32(self.N),
-            scale=hist_scale))
+            scale=hist_scale)
+        root_hist = self._psum(root_local)
         bag_cnt_g = self._psum_scalar(bag_cnt)
-        # in voting mode root_hist stays LOCAL; the leaf totals are global
-        sum_g = self._psum_scalar(root_hist[0, :, 0].sum()) \
-            if self.parallel_mode == "voting" else root_hist[0, :, 0].sum()
-        sum_h = self._psum_scalar(root_hist[0, :, 1].sum()) \
-            if self.parallel_mode == "voting" else root_hist[0, :, 1].sum()
+        # in voting mode root_hist stays LOCAL; in scatter mode only the
+        # owned groups survive in root_hist — either way the leaf totals
+        # come from the LOCAL histogram reduced across ranks
+        if self.parallel_mode == "voting" or self._scatter_groups:
+            sum_g = self._psum_scalar(root_local[0, :, 0].sum())
+            sum_h = self._psum_scalar(root_local[0, :, 1].sum())
+        else:
+            sum_g = root_hist[0, :, 0].sum()
+            sum_h = root_hist[0, :, 1].sum()
         neg_inf = jnp.float32(-jnp.inf)
         pos_inf = jnp.float32(jnp.inf)
         lazy_extra = ()

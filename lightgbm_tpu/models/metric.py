@@ -22,6 +22,8 @@ from ..utils import log
 
 K_EPSILON = 1e-15
 
+_RANK_MEAN_WARNED = False
+
 
 class Metric:
     name = "metric"
@@ -64,6 +66,18 @@ class Metric:
         family): the sum_weight-weighted mean of per-rank values.  Exact
         only when every rank sees the full data (feature-parallel); an
         explicit approximation for rank-sharded rows."""
+        from ..parallel import network
+        global _RANK_MEAN_WARNED
+        if network.num_machines() > 1 and not _RANK_MEAN_WARNED:
+            # surface the approximation once so early-stopping users know
+            # (cross-rank score pairs are never compared; the reference
+            # reports per-machine metrics instead — src/metric/ has no
+            # Network calls)
+            _RANK_MEAN_WARNED = True
+            log.warning(
+                "non-decomposable metric aggregated as a weighted mean "
+                "of per-rank values under data-parallel row sharding — "
+                "an approximation of the true global metric")
         vs, ws = _global_pair(value * self.sum_weight, self.sum_weight)
         return vs / max(ws, K_EPSILON)
 

@@ -956,15 +956,23 @@ def _weighted_percentile_host(values: np.ndarray, weights: Optional[np.ndarray],
         if lo + 1 >= n:
             return float(v[-1])
         return float(v[lo] + (v[lo + 1] - v[lo]) * bias)
-    order = np.argsort(values)
+    order = np.argsort(values, kind="stable")
     v = values[order]
     w = weights[order].astype(np.float64)
-    # reference WeightedPercentileFun: threshold on cumulative weight
-    cum = np.cumsum(w) - w / 2.0
-    threshold = alpha * np.sum(w)
-    pos = int(np.searchsorted(cum, threshold))
-    pos = min(max(pos, 0), n - 1)
-    return float(v[pos])
+    # reference WeightedPercentileFun (regression_objective.hpp:50-88):
+    # upper_bound on the full cumulative weight, then interpolation only
+    # when the next point carries weight >= 1
+    cdf = np.cumsum(w)
+    threshold = alpha * cdf[-1]
+    pos = int(np.searchsorted(cdf, threshold, side="right"))
+    pos = min(pos, n - 1)
+    if pos == 0 or pos == n - 1:
+        return float(v[pos])
+    v1, v2 = float(v[pos - 1]), float(v[pos])
+    if cdf[pos + 1] - cdf[pos] >= 1.0:
+        return float((threshold - cdf[pos]) /
+                     (cdf[pos + 1] - cdf[pos]) * (v2 - v1) + v1)
+    return v2
 
 
 _OBJECTIVES = {

@@ -127,6 +127,7 @@ def _cdiv(a, c):
 
 def partition_leaf_pallas(part_bins, part_ghi, sc_packed, scalars, *,
                           row_chunk: int, ghi_live: int = 3,
+                          pack_rowid: bool = False,
                           interpret: bool = False):
     """Two-way stable partition of the leaf range described by
     ``scalars`` (see the S_* layout above), in place.
@@ -140,6 +141,13 @@ def partition_leaf_pallas(part_bins, part_ghi, sc_packed, scalars, *,
         (models/boosting.py _setup_fused_step).
       sc_packed: (SC_ROWS, N_pad) i32 scratch staging the packed rights
       scalars: (N_SCALARS,) i32.
+      pack_rowid: ride the rowid-bits ghi row (row 2) inside the 4 spare
+        byte slots of the packed bin words (byte 3 of words W-4..W-1 —
+        the zero pad rows G..G32) instead of as its own payload sublane.
+        The roll network's cost is proportional to payload sublanes
+        (PERF.md), so this drops P by one for free when G <= G32-4.
+        Kernel-internal only: the HBM layout of part_ghi is unchanged
+        and the pad bin rows come back zeroed.
     Returns (part_bins', part_ghi', sc_packed', nl) with the first three
     aliased in place; nl is an (8, 128) i32 tile whose [0, 0] element is
     the left count.
@@ -158,7 +166,11 @@ def partition_leaf_pallas(part_bins, part_ghi, sc_packed, scalars, *,
     logc = C.bit_length() - 1
     W = G32 // 4        # packed bin words
     assert 3 <= ghi_live <= GH
-    P = W + ghi_live    # packed payload sublanes (bins + live ghi rows)
+    if pack_rowid:
+        assert W >= 4, "pack_rowid needs >= 4 packed words"
+    # payload sublanes: bins words + live ghi rows (minus the rowid row
+    # when it rides inside the spare bin bytes)
+    P = W + ghi_live - (1 if pack_rowid else 0)
     assert P <= SCR
 
     def pack_bins(bins_i32):
@@ -171,6 +183,43 @@ def partition_leaf_pallas(part_bins, part_ghi, sc_packed, scalars, *,
         return jnp.concatenate(
             [packed & 255, (packed >> 8) & 255,
              (packed >> 16) & 255, (packed >> 24) & 255], axis=0)
+
+    def make_payload(packed, ghi_i):
+        """(P, C) compaction payload from packed words + live ghi rows;
+        with pack_rowid the rowid bytes overwrite the zero byte-3 slots
+        of words W-4..W-1 and ghi row 2 is dropped.  All row picks are
+        STATIC sublane slices — masked row selects/reductions take a
+        per-tile slow path in Mosaic (round-5 measurement: an
+        iota-compare formulation of this same packing ran 15x slower)."""
+        if not pack_rowid:
+            return jnp.concatenate([packed, ghi_i], axis=0)
+        rowid = ghi_i[2:3]                               # (1, C) i32
+        top = [packed[W - 4 + j:W - 3 + j] |
+               ((jax.lax.shift_right_logical(
+                   rowid, jnp.broadcast_to(8 * j, rowid.shape)) & 255)
+                << 24)
+               for j in range(4)]
+        extra = [ghi_i[3:ghi_live]] if ghi_live > 3 else []
+        return jnp.concatenate(
+            [packed[0:W - 4]] + top + [ghi_i[0:2]] + extra, axis=0)
+
+    def split_payload(pay):
+        """(P, C) payload -> ((W, C) clean packed words, (ghi_live, C)
+        ghi rows in storage order), reconstructing the rowid row.
+        Static sublane slices only (see make_payload)."""
+        if not pack_rowid:
+            return pay[0:W], pay[W:P]
+        rowid = None
+        for j in range(4):
+            byte_j = (jax.lax.shift_right_logical(
+                pay[W - 4 + j:W - 3 + j],
+                jnp.broadcast_to(24, (1, pay.shape[1]))) & 255) << (8 * j)
+            rowid = byte_j if rowid is None else rowid | byte_j
+        packed = jnp.concatenate(
+            [pay[0:W - 4], pay[W - 4:W] & 0x00FFFFFF], axis=0)
+        tail = [pay[W + 2:P]] if P > W + 2 else []
+        ghi = jnp.concatenate([pay[W:W + 2], rowid] + tail, axis=0)
+        return packed, ghi
 
     def kernel(s_ref, pb_in, pg_in, sp_in, pb, pg, sp, nl_ref,
                rb, rg, rs, stgl, stgr, wb, wg, wp, exb, exg, sems):
@@ -222,7 +271,7 @@ def partition_leaf_pallas(part_bins, part_ghi, sc_packed, scalars, *,
             packed = pack_bins(bins_i)                        # (W, C)
             ghi_i = jax.lax.bitcast_convert_type(
                 rg[slot], jnp.int32)[0:ghi_live]
-            payload = jnp.concatenate([packed, ghi_i], axis=0)  # (P, C)
+            payload = make_payload(packed, ghi_i)             # (P, C)
 
             # --- decision (numerical splits; see ops/partition.py
             # split_decision and models/learner.py _goes_left) ---
@@ -288,10 +337,11 @@ def partition_leaf_pallas(part_bins, part_ghi, sc_packed, scalars, *,
                         wb, pb.at[:, pl.ds(0, C)], sems.at[0, 2]).wait()
                     pltpu.make_async_copy(
                         wg, pg.at[:, pl.ds(0, C)], sems.at[1, 2]).wait()
-                wb[:] = unpack_bins(stgl[0:W, 0:C]).astype(jnp.uint8)
+                pk_l, gl_l = split_payload(stgl[:, 0:C])
+                wb[:] = unpack_bins(pk_l).astype(jnp.uint8)
                 wg[:] = jax.lax.bitcast_convert_type(
                     jnp.concatenate(
-                        [stgl[W:P, 0:C],
+                        [gl_l,
                          jnp.zeros((GH - ghi_live, C), jnp.int32)], axis=0),
                     jnp.float32)
                 pltpu.make_async_copy(
@@ -342,10 +392,11 @@ def partition_leaf_pallas(part_bins, part_ghi, sc_packed, scalars, *,
         # read (scratch).
         @pl.when(fill_l > 0)
         def _():
-            wb[:] = unpack_bins(stgl[0:W, 0:C]).astype(jnp.uint8)
+            pk_f, gl_f = split_payload(stgl[:, 0:C])
+            wb[:] = unpack_bins(pk_f).astype(jnp.uint8)
             wg[:] = jax.lax.bitcast_convert_type(
                 jnp.concatenate(
-                    [stgl[W:P, 0:C],
+                    [gl_f,
                      jnp.zeros((GH - ghi_live, C), jnp.int32)], axis=0),
                 jnp.float32)
             cb = pltpu.make_async_copy(
@@ -419,8 +470,8 @@ def partition_leaf_pallas(part_bins, part_ghi, sc_packed, scalars, *,
             take_prev = lane < r0
             out_p = jnp.where(take_prev, pltpu.roll(prv_p, r0, 1),
                               pltpu.roll(cur_p, r0, 1))
-            out_b = unpack_bins(out_p[0:W])          # (G32, C)
-            out_gl = out_p[W:P]                      # (ghi_live, C) bits
+            pk_2, out_gl = split_payload(out_p)      # clean words + ghi
+            out_b = unpack_bins(pk_2)                # (G32, C)
             valid = (lane >= lo) & (lane < hi)
             # wait the PREVIOUS window's deferred write before reusing
             # the staging buffers (destination windows are disjoint, so

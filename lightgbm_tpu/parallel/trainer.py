@@ -271,3 +271,13 @@ class ShardedTreeBuilder:
         if self.learner.cegb_lazy is not None:
             return self._build_sharded(*args, self.pad_aux(lazy_aux))
         return self._build_sharded(*args)
+
+    def _build_lowered_hlo(self, grad, hess) -> str:
+        """Optimized HLO of the sharded tree build (test/inspection hook:
+        verifies which collectives the histogram sync lowers to)."""
+        lr = self.learner
+        args = (self.binned_sharded, self.pad_rows(grad),
+                self.pad_rows(hess), self.local_counts,
+                jnp.ones((lr.F,), dtype=bool), jnp.int32(0),
+                jnp.zeros((lr.F,), dtype=bool))
+        return self._build_sharded.lower(*args).compile().as_text()

@@ -1,0 +1,153 @@
+"""cv() parity with the reference engine
+(/root/reference/python-package/lightgbm/engine.py:580-744): fpreproc,
+eval_train_metric, sklearn splitter folds, ranking group-aware folds."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+BASE = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+        "metric": "binary_logloss", "min_data_in_leaf": 10}
+
+
+def _bin_data(rng, n=1200, f=6):
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + 0.5 * X[:, 1] + 0.3 * rng.normal(size=n) > 0)
+    return X, y.astype(np.float64)
+
+
+def test_cv_eval_train_metric(rng):
+    """eval_train_metric=True adds `train <metric>-mean` series
+    (reference: engine.py cv eval_train_metric arm)."""
+    X, y = _bin_data(rng)
+    res = lgb.cv(BASE, lgb.Dataset(X, label=y), num_boost_round=8,
+                 nfold=3, eval_train_metric=True, seed=4)
+    assert "train binary_logloss-mean" in res
+    assert "valid binary_logloss-mean" in res
+    assert len(res["train binary_logloss-mean"]) == 8
+    # train loss should be below valid loss by the end (it's fitted)
+    assert res["train binary_logloss-mean"][-1] <= \
+        res["valid binary_logloss-mean"][-1] + 1e-6
+
+
+def test_cv_fpreproc_applied_per_fold(rng):
+    """fpreproc mutates each fold's sets/params before training
+    (reference: engine.py:553-556)."""
+    X, y = _bin_data(rng)
+    calls = []
+
+    def fpreproc(dtrain, dtest, params):
+        calls.append((dtrain.num_data(), dtest.num_data()))
+        params = dict(params, learning_rate=0.5)
+        return dtrain, dtest, params
+
+    res = lgb.cv(BASE, lgb.Dataset(X, label=y), num_boost_round=5,
+                 nfold=3, fpreproc=fpreproc, seed=4,
+                 return_cvbooster=True)
+    assert len(calls) == 3
+    assert all(tr + te == len(y) for tr, te in calls)
+    # the params hook took effect on the fold boosters
+    for bst in res["cvbooster"].boosters:
+        assert bst.config.learning_rate == pytest.approx(0.5)
+
+
+def test_cv_sklearn_splitter_folds(rng):
+    """A scikit-learn splitter object drives the folds
+    (reference: engine.py:507-517 hasattr(folds, 'split'))."""
+    from sklearn.model_selection import KFold
+    X, y = _bin_data(rng)
+    res = lgb.cv(BASE, lgb.Dataset(X, label=y), num_boost_round=6,
+                 folds=KFold(n_splits=4, shuffle=True, random_state=0))
+    assert len(res["valid binary_logloss-mean"]) == 6
+    # 4 folds -> stdv series exists and is finite
+    assert np.isfinite(res["valid binary_logloss-stdv"]).all()
+    # a non-iterable non-splitter raises like the reference
+    with pytest.raises(AttributeError, match="folds should be"):
+        lgb.cv(BASE, lgb.Dataset(X, label=y), num_boost_round=2, folds=3)
+
+
+def test_cv_ranking_group_aware(rng):
+    """lambdarank cv splits by whole query groups (reference:
+    engine.py:525-532 group_kfold path): every fold's booster must see
+    intact query groups summing to the fold's rows."""
+    nq, qsize = 60, 8
+    n = nq * qsize
+    X = rng.normal(size=(n, 5))
+    rel = (X[:, 0] + 0.2 * rng.normal(size=n))
+    y = np.digitize(rel, np.quantile(rel, [0.5, 0.8])).astype(np.float64)
+    group = np.full(nq, qsize)
+    params = {"objective": "lambdarank", "num_leaves": 15, "verbosity": -1,
+              "metric": "ndcg", "eval_at": "3", "min_data_in_leaf": 5}
+    res = lgb.cv(params, lgb.Dataset(X, label=y, group=group),
+                 num_boost_round=6, nfold=3, seed=7,
+                 return_cvbooster=True)
+    assert "valid ndcg@3-mean" in res
+    assert len(res["valid ndcg@3-mean"]) == 6
+    for bst in res["cvbooster"].boosters:
+        g = np.asarray(bst.train_set.group)
+        # groups kept whole: each fold's train groups are full-size
+        assert (g == qsize).all()
+        assert g.sum() == bst.train_set.num_data()
+    # ndcg improves over training
+    assert res["valid ndcg@3-mean"][-1] >= res["valid ndcg@3-mean"][0] - 1e-9
+
+
+def test_cv_sklearn_groupkfold_ranking(rng):
+    """GroupKFold passed explicitly receives the flattened query ids as
+    groups (reference: engine.py:509-516)."""
+    from sklearn.model_selection import GroupKFold
+    nq, qsize = 40, 6
+    n = nq * qsize
+    X = rng.normal(size=(n, 4))
+    y = (X[:, 0] > 0).astype(np.float64)
+    group = np.full(nq, qsize)
+    params = {"objective": "lambdarank", "num_leaves": 7, "verbosity": -1,
+              "metric": "ndcg", "eval_at": "2", "min_data_in_leaf": 5}
+    res = lgb.cv(params, lgb.Dataset(X, label=y, group=group),
+                 num_boost_round=3,
+                 folds=GroupKFold(n_splits=4), return_cvbooster=True)
+    assert len(res["valid ndcg@2-mean"]) == 3
+    for bst in res["cvbooster"].boosters:
+        g = np.asarray(bst.train_set.group)
+        assert (g == qsize).all()
+
+
+def test_cv_early_stopping_and_callbacks(rng):
+    """cv honors callbacks (log_evaluation cadence) and early stopping
+    sets best_iteration on the returned CVBooster."""
+    X, y = _bin_data(rng, n=800)
+    seen = []
+
+    def spy(env):
+        seen.append((env.iteration,
+                     [e[1] for e in env.evaluation_result_list]))
+
+    res = lgb.cv(dict(BASE, early_stopping_round=3),
+                 lgb.Dataset(X, label=y), num_boost_round=50, nfold=3,
+                 seed=4, callbacks=[spy], return_cvbooster=True)
+    assert seen and seen[0][1] == ["valid binary_logloss"]
+    cvb = res["cvbooster"]
+    assert 1 <= cvb.best_iteration <= 50
+    # reference semantics: series truncated to best_iteration, fold
+    # boosters stamped (engine.py:843-848)
+    if cvb.best_iteration < 50:
+        assert len(res["valid binary_logloss-mean"]) == cvb.best_iteration
+        assert all(b.best_iteration == cvb.best_iteration
+                   for b in cvb.boosters)
+
+
+def test_cv_init_model_continues(rng, tmp_path):
+    """cv(init_model=...) seeds every fold (and its valid scores) from
+    the model, like train(); starting from a trained model must not be
+    worse than a cold start at the same added rounds."""
+    X, y = _bin_data(rng)
+    f = str(tmp_path / "warm.txt")
+    lgb.train(BASE, lgb.Dataset(X, label=y), num_boost_round=12) \
+        .save_model(f)
+    warm = lgb.cv(BASE, lgb.Dataset(X, label=y), num_boost_round=3,
+                  nfold=3, seed=4, init_model=f)
+    cold = lgb.cv(BASE, lgb.Dataset(X, label=y), num_boost_round=3,
+                  nfold=3, seed=4)
+    assert warm["valid binary_logloss-mean"][-1] < \
+        cold["valid binary_logloss-mean"][-1]

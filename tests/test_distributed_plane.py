@@ -120,13 +120,27 @@ params = {"objective": "regression", "num_leaves": 7, "max_bin": 63,
           "deterministic": True}
 ds = lgb.Dataset(X, label=y)
 bst = lgb.Booster(params=params, train_set=ds)
+# round-5 un-gating: multi-process meshes must take the FUSED sharded
+# single-program path (VERDICT r4 #4)
+fused_active = bst._gbdt._fused is not None \
+    and bst._gbdt._init_phys_fn is not None
 for _ in range(20):
     bst.update()
 ev = dict((n, v) for (dn, n, v, mb) in bst.eval_train())
 bst.save_model(out_path + ".model.txt")
+
+# eager arm: same data, fused disabled — must produce the same model
+bst2 = lgb.Booster(params=dict(params, tpu_fused_iteration=False),
+                   train_set=lgb.Dataset(X, label=y))
+eager_off = bst2._gbdt._fused is None
+for _ in range(20):
+    bst2.update()
+bst2.save_model(out_path + ".eager.model.txt")
 with open(out_path, "w") as f:
     json.dump({"rank": pid, "n_local": int(X.shape[0]),
-               "train_l2": ev.get("l2")}, f)
+               "train_l2": ev.get("l2"),
+               "fused_active": bool(fused_active),
+               "eager_off": bool(eager_off)}, f)
 print("WORKER_DONE", pid, flush=True)
 """
 
@@ -137,7 +151,10 @@ def test_two_process_training_matches_single(tmp_path, rng):
     model as single-process training on the union of the shards
     (reference posture: data_parallel_tree_learner.cpp — global
     histograms; binary_objective/gbdt.cpp init-score syncs)."""
-    n, f = 2048, 5
+    n, f = 2049, 5
+    # ODD row count: the two ranks hold unequal shards (1025/1024), so
+    # the fused mesh-id space is GAPPED — regression-guards the pad
+    # sentinel colliding with a real row id (round-5 review finding).
     # integer-grid features: any row subset yields identical BinMappers,
     # isolating the training math from sampling-dependent bin edges
     X = rng.randint(0, 16, size=(n, f)).astype(np.float64)
@@ -159,11 +176,22 @@ def test_two_process_training_matches_single(tmp_path, rng):
     for p, lg_ in zip(procs, logs):
         assert p.returncode == 0, lg_[-3000:]
     r0, r1 = [json.load(open(o)) for o in outs]
+    # the fused sharded path is ACTIVE on the multi-process mesh
+    # (round-4 verdict #4: no more _fused_sharded_reason gate)
+    assert r0["fused_active"] and r1["fused_active"]
+    assert r0["eager_off"] and r1["eager_off"]
     m0 = open(str(outs[0]) + ".model.txt").read()
     m1 = open(str(outs[1]) + ".model.txt").read()
     # every rank materializes the IDENTICAL model (init-score syncs +
     # psum'd histograms): bit-equal text
     assert m0 == m1
+    # eager arm: ranks also bit-equal among themselves; fused vs eager
+    # agree numerically (not bitwise: the fused state keeps rows in
+    # persistent physical order across iterations, so histogram f32
+    # summation order differs — same situation as single-process)
+    e0 = open(str(outs[0]) + ".eager.model.txt").read()
+    e1 = open(str(outs[1]) + ".eager.model.txt").read()
+    assert e0 == e1
     # the synced train metric agrees across ranks
     assert r0["train_l2"] == pytest.approx(r1["train_l2"], rel=1e-9)
 
@@ -183,3 +211,8 @@ def test_two_process_training_matches_single(tmp_path, rng):
     pred_dist = np.asarray(loaded.predict(X))
     assert np.allclose(pred_dist, pred_single, rtol=1e-4, atol=1e-4), \
         np.abs(pred_dist - pred_single).max()
+    # fused (default) and eager sharded paths agree numerically
+    eager = lgb.Booster(model_file=str(outs[0]) + ".eager.model.txt")
+    pred_eager = np.asarray(eager.predict(X))
+    assert np.allclose(pred_dist, pred_eager, rtol=1e-4, atol=1e-4), \
+        np.abs(pred_dist - pred_eager).max()

@@ -111,3 +111,33 @@ def test_split_kernel_interpreted():
         assert row[2:3].view(np.int32)[0] == int(ref.threshold)
         np.testing.assert_allclose(row[0], float(ref.gain),
                                    rtol=2e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("trial", [0, 1])
+def test_partition_kernel_pack_rowid_interpreted(trial):
+    """pack_rowid rides ghi row 2 inside the spare packed-bin bytes;
+    HBM layout must be unchanged (pad bin rows zero, rowid row exact)."""
+    C, G32, G = 256, 32, 28
+    Np = 8 * C
+    rng = np.random.RandomState(100 + trial)
+    pb = rng.randint(0, 250, (G32, Np)).astype(np.uint8)
+    pb[G:] = 0                     # pad rows zero: the dataset invariant
+    pg = rng.randn(8, Np).astype(np.float32)
+    start = int(rng.randint(C, 4 * C))
+    cnt = int(rng.randint(1, 3 * C))
+    col = int(rng.randint(0, G))
+    nb = int(rng.randint(10, 250))
+    thr = int(rng.randint(0, nb))
+    epb, epg, enl = _oracle(pb, pg, start, cnt, col, 0, 0, nb, 0, 0, thr, 0)
+    sc = make_scalars(start, cnt, col, 0, 0, nb, 0, 0, thr, 0)
+    for ghi_live in (3, 5):
+        rpb, rpg, _, rnl = partition_leaf_pallas(
+            jnp.asarray(pb), jnp.asarray(pg),
+            jnp.zeros((sc_rows_for(G32), Np), jnp.int32), sc,
+            row_chunk=C, ghi_live=ghi_live, pack_rowid=True,
+            interpret=True)
+        assert int(np.asarray(rnl)[0, 0]) == enl
+        np.testing.assert_array_equal(np.asarray(rpb), epb)
+        np.testing.assert_array_equal(
+            np.asarray(rpg)[:ghi_live].view(np.int32),
+            epg[:ghi_live].view(np.int32))

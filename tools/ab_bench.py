@@ -132,6 +132,12 @@ def main():
                 "mad_pct": round(100 * mad / med, 2),
                 "blocks": [round(x, 5) for x in v]}
 
+    def kernel_flags(bst):
+        lr = bst._gbdt.learner
+        return {k: bool(getattr(lr, k, False)) for k in
+                ("_use_pallas_part", "_use_pallas_search",
+                 "_use_flat_hist", "_pack_rowid", "_use_pallas")}
+
     sa, sb = stats(times["A"]), stats(times["B"])
     paired = np.asarray(times["B"]) - np.asarray(times["A"])
     delta_med = float(np.median(paired))
@@ -139,6 +145,8 @@ def main():
         "rows": args.rows, "iters_per_block": args.iters,
         "blocks_per_arm": args.blocks,
         "a_params": _parse_overrides(args.a), "b_params": _parse_overrides(args.b),
+        "a_kernels": kernel_flags(boosters["A"]),
+        "b_kernels": kernel_flags(boosters["B"]),
         "A": sa, "B": sb,
         "paired_delta_s_per_iter": round(delta_med, 5),
         "paired_delta_pct_of_A": round(

@@ -44,7 +44,10 @@ C, G32 = 1024, 32
 Np = 10 * C
 rng = np.random.RandomState(7)
 for trial in range(6):
+    pack = trial >= 3          # trials 3-5 exercise pack_rowid
     pb = rng.randint(0, 250, (G32, Np)).astype(np.uint8)
+    if pack:
+        pb[28:] = 0            # pad-row invariant pack_rowid relies on
     pg = rng.randn(8, Np).astype(np.float32)
     start = int(rng.randint(C, 5*C)); cnt = int(rng.randint(0, 4*C))
     col = int(rng.randint(0, 28)); isb = int(rng.rand() < 0.3)
@@ -55,12 +58,14 @@ for trial in range(6):
     sc = make_scalars(start, cnt, col, bstart, isb, nb, dbin, mtype, thr, dl)
     rpb, rpg, _, rnl = partition_leaf_pallas(
         jnp.asarray(pb), jnp.asarray(pg),
-        jnp.zeros((sc_rows_for(G32), Np), jnp.int32), sc, row_chunk=C)
+        jnp.zeros((sc_rows_for(G32), Np), jnp.int32), sc, row_chunk=C,
+        ghi_live=5 if pack else 3, pack_rowid=pack)
     assert int(np.asarray(rnl)[0, 0]) == enl, trial
     np.testing.assert_array_equal(np.asarray(rpb), epb)
-    np.testing.assert_array_equal(np.asarray(rpg)[:3].view(np.int32),
-                                  epg[:3].view(np.int32))
-print("[1/4] partition kernel vs oracle: OK", flush=True)
+    nliv = 5 if pack else 3
+    np.testing.assert_array_equal(np.asarray(rpg)[:nliv].view(np.int32),
+                                  epg[:nliv].view(np.int32))
+print("[1/5] partition kernel vs oracle (incl pack_rowid): OK", flush=True)
 
 # ---- 2. search kernel vs XLA fast search ----
 F, BF = 28, 255
@@ -98,7 +103,7 @@ tile = np.asarray(best_split_pair_pallas(
 for c, ref in enumerate(refs):
     assert tile[c, 1:2].view(np.int32)[0] == int(ref.feature)
     assert tile[c, 2:3].view(np.int32)[0] == int(ref.threshold)
-print("[2/4] search kernel vs XLA fast search: OK", flush=True)
+print("[2/5] search kernel vs XLA fast search: OK", flush=True)
 
 # ---- 3. rowid integrity through build_tree ----
 N = 40000
@@ -114,7 +119,7 @@ idx = np.asarray(rec["indices"])
 r0 = g.learner.row0
 assert np.array_equal(np.sort(idx[r0:r0+N]), np.arange(N)), \
     "rowid row corrupted (stack+concat miscompile regression?)"
-print("[3/4] rowid integrity: OK", flush=True)
+print("[3/5] rowid integrity: OK", flush=True)
 
 # ---- 4. hist-state RMW kernel vs numpy ----
 from lightgbm_tpu.ops.hist_state_pallas import flat_geometry, hist_rmw_pallas
