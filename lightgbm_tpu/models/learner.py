@@ -552,6 +552,14 @@ class SerialTreeLearner:
         # "fixed-cost smoking gun"); the flat (L+1, 8, WL) state is
         # updated in place by ops/hist_state_pallas.py with one-row DMAs.
         self._ab_double = str(getattr(config, "tpu_ab_double", "") or "")
+        # bfloat16_pair: one-hot/gradient OPERANDS in bf16 with f32
+        # accumulation — the TPU analog of the reference GPU's
+        # single-precision histograms (gpu_use_dp=false default,
+        # docs/GPU-Performance.rst); float32 keeps strict CPU-parity
+        self._hist_dtype = (jnp.bfloat16
+                            if str(getattr(config, "tpu_hist_dtype",
+                                           "float32")) == "bfloat16_pair"
+                            else jnp.float32)
         self._use_flat_hist = (self._use_pallas_search
                                and not self._use_pallas
                                and getattr(config, "tpu_hist_state",
@@ -605,7 +613,7 @@ class SerialTreeLearner:
                             num_bins=self.B, row_chunk=self.row_chunk,
                             vary=self._pvary, num_groups=self.G,
                             dtype=(jnp.bfloat16 if scale is not None
-                                   else jnp.float32))
+                                   else self._hist_dtype))
         if scale is not None:
             h = h * scale[None, None, :]
         if self._ab_double == "hist" and scale is None:
@@ -613,7 +621,8 @@ class SerialTreeLearner:
                 h, lambda s2: leaf_hist_slice(
                     part_bins, part_ghi, s2, cnt, num_bins=self.B,
                     row_chunk=self.row_chunk, vary=self._pvary,
-                    num_groups=self.G), part_ghi, start)
+                    num_groups=self.G, dtype=self._hist_dtype),
+                part_ghi, start)
         return h
 
     def _hist_leaf_flat(self, part_bins, part_ghi, start, cnt):
@@ -622,13 +631,15 @@ class SerialTreeLearner:
         h = leaf_hist_slice(part_bins, part_ghi, start, cnt,
                             num_bins=self.B, row_chunk=self.row_chunk,
                             vary=self._pvary, num_groups=self.G,
+                            dtype=self._hist_dtype,
                             flat_geom=self._flat_geom)
         if self._ab_double == "hist":
             h = self._double_opaque(
                 h, lambda s2: leaf_hist_slice(
                     part_bins, part_ghi, s2, cnt, num_bins=self.B,
                     row_chunk=self.row_chunk, vary=self._pvary,
-                    num_groups=self.G, flat_geom=self._flat_geom),
+                    num_groups=self.G, dtype=self._hist_dtype,
+                    flat_geom=self._flat_geom),
                 part_ghi, start)
         return h
 
