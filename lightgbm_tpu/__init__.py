@@ -37,7 +37,7 @@ __all__ = [
     "Dataset", "Booster", "LightGBMError", "CVBooster",
     "train", "cv",
     "early_stopping", "log_evaluation", "record_evaluation", "reset_parameter",
-    "EarlyStopException",
+    "EarlyStopException", "CheckpointCallback",
     "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
     "plot_importance", "plot_metric", "plot_tree", "create_tree_digraph",
     "plot_split_value_histogram", "register_logger",
@@ -49,6 +49,9 @@ def __getattr__(name):
     if name == "register_logger":
         from .utils.log import register_logger
         return register_logger
+    if name == "CheckpointCallback":
+        from .robustness.checkpoint import CheckpointCallback
+        return CheckpointCallback
     if name in ("LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker"):
         from . import sklearn as _sk
         return getattr(_sk, name)

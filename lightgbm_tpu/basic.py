@@ -405,6 +405,10 @@ class Booster:
         self.best_score: Dict[str, Dict[str, float]] = {}
         self._valid_names: List[str] = []
         self._valid_sets: List[Dataset] = []
+        # the train set's eval-row name; engine.train overrides it with
+        # the valid_names entry when the train set is evaluated
+        # (reference: Booster train_data_name / _EarlyStoppingCallback)
+        self._train_data_name = "training"
 
         self.pandas_categorical: Optional[List[List[Any]]] = None
         if train_set is not None:
@@ -527,9 +531,10 @@ class Booster:
     def eval_train(self, feval=None):
         results = []
         for name, val, is_max in self._gbdt.eval_train():
-            results.append(("training", name, val, is_max))
+            results.append((self._train_data_name, name, val, is_max))
         if feval is not None:
-            results.extend(self._custom_eval(feval, "training", train=True))
+            results.extend(self._custom_eval(feval, self._train_data_name,
+                                             train=True))
         return results
 
     def eval_valid(self, feval=None):
@@ -592,9 +597,13 @@ class Booster:
             if mat.shape[1] > nf:
                 mat = mat[:, :nf]
             else:
-                pad = np.full((mat.shape[0], nf - mat.shape[1]), np.nan,
-                              dtype=mat.dtype if np.issubdtype(
-                                  mat.dtype, np.floating) else np.float64)
+                # absent features stay 0.0: the reference C API predicts
+                # from a zero-initialized row buffer, so trees routing
+                # NaN via missing_type=NaN must not see the padding as
+                # missing (ADVICE round 5)
+                pad = np.zeros((mat.shape[0], nf - mat.shape[1]),
+                               dtype=mat.dtype if np.issubdtype(
+                                   mat.dtype, np.floating) else np.float64)
                 mat = np.concatenate([np.asarray(mat, pad.dtype), pad],
                                      axis=1)
         if pred_leaf:

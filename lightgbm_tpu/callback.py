@@ -89,11 +89,14 @@ def reset_parameter(**kwargs) -> Callable:
     return _callback
 
 
-def _is_train_row(item) -> bool:
+def _is_train_row(item, train_name: str = "training") -> bool:
     """True for training-set eval rows, incl. cv aggregate rows labeled
     ("cv_agg", "train <metric>", ...) (reference: callback.py
-    _EarlyStoppingCallback._is_train_set)."""
-    return item[0] == "training" or (
+    _EarlyStoppingCallback._is_train_set compares against the model's
+    ACTUAL train data name, not the literal "training" — a user who
+    names the training eval set e.g. "train" must not have train-set
+    scores drive early stopping)."""
+    return item[0] == train_name or (
         item[0] == "cv_agg" and str(item[1]).startswith("train "))
 
 
@@ -106,8 +109,15 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
     cmp_op: List[Callable] = []
     enabled = [True]
     first_metric = [""]
+    train_name = ["training"]
 
     def _init(env: CallbackEnv) -> None:
+        # the booster's actual train-data name (engine.train stamps it
+        # from valid_names).  Read the instance __dict__: CVBooster's
+        # __getattr__ manufactures a method for ANY name, so a plain
+        # getattr would return a function instead of the default.
+        train_name[0] = env.model.__dict__.get("_train_data_name",
+                                               "training")
         if not env.evaluation_result_list:
             enabled[0] = False
             log.warning("Early stopping is not available in dart mode" if False
@@ -121,7 +131,7 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
         # _EarlyStoppingCallback: train sets never drive stopping; under
         # cv the rows are ("cv_agg", "train <m>"/"valid <m>", ...))
         non_train = [it for it in env.evaluation_result_list
-                     if not _is_train_row(it)]
+                     if not _is_train_row(it, train_name[0])]
         first_metric[0] = (non_train[0][1].split(" ")[-1] if non_train
                            else env.evaluation_result_list[0][1])
         for item in env.evaluation_result_list:
@@ -147,7 +157,7 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
                 best_score_list[i] = env.evaluation_result_list
             if first_metric_only and first_metric[0] != item[1].split(" ")[-1]:
                 continue
-            if _is_train_row(item):
+            if _is_train_row(item, train_name[0]):
                 continue
             if env.iteration - best_iter[i] >= stopping_rounds:
                 if verbose:

@@ -149,17 +149,30 @@ class Application:
         callbacks = None
         if cfg.snapshot_freq and cfg.snapshot_freq > 0:
             # periodic model snapshots (reference: GBDT::Train,
-            # gbdt.cpp:244-248 — "<output_model>.snapshot_iter_<i>")
+            # gbdt.cpp:244-248 — "<output_model>.snapshot_iter_<i>"),
+            # written atomically (temp + rename) so a crash mid-write
+            # never leaves a truncated model file behind
             freq = int(cfg.snapshot_freq)
             out_path = cfg.output_model
 
             def _snapshot(env):
                 it = env.iteration + 1
                 if it % freq == 0:
-                    env.model.save_model(f"{out_path}.snapshot_iter_{it}")
+                    final = f"{out_path}.snapshot_iter_{it}"
+                    tmp = f"{final}.tmp{os.getpid()}"
+                    env.model.save_model(tmp)
+                    os.replace(tmp, final)
 
             _snapshot.order = 100
             callbacks = [_snapshot]
+        if cfg.checkpoint_dir and not cfg.checkpoint_interval:
+            log.warning("checkpoint_dir is set but checkpoint_interval is "
+                        "0; no training checkpoints will be written (set "
+                        "checkpoint_interval=N to checkpoint every N "
+                        "iterations)")
+        if cfg.checkpoint_resume:
+            log.info("checkpoint_resume=true: will resume from the latest "
+                     "checkpoint under %s if one exists", cfg.checkpoint_dir)
         if cfg.is_provide_training_metric:
             # reference: training_metric adds the train set to the
             # evaluated sets (Application::LoadData train_metric path)

@@ -131,6 +131,24 @@ _PARAMS: List[_Param] = [
        ("model_output", "model_out")),
     _p("saved_feature_importance_type", 0, int),
     _p("snapshot_freq", -1, int, ("save_period",)),
+    # --- Robustness (new in this framework; lightgbm_tpu/robustness/) ---
+    # iteration-level checkpointing: every checkpoint_interval iterations
+    # the full training state (model text + scores + RNG streams + eval
+    # history) is written atomically under checkpoint_dir, keeping the
+    # newest checkpoint_keep snapshots; train(resume=True) (or
+    # checkpoint_resume=true) continues bit-exact from the latest one
+    _p("checkpoint_dir", "", str, ("checkpoint_directory",)),
+    _p("checkpoint_interval", 0, int, ("checkpoint_freq",), ">=0"),
+    _p("checkpoint_keep", 2, int, ("checkpoint_keep_last",), ">0"),
+    _p("checkpoint_resume", False, bool, ("resume_from_checkpoint",)),
+    # what to do when gradients/hessians/scores stop being finite:
+    # none (no checks) | raise | skip_iteration | clamp
+    _p("nonfinite_policy", "none", str, ("non_finite_policy",)),
+    # distributed bootstrap hardening (parallel/network.py): retry
+    # attempts around jax.distributed.initialize with exponential
+    # backoff (deadline = time_out)
+    _p("bootstrap_retries", 5, int, (), ">0"),
+    _p("bootstrap_retry_delay", 1.0, float, (), ">0.0"),
     _p("use_quantized_grad", False, bool),
     _p("num_grad_quant_bins", 4, int),
     _p("quant_train_renew_leaf", False, bool),
@@ -352,6 +370,17 @@ def _check_value(param: _Param, v: Any) -> None:
 _WARNED_UNKNOWN: set = set()
 
 
+def reset_unknown_param_warnings() -> None:
+    """Open a fresh unknown-parameter warning scope.
+
+    Called at every top-level ``train()``/``cv()`` entry: within one call
+    Config is legitimately rebuilt several times from the same raw params
+    (Dataset, Booster, engine) and the warning must fire once — but a
+    typo'd key in a LATER, unrelated training session in the same process
+    must warn again, not be swallowed by a process-lifetime set."""
+    _WARNED_UNKNOWN.clear()
+
+
 class Config:
     """Resolved training configuration (reference: include/LightGBM/config.h)."""
 
@@ -389,9 +418,10 @@ class Config:
         # reference: Config surfaces unrecognized keys instead of
         # silently dropping them (include/LightGBM/config.h:1242
         # "Unknown parameter: %s"); a typo'd key (num_leafs) must not
-        # train silently with defaults.  Deduped per process: one train
-        # call legitimately rebuilds Config several times (Dataset,
-        # Booster, engine) from the same raw params.
+        # train silently with defaults.  Deduped per warning scope (one
+        # top-level train()/cv() call, see reset_unknown_param_warnings):
+        # one train call legitimately rebuilds Config several times
+        # (Dataset, Booster, engine) from the same raw params.
         for k in self._unknown:
             if k not in _WARNED_UNKNOWN:
                 _WARNED_UNKNOWN.add(k)

@@ -99,6 +99,36 @@ def _group_parts_by_worker(futures, client):
     return by_worker
 
 
+def _free_port() -> int:
+    """Bind-then-release a kernel-assigned port (runs ON the rank-0
+    worker so the probed port is free on the coordinator HOST)."""
+    import socket
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    try:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("", 0))
+        return int(s.getsockname()[1])
+    finally:
+        s.close()
+
+
+def _probe_coordinator_port(client, worker) -> int:
+    """Free port on the rank-0 worker's host via bind-then-release —
+    unlike a uuid-derived draw from a fixed range, two concurrent
+    distributed fits can't collide (ADVICE round 5).  Falls back to the
+    derived draw if the probe task itself fails."""
+    try:
+        return int(client.submit(_free_port, workers=[worker],
+                                 allow_other_workers=False,
+                                 pure=False).result())
+    except Exception as exc:
+        import uuid
+        port = 12400 + (uuid.uuid4().int % 4000)
+        log.warning("free-port probe on %s failed (%s); falling back to "
+                    "derived port %d", worker, exc, port)
+        return port
+
+
 def _train_part(params, num_boost_round, x_parts, y_parts, w_parts,
                 g_parts, classes, rank, num_machines, coordinator):
     """One rank of the distributed training job, executed ON a dask
@@ -116,13 +146,25 @@ def _train_part(params, num_boost_round, x_parts, y_parts, w_parts,
         jax.distributed.initialize(coordinator_address=coordinator,
                                    num_processes=num_machines,
                                    process_id=rank)
-    except RuntimeError:
+    except RuntimeError as exc:
         # the XLA backend is already up on this worker (a prior task
         # touched JAX): acceptable only if this process already belongs
-        # to an equivalent process group
+        # to an equivalent process group.  jax.distributed.initialize is
+        # once-per-process, so a SECOND distributed fit on persistent
+        # workers with a different group shape can never bootstrap —
+        # fail with the remedy instead of a barrier hang / cryptic error
         if (jax.process_count() != num_machines
                 or jax.process_index() != rank):
-            raise
+            raise RuntimeError(
+                "this dask worker already hosts a jax distributed "
+                f"runtime (process {jax.process_index()} of "
+                f"{jax.process_count()}) and cannot join this fit as "
+                f"rank {rank} of {num_machines}: jax.distributed."
+                "initialize is once-per-process, so only ONE distributed "
+                "fit per worker process is supported.  Restart the "
+                "workers (client.restart()) between distributed fits, "
+                "or pass distributed=False to use the gather-to-client "
+                "path.") from exc
     import lightgbm_tpu as lgb
 
     X = np.concatenate([np.asarray(p) for p in x_parts], axis=0)
@@ -221,15 +263,14 @@ class _DaskLGBMModel:
         params.pop("n_estimators", None)
 
         # rank 0's worker hosts the jax.distributed coordinator.  With no
-        # explicit local_listen_port, derive a per-fit port so two
-        # concurrent distributed fits on one cluster don't collide at
-        # jax.distributed.initialize
+        # explicit local_listen_port, probe a kernel-assigned free port
+        # ON that worker (bind-then-release) so concurrent distributed
+        # fits on one cluster can't collide at jax.distributed.initialize
         host0 = workers[0].split("://")[-1].rsplit(":", 1)[0]
         if params.get("local_listen_port"):
             port = int(params["local_listen_port"])
         else:
-            import uuid
-            port = 12400 + (uuid.uuid4().int % 4000)
+            port = _probe_coordinator_port(client, workers[0])
         coordinator = f"{host0}:{port}"
         log.info("lightgbm_tpu.dask: distributed fit over %d workers "
                  "(%d partitions), coordinator %s",

@@ -14,8 +14,11 @@ import numpy as np
 from . import callback as callback_mod
 from .basic import Booster, Dataset
 from .callback import EarlyStopException
-from .config import Config
+from .config import Config, reset_unknown_param_warnings
+from .robustness import faultinject
+from .robustness.checkpoint import CheckpointCallback, restore_training_state
 from .utils import log
+from .utils.log import LightGBMError
 
 __all__ = ["train", "cv", "CVBooster"]
 
@@ -25,8 +28,17 @@ def train(params: Dict[str, Any], train_set: Dataset,
           valid_sets: Optional[List[Dataset]] = None,
           valid_names: Optional[List[str]] = None,
           feval=None, init_model=None, keep_training_booster: bool = False,
-          callbacks: Optional[List[Callable]] = None) -> Booster:
-    """Train a booster (reference: engine.py train:66)."""
+          callbacks: Optional[List[Callable]] = None,
+          resume: Optional[bool] = None) -> Booster:
+    """Train a booster (reference: engine.py train:66).
+
+    ``resume=True`` (or ``checkpoint_resume=true`` in params) restores
+    the latest checkpoint under ``checkpoint_dir`` and continues the run
+    bit-exact with an uninterrupted one (robustness/checkpoint.py);
+    requires ``checkpoint_dir`` + ``checkpoint_interval`` (or an explicit
+    ``CheckpointCallback`` in ``callbacks``).
+    """
+    reset_unknown_param_warnings()
     params = dict(params or {})
     # LightGBM 4.x style: a callable objective in params drives the custom
     # gradient path (reference: engine.py train:150-160)
@@ -45,12 +57,20 @@ def train(params: Dict[str, Any], train_set: Dataset,
     valid_contain_train = False
     name_valid_sets = []
     if valid_sets is not None:
+        user_named = valid_names is not None
         if valid_names is None:
             valid_names = [f"valid_{i}" for i in range(len(valid_sets))]
         for i, vs in enumerate(valid_sets):
             if vs is train_set:
                 valid_contain_train = True
-                name_valid_sets.append(valid_names[i] if valid_names else "training")
+                # the train set keeps the reference's "training" label
+                # unless the USER named it (auto-filled valid_i must not
+                # leak into eval rows / evals_result keys)
+                train_name = valid_names[i] if user_named else "training"
+                name_valid_sets.append(train_name)
+                # early stopping and eval rows must carry the user's
+                # name for the train set (callback.py _is_train_row)
+                booster._train_data_name = train_name
                 continue
             vs.reference = train_set
             booster.add_valid(vs, valid_names[i])
@@ -63,6 +83,17 @@ def train(params: Dict[str, Any], train_set: Dataset,
         callbacks.append(callback_mod.early_stopping(
             cfg.early_stopping_round, cfg.first_metric_only,
             verbose=cfg.verbosity >= 1, min_delta=cfg.early_stopping_min_delta))
+    # iteration-level checkpointing (robustness/checkpoint.py): auto-wire
+    # the callback from checkpoint_dir/checkpoint_interval unless the
+    # caller passed one explicitly
+    ckpt_cb = next((cb for cb in callbacks
+                    if isinstance(cb, CheckpointCallback)), None)
+    if (ckpt_cb is None and cfg.checkpoint_dir
+            and cfg.checkpoint_interval > 0):
+        ckpt_cb = CheckpointCallback(cfg.checkpoint_dir,
+                                     cfg.checkpoint_interval,
+                                     keep=cfg.checkpoint_keep)
+        callbacks.append(ckpt_cb)
     callbacks_before = [cb for cb in callbacks
                         if getattr(cb, "before_iteration", False)]
     callbacks_after = [cb for cb in callbacks
@@ -71,12 +102,31 @@ def train(params: Dict[str, Any], train_set: Dataset,
     callbacks_after.sort(key=lambda cb: getattr(cb, "order", 0))
 
     booster.best_iteration = -1
-    train_data_name = "training"
-    for i in range(num_boost_round):
+    begin_iteration = 0
+    if resume is None:
+        resume = bool(cfg.checkpoint_resume)
+    if resume:
+        if ckpt_cb is None:
+            raise LightGBMError(
+                "resume=True needs checkpoint_dir and checkpoint_interval "
+                "set (or an explicit CheckpointCallback in callbacks)")
+        state = ckpt_cb.manager.latest()
+        if state is None:
+            log.warning("resume=True but no checkpoint found under %s; "
+                        "starting from scratch", ckpt_cb.manager.dir)
+        else:
+            begin_iteration = restore_training_state(booster, state)
+            ckpt_cb.seed_history(state.eval_history)
+            log.info("resumed training from checkpoint at iteration %d "
+                     "(%s)", begin_iteration, ckpt_cb.manager.dir)
+    for i in range(begin_iteration, num_boost_round):
+        if faultinject.is_active():
+            faultinject.maybe_kill(i)
         for cb in callbacks_before:
             cb(callback_mod.CallbackEnv(
                 model=booster, params=params, iteration=i,
-                begin_iteration=0, end_iteration=num_boost_round,
+                begin_iteration=begin_iteration,
+                end_iteration=num_boost_round,
                 evaluation_result_list=None))
         should_stop = booster.update(fobj=fobj)
         evaluation_result_list = []
@@ -88,7 +138,8 @@ def train(params: Dict[str, Any], train_set: Dataset,
             for cb in callbacks_after:
                 cb(callback_mod.CallbackEnv(
                     model=booster, params=params, iteration=i,
-                    begin_iteration=0, end_iteration=num_boost_round,
+                    begin_iteration=begin_iteration,
+                    end_iteration=num_boost_round,
                     evaluation_result_list=evaluation_result_list))
         except EarlyStopException as es:
             booster.best_iteration = es.best_iteration + 1
@@ -165,6 +216,7 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
        eval_train_metric: bool = False,
        return_cvbooster: bool = False) -> Dict[str, Any]:
     """Cross validation (reference: engine.py cv:580)."""
+    reset_unknown_param_warnings()
     params = dict(params or {})
     fobj = None
     if callable(params.get("objective")):

@@ -20,6 +20,8 @@ import numpy as np
 from ..config import Config
 from ..dataset import BinnedDataset
 from ..ops.predict import predict_leaf_binned
+from ..robustness import faultinject
+from ..robustness.guard import NonFiniteGuard
 from ..utils import log
 from .learner import SerialTreeLearner
 from .metric import Metric, create_metrics
@@ -255,6 +257,9 @@ class GBDT:
         self.config = config
         self.train_data = train_data
         self.objective = objective
+        # non-finite guard rails (robustness/guard.py); active policy
+        # keeps training on the eager path (fused gating below)
+        self._nf_guard = NonFiniteGuard.from_config(config)
         self.models: List[Tree] = []
         self.device_trees: List[Dict[str, Any]] = []  # node arrays + leaf values
         self._continued = False        # set by continue_from
@@ -431,7 +436,7 @@ class GBDT:
         # (their masks are pure jnp); balanced/query bagging do not yet
         fused_on = bool(getattr(cfg, "tpu_fused_iteration", True))
         common_ok = (
-            fused_on
+            fused_on and self._nf_guard is None
             and self.sharded_builder is None and self.objective is not None
             and getattr(self.objective, "is_jit_safe", True)
             and not cfg.linear_tree
@@ -445,7 +450,7 @@ class GBDT:
             # multiclass: all K class trees build inside ONE program per
             # iteration (gbdt.cpp:379's per-class Train loop, device-side)
             self._setup_fused_multiclass()
-        elif (fused_on
+        elif (fused_on and self._nf_guard is None
               and self.sharded_builder is not None
               and self.objective is not None
               and getattr(self.objective, "is_jit_safe", True)
@@ -461,6 +466,10 @@ class GBDT:
             self._setup_fused_sharded()
         if self._fused is None and train_data is not None:
             reasons = []
+            if self._nf_guard is not None:
+                reasons.append(f"nonfinite_policy={self._nf_guard.policy} "
+                               "(the per-iteration guard verdict needs "
+                               "the eager path)")
             if self.sharded_builder is not None:
                 why = getattr(self, "_fused_sharded_reason",
                               "sampling/renewal combo not yet fused")
@@ -1007,7 +1016,8 @@ class GBDT:
         # decisions are synced by the build's all-gather), but the vma
         # checker can't see through the varying intermediates — disable
         # the static check for the replicated layout only
-        smap = functools.partial(jax.shard_map, mesh=mesh,
+        from ..utils.compat import shard_map as _compat_shard_map
+        smap = functools.partial(_compat_shard_map, mesh=mesh,
                                  check_vma=not repl_rows)
         init_sharded = jax.jit(smap(
             init_shard,
@@ -1584,6 +1594,19 @@ class GBDT:
             if self.num_tree_per_iteration > 1 and grad.ndim == 1:
                 grad = grad.reshape(self.num_tree_per_iteration, self.num_data).T
                 hess = hess.reshape(self.num_tree_per_iteration, self.num_data).T
+
+        if faultinject.is_active():
+            grad, hess = faultinject.maybe_corrupt_gradients(
+                self.iter, grad, hess)
+        if self._nf_guard is not None:
+            # one device-side reduction over (grad, hess, scores) BEFORE
+            # sampling (bagging's zeroing could mask a poisoned row); a
+            # skipped iteration builds no tree from the bad batch
+            grad, hess, skip = self._nf_guard.filter(
+                self.iter, grad, hess, self.scores)
+            if skip:
+                self.iter += 1
+                return False
 
         use_sharded = self.sharded_builder is not None
         bag_mask = bag_cnt = None

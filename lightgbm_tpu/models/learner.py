@@ -1399,17 +1399,12 @@ class SerialTreeLearner:
     # ------------------------------------------------------------------
     def _pvary(self, x):
         """Mark a value as device-varying for shard_map's vma type system
-        (loop carries initialized from constants need this under SPMD)."""
+        (loop carries initialized from constants need this under SPMD);
+        identity on runtimes without vma (utils/compat.py)."""
         if self.axis_name is None:
             return x
-
-        def mark(a):
-            vma = getattr(jax.typeof(a), "vma", frozenset())
-            if self.axis_name in vma:
-                return a
-            return jax.lax.pcast(a, (self.axis_name,), to="varying")
-
-        return jax.tree.map(mark, x)
+        from ..utils.compat import mark_device_varying
+        return mark_device_varying(x, self.axis_name)
 
     def _psum(self, x):
         """Histogram sync: global sums only in data-parallel mode (voting

@@ -64,6 +64,17 @@ class ObjectiveFunction:
     def get_gradients(self, score: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
         raise NotImplementedError
 
+    # --- checkpoint support (robustness/checkpoint.py) -----------------
+    # JSON-serializable python-side per-iteration state (e.g. a host PRNG
+    # counter).  Stateless objectives return {}; objectives whose
+    # gradients consume host-side randomness MUST round-trip it here or
+    # crash resume will not be bit-exact.
+    def state_dict(self) -> dict:
+        return {}
+
+    def load_state_dict(self, state: dict) -> None:
+        pass
+
     # --- physical-order fused training support -------------------------
     # Names of the row-aligned attribute arrays the gradient computation
     # reads; they ride the tree builder's partition payload so gradients
@@ -877,6 +888,14 @@ class RankXENDCG(ObjectiveFunction):
         super().__init__(config)
         self.seed = int(config.objective_seed)
         self._iter = 0
+
+    def state_dict(self) -> dict:
+        # the gumbel-noise key is fold_in(seed, _iter): the counter IS
+        # the whole per-iteration RNG state
+        return {"iter": int(self._iter)}
+
+    def load_state_dict(self, state: dict) -> None:
+        self._iter = int(state.get("iter", self._iter))
 
     def init(self, metadata: Metadata) -> None:
         super().init(metadata)

@@ -33,7 +33,9 @@ def init_network(machines: Optional[str] = None,
                  local_listen_port: int = 12400,
                  num_machines: int = 1,
                  machine_rank: Optional[int] = None,
-                 time_out: int = 120) -> None:
+                 time_out: int = 120,
+                 retries: int = 5,
+                 retry_base_delay: float = 1.0) -> None:
     """Initialize multi-host training (reference: Network::Init via
     `LGBM_NetworkInit`, c_api.cpp; socket mesh construction
     linkers_socket.cpp:166).
@@ -43,11 +45,21 @@ def init_network(machines: Optional[str] = None,
     TPU pods where the runtime already knows the topology, calling with
     defaults (or not at all) is fine — `jax.distributed.initialize()`
     auto-detects.
+
+    Hardened bootstrap (robustness/retry.py): a flaky or slow-starting
+    coordinator is retried with capped exponential backoff under a
+    `time_out`-seconds deadline, rank/num_machines disagreements raise a
+    clear error instead of hanging the barrier, and "already
+    initialized" errors are never retried.
     """
     global _initialized
     if _initialized:
         return
     import jax
+
+    from ..robustness import faultinject
+    from ..robustness.retry import retry_with_backoff
+    from ..utils.log import LightGBMError
     if num_machines <= 1 and not machines:
         log.info("init_network: single process; nothing to do")
         _initialized = True
@@ -55,6 +67,14 @@ def init_network(machines: Optional[str] = None,
     kwargs = {}
     if machines:
         hosts = [h.strip() for h in str(machines).split(",") if h.strip()]
+        if num_machines > 1 and len(hosts) > 1 and len(hosts) != num_machines:
+            # every rank hangs on the coordinator barrier if the group
+            # sizes disagree; fail fast with the actionable mismatch
+            raise LightGBMError(
+                f"machines= lists {len(hosts)} hosts but "
+                f"num_machines={num_machines}: every rank must agree on "
+                "the machine list and num_machines (reference: "
+                "config.h network section)")
         coordinator = hosts[0]
         if ":" not in coordinator:
             coordinator = f"{coordinator}:{local_listen_port}"
@@ -64,7 +84,24 @@ def init_network(machines: Optional[str] = None,
         if machine_rank is not None:
             kwargs["process_id"] = machine_rank
     kwargs["initialization_timeout"] = time_out
-    jax.distributed.initialize(**kwargs)
+
+    def _attempt():
+        faultinject.maybe_fail_bootstrap()
+        jax.distributed.initialize(**kwargs)
+
+    retry_with_backoff(
+        _attempt, attempts=max(int(retries), 1),
+        base_delay=float(retry_base_delay), deadline=float(time_out),
+        fatal_if=lambda e: "already initialized" in str(e).lower(),
+        describe="distributed bootstrap (jax.distributed.initialize)")
+    expected = int(kwargs.get("num_processes", num_machines) or 0)
+    actual = jax.process_count()
+    if expected > 1 and actual != expected:
+        raise LightGBMError(
+            f"distributed bootstrap came up with {actual} process(es) but "
+            f"this rank's config says num_machines={expected}: the ranks "
+            "disagree on num_machines / the machines list; fix the "
+            "per-rank configs (all must be identical)")
     _initialized = True
     log.info("init_network: process %d / %d initialized",
              jax.process_index(), jax.process_count())
@@ -77,7 +114,10 @@ def init_from_config(config: Config) -> None:
         init_network(machines=config.machines,
                      local_listen_port=config.local_listen_port,
                      num_machines=config.num_machines,
-                     time_out=config.time_out)
+                     time_out=config.time_out,
+                     retries=getattr(config, "bootstrap_retries", 5),
+                     retry_base_delay=getattr(config, "bootstrap_retry_delay",
+                                              1.0))
 
 
 def num_machines() -> int:

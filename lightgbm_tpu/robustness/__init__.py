@@ -1,0 +1,34 @@
+"""Fault-tolerant training runtime.
+
+Production posture for multi-hour boosting runs on preemptible TPU pods
+(ROADMAP north star): the reference C++ stack assumes a reliable process
+and reliable socket/MPI peers, which large-TPU practice does not grant.
+This package supplies the pieces the training path is wired through:
+
+  * ``checkpoint`` — iteration-level snapshots (model text + RNG/score
+    state + eval history) with atomic write-to-temp-then-rename,
+    keep-last-K retention and a bit-exact ``train(..., resume=True)``
+    path (the TPU analog of the reference's ``snapshot_freq`` model
+    dumps, gbdt.cpp:244-248, which save only the model and cannot
+    resume bit-exact);
+  * ``guard`` — per-iteration non-finite guard rails over
+    gradients/hessians/scores (``nonfinite_policy=raise|skip_iteration|
+    clamp``), one cheap device-side reduction per iteration;
+  * ``retry`` — exponential-backoff-with-deadline used to harden the
+    ``jax.distributed`` bootstrap in ``parallel/network.py``;
+  * ``faultinject`` — a test-only deterministic fault injector (kill at
+    iteration k, corrupt a gradient batch, fail the first N bootstrap
+    attempts) so every behavior above is exercised in tier-1 tests.
+"""
+
+from .checkpoint import (CheckpointCallback, CheckpointManager,
+                         CheckpointState, capture_training_state,
+                         restore_training_state)
+from .guard import NonFiniteGuard
+from .retry import retry_with_backoff
+
+__all__ = [
+    "CheckpointCallback", "CheckpointManager", "CheckpointState",
+    "capture_training_state", "restore_training_state",
+    "NonFiniteGuard", "retry_with_backoff",
+]

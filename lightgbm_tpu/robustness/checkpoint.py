@@ -1,0 +1,388 @@
+"""Iteration-level checkpointing and bit-exact crash resume.
+
+A crash at iteration 900/1000 of a multi-hour preemptible-TPU run must
+not lose everything (ROADMAP north star; the Gemma-on-TPU ops practice
+in PAPERS.md treats periodic checkpointing as table stakes).  The
+reference's ``snapshot_freq`` (gbdt.cpp:244-248) dumps only the model
+text — enough to warm-start via ``init_model``, but NOT bit-exact: the
+continued booster re-seeds its scores from float64 host predictions and
+its RNG streams restart.  A checkpoint here snapshots the full training
+state:
+
+  * the model text (trees + feature infos, self-contained);
+  * the float32 train/validation score arrays exactly as the device
+    holds them;
+  * every python-side RNG stream (bagging, feature-fraction, quantized
+    rounding keys; the objective's iteration counter for objectives
+    with host-side noise) plus the current bagging mask;
+  * the eval history and the booster's best-iteration bookkeeping.
+
+so ``train(..., resume=True)`` continues the run bit-exact with an
+uninterrupted one.  (Exception: the ``early_stopping`` CALLBACK's
+internal patience counters live in closures and are rebuilt at the
+first post-resume iteration — with early stopping enabled a resumed run
+restarts its patience window from the resume point, so it may stop
+later than the uninterrupted run.  The boosting trajectory itself stays
+bit-exact.)  Why that works with the fused physical path: reading
+``GBDT.scores`` materializes the physically-permuted payload back to
+original row order and drops the physical state, which the next fused
+iteration rebuilds from scratch — capture does exactly that read, and it
+happens at the SAME iterations in the uninterrupted run (its checkpoint
+callback fires there too), so both runs see identical state-reset points
+and identical histogram accumulation orders thereafter.
+
+Write protocol: everything lands in a temp directory first, fsynced,
+then ``os.rename``d into place (atomic on POSIX) — a reader never
+observes a half-written checkpoint.  Retention keeps the newest K.
+Under multi-process SPMD every rank CAPTURES (the capture itself is a
+collective-ordering-relevant scores read) but only rank 0 WRITES.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..utils import log
+from ..utils.log import LightGBMError
+
+_PREFIX = "ckpt_"
+_TMP_PREFIX = ".tmp-"
+
+MODEL_FILE = "model.txt"
+STATE_FILE = "state.json"
+ARRAYS_FILE = "arrays.npz"
+
+
+@dataclass
+class CheckpointState:
+    """One checkpoint's payload (see module docstring for the why of
+    each field)."""
+
+    iteration: int
+    model_text: str
+    scores: np.ndarray
+    valid_scores: List[np.ndarray] = field(default_factory=list)
+    rng: Dict[str, np.ndarray] = field(default_factory=dict)
+    bag_mask: Optional[np.ndarray] = None
+    bag_cnt: Optional[int] = None
+    empty_run: int = 0
+    objective_state: Dict[str, Any] = field(default_factory=dict)
+    eval_history: List[Any] = field(default_factory=list)
+    best_iteration: int = -1
+    best_score: Dict[str, Dict[str, float]] = field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# capture / restore
+# ---------------------------------------------------------------------------
+def capture_training_state(booster, iteration: int,
+                           eval_history: Optional[List[Any]] = None
+                           ) -> CheckpointState:
+    """Snapshot a Booster mid-training.  The ``model_to_string`` call
+    flushes any lagged fused records and the ``scores`` read
+    materializes the physical payload — both intentional: they pin the
+    device state to a canonical form at this iteration boundary (and
+    the uninterrupted run's checkpoint callback pins it at the same
+    boundaries, which is what makes resume bit-exact)."""
+    g = booster._gbdt
+    model_text = booster.model_to_string()
+    scores = np.asarray(g.scores)
+    valid_scores = [np.asarray(v) for v in g.valid_scores]
+    rng: Dict[str, np.ndarray] = {}
+    for name in ("bag_rng", "feat_rng", "quant_rng"):
+        key = getattr(g, name, None)
+        if key is not None:
+            rng[name] = np.asarray(key)
+    bag_mask = bag_cnt = None
+    cached = getattr(g, "_cached_bag", None)
+    if cached is not None:
+        bag_mask = np.asarray(cached[0])
+        bag_cnt = int(cached[1])
+    objective_state = {}
+    if g.objective is not None:
+        objective_state = g.objective.state_dict()
+    return CheckpointState(
+        iteration=int(iteration),
+        model_text=model_text,
+        scores=scores,
+        valid_scores=valid_scores,
+        rng=rng,
+        bag_mask=bag_mask,
+        bag_cnt=bag_cnt,
+        empty_run=int(getattr(g, "_empty_run", 0)),
+        objective_state=objective_state,
+        eval_history=list(eval_history or []),
+        best_iteration=int(getattr(booster, "best_iteration", -1)),
+        best_score=dict(getattr(booster, "best_score", {}) or {}),
+    )
+
+
+def restore_training_state(booster, state: CheckpointState) -> int:
+    """Load ``state`` into a freshly constructed, train-set-backed
+    Booster (validation sets already attached) and return the iteration
+    to continue from.  The head trees come back as host trees (real
+    thresholds, no device arrays) exactly like ``init_model``
+    continuation — but scores and RNG streams restore from the saved
+    arrays, NOT from re-prediction, which is what keeps the continued
+    run bit-exact."""
+    import jax.numpy as jnp
+
+    from ..parallel import network
+
+    g = booster._gbdt
+    if network.num_machines() > 1:
+        raise LightGBMError(
+            "checkpoint resume is not supported under multi-process "
+            "training yet: the snapshot holds rank-0 local scores only. "
+            "Restart the whole job from the saved model via init_model "
+            "instead (warm start, not bit-exact).")
+    if type(g).__name__ in ("DART", "RF"):
+        raise LightGBMError(
+            f"checkpoint resume is not supported for boosting="
+            f"{type(g).__name__.lower()}: its per-tree bookkeeping "
+            "(drop weights / fixed-score gradients) needs device trees "
+            "that a restored model does not carry")
+    if g.models:
+        raise LightGBMError("checkpoint resume needs a fresh booster "
+                            "(models already present)")
+    K = g.num_tree_per_iteration
+    # parse the saved trees through the normal model loader
+    from ..basic import Booster as _Booster
+    loaded = _Booster(model_str=state.model_text)
+    g.models = loaded._gbdt.models
+    g.device_trees = [None] * len(g.models)
+    g._model_version += 1
+    g.iter = int(state.iteration)
+    g._empty_run = int(state.empty_run)
+    # the saved head trees already contain the boost-from-average fold
+    # (same reason as GBDT.continue_from)
+    g.init_scores = [0.0] * K
+    g.scores = jnp.asarray(np.asarray(state.scores, np.float32))
+    if len(state.valid_scores) != len(g.valid_scores):
+        raise LightGBMError(
+            f"checkpoint has {len(state.valid_scores)} validation score "
+            f"arrays but the resumed training set up "
+            f"{len(g.valid_scores)} validation sets; pass the same "
+            "valid_sets as the original run")
+    for vi, vs in enumerate(state.valid_scores):
+        g.valid_scores[vi] = jnp.asarray(np.asarray(vs, np.float32))
+    for name, arr in state.rng.items():
+        if getattr(g, name, None) is not None:
+            setattr(g, name, jnp.asarray(np.asarray(arr)))
+    if state.bag_mask is not None:
+        g._cached_bag = (jnp.asarray(np.asarray(state.bag_mask, bool)),
+                         int(state.bag_cnt))
+    if g.objective is not None and state.objective_state:
+        g.objective.load_state_dict(state.objective_state)
+    booster.best_iteration = int(state.best_iteration)
+    booster.best_score = dict(state.best_score or {})
+    return int(state.iteration)
+
+
+# ---------------------------------------------------------------------------
+# on-disk manager
+# ---------------------------------------------------------------------------
+class CheckpointManager:
+    """Atomic, keep-last-K checkpoint directory layout:
+
+        <checkpoint_dir>/ckpt_00000010/{model.txt,state.json,arrays.npz}
+
+    Writers stage under ``.tmp-*`` and rename; readers only ever see
+    complete directories.  ``latest()`` walks newest-to-oldest and skips
+    unreadable entries, so a torn write (crash mid-stage) degrades to
+    the previous checkpoint instead of failing the resume."""
+
+    def __init__(self, checkpoint_dir: str, keep: int = 2):
+        if not checkpoint_dir:
+            raise LightGBMError("checkpoint_dir must be a non-empty path")
+        self.dir = str(checkpoint_dir)
+        self.keep = max(int(keep), 1)
+        os.makedirs(self.dir, exist_ok=True)
+
+    # -- listing -------------------------------------------------------
+    def iterations(self) -> List[int]:
+        out = []
+        for name in os.listdir(self.dir):
+            if name.startswith(_PREFIX):
+                try:
+                    out.append(int(name[len(_PREFIX):]))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    def _path(self, iteration: int) -> str:
+        return os.path.join(self.dir, f"{_PREFIX}{iteration:08d}")
+
+    # -- write ---------------------------------------------------------
+    def save(self, state: CheckpointState) -> str:
+        final = self._path(state.iteration)
+        tmp = os.path.join(
+            self.dir,
+            f"{_TMP_PREFIX}{_PREFIX}{state.iteration:08d}-{os.getpid()}")
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        try:
+            self._write_payload(tmp, state)
+            if os.path.exists(final):
+                shutil.rmtree(final)
+            os.rename(tmp, final)
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        self._prune()
+        log.debug("checkpoint saved at iteration %d -> %s",
+                  state.iteration, final)
+        return final
+
+    @staticmethod
+    def _write_payload(path: str, state: CheckpointState) -> None:
+        def _fsync_write(fname: str, mode: str, writer) -> None:
+            with open(os.path.join(path, fname), mode) as fh:
+                writer(fh)
+                fh.flush()
+                os.fsync(fh.fileno())
+
+        _fsync_write(MODEL_FILE, "w", lambda fh: fh.write(state.model_text))
+        arrays: Dict[str, np.ndarray] = {"scores": state.scores}
+        for vi, vs in enumerate(state.valid_scores):
+            arrays[f"valid_scores_{vi}"] = vs
+        for name, arr in state.rng.items():
+            arrays[f"rng_{name}"] = arr
+        if state.bag_mask is not None:
+            arrays["bag_mask"] = state.bag_mask
+        _fsync_write(ARRAYS_FILE, "wb",
+                     lambda fh: np.savez(fh, **arrays))
+        meta = {
+            "format_version": 1,
+            "iteration": state.iteration,
+            "num_valid_scores": len(state.valid_scores),
+            "rng_names": sorted(state.rng),
+            "bag_cnt": state.bag_cnt,
+            "empty_run": state.empty_run,
+            "objective_state": state.objective_state,
+            "eval_history": _history_to_json(state.eval_history),
+            "best_iteration": state.best_iteration,
+            "best_score": state.best_score,
+        }
+        _fsync_write(STATE_FILE, "w", lambda fh: json.dump(meta, fh))
+
+    def _prune(self) -> None:
+        iters = self.iterations()
+        for it in iters[:-self.keep]:
+            shutil.rmtree(self._path(it), ignore_errors=True)
+        # stale temp dirs from THIS process's earlier (crashed-and-
+        # restarted-in-place) saves only: tmp names are pid-suffixed, and
+        # another live writer sharing this dir must not lose its in-
+        # flight staging directory
+        suffix = f"-{os.getpid()}"
+        for name in os.listdir(self.dir):
+            if name.startswith(_TMP_PREFIX) and name.endswith(suffix):
+                shutil.rmtree(os.path.join(self.dir, name),
+                              ignore_errors=True)
+
+    # -- read ----------------------------------------------------------
+    def load(self, iteration: int) -> CheckpointState:
+        path = self._path(iteration)
+        with open(os.path.join(path, STATE_FILE)) as fh:
+            meta = json.load(fh)
+        with open(os.path.join(path, MODEL_FILE)) as fh:
+            model_text = fh.read()
+        with np.load(os.path.join(path, ARRAYS_FILE)) as npz:
+            scores = np.asarray(npz["scores"])
+            valid_scores = [np.asarray(npz[f"valid_scores_{vi}"])
+                            for vi in range(int(meta["num_valid_scores"]))]
+            rng = {name: np.asarray(npz[f"rng_{name}"])
+                   for name in meta.get("rng_names", [])}
+            bag_mask = (np.asarray(npz["bag_mask"])
+                        if "bag_mask" in npz.files else None)
+        return CheckpointState(
+            iteration=int(meta["iteration"]),
+            model_text=model_text,
+            scores=scores,
+            valid_scores=valid_scores,
+            rng=rng,
+            bag_mask=bag_mask,
+            bag_cnt=meta.get("bag_cnt"),
+            empty_run=int(meta.get("empty_run", 0)),
+            objective_state=meta.get("objective_state") or {},
+            eval_history=_history_from_json(meta.get("eval_history") or []),
+            best_iteration=int(meta.get("best_iteration", -1)),
+            best_score=meta.get("best_score") or {},
+        )
+
+    def latest(self) -> Optional[CheckpointState]:
+        for it in reversed(self.iterations()):
+            try:
+                return self.load(it)
+            except Exception as exc:  # torn write: fall back to older
+                log.warning("checkpoint at iteration %d unreadable (%s); "
+                            "trying the previous one", it, exc)
+        return None
+
+
+def _history_to_json(history: List[Any]) -> List[Any]:
+    # eval rows are (data_name, metric, value, is_max[, stdv]) tuples per
+    # iteration; tuples/np scalars flatten to plain JSON lists
+    out = []
+    for rows in history:
+        out.append([[row[0], row[1], float(row[2]), bool(row[3])]
+                    + ([float(row[4])] if len(row) > 4 else [])
+                    for row in (rows or [])])
+    return out
+
+
+def _history_from_json(history: List[Any]) -> List[Any]:
+    return [[tuple(row) for row in rows] for rows in history]
+
+
+# ---------------------------------------------------------------------------
+# training callback
+# ---------------------------------------------------------------------------
+class CheckpointCallback:
+    """After-iteration callback that records the eval history and writes
+    a checkpoint every ``interval`` iterations (rank 0 only; every rank
+    still captures, keeping SPMD ranks' device state in lockstep).
+
+    Appended automatically by ``train()`` when ``checkpoint_dir`` and
+    ``checkpoint_interval`` are configured, or pass an instance in
+    ``callbacks`` for custom retention."""
+
+    order = 40                     # after record_evaluation/early_stopping
+
+    def __init__(self, checkpoint_dir: str, interval: int, keep: int = 2):
+        if int(interval) <= 0:
+            raise LightGBMError("checkpoint_interval must be > 0")
+        self.manager = CheckpointManager(checkpoint_dir, keep=keep)
+        self.interval = int(interval)
+        self.eval_history: List[Any] = []
+
+    def seed_history(self, history: List[Any]) -> None:
+        """Pre-load the eval history restored from a checkpoint so the
+        post-resume checkpoints carry the full run's history."""
+        self.eval_history = list(history or [])
+
+    def __call__(self, env) -> None:
+        booster = env.model
+        from ..basic import Booster as _Booster
+        if not isinstance(booster, _Booster):
+            # CVBooster's __getattr__ fans any method out per fold, so a
+            # duck check would silently "succeed"; require the real type
+            raise LightGBMError(
+                "CheckpointCallback supports train() boosters only "
+                "(cv() fold ensembles are not checkpointable)")
+        if env.evaluation_result_list:
+            self.eval_history.append(list(env.evaluation_result_list))
+        it = env.iteration + 1
+        if it % self.interval != 0:
+            return
+        state = capture_training_state(booster, it, self.eval_history)
+        from ..parallel import network
+        if network.rank() == 0:
+            self.manager.save(state)

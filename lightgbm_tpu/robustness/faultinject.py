@@ -1,0 +1,108 @@
+"""Deterministic fault injection for testing the robustness runtime.
+
+Test-only: nothing here is imported on the hot path unless an injection
+is armed (`is_active()` is a plain module-bool check).  Three fault
+classes cover the runtime's failure surface:
+
+  * ``kill_at_iteration=k`` — raise ``TrainingKilled`` at the top of
+    boosting iteration k (simulated process death / preemption; the
+    engine never catches it);
+  * ``corrupt_gradients_at=k`` — overwrite the head of the gradient
+    batch with NaN at iteration k (a poisoned input batch), exercising
+    every ``nonfinite_policy``;
+  * ``fail_bootstrap_attempts=n`` — fail the first n distributed
+    bootstrap attempts with a retriable connection error, exercising
+    the backoff path in ``parallel/network.py``.
+
+Injections are process-local and explicit (no env vars): tests call
+``inject(...)`` / ``clear()``, or use the ``injected(...)`` context
+manager which always clears.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+_active = False
+_kill_at: Optional[int] = None
+_corrupt_at: Optional[int] = None
+_corrupt_rows = 16
+_fail_bootstrap_remaining = 0
+bootstrap_attempts_seen = 0
+
+
+class TrainingKilled(RuntimeError):
+    """Simulated process death mid-training (fault injection only)."""
+
+
+class InjectedBootstrapError(ConnectionError):
+    """Retriable injected failure of a distributed bootstrap attempt."""
+
+
+def inject(kill_at_iteration: Optional[int] = None,
+           corrupt_gradients_at: Optional[int] = None,
+           corrupt_rows: int = 16,
+           fail_bootstrap_attempts: int = 0) -> None:
+    """Arm one or more fault injections (iteration indices are 0-based,
+    matching ``GBDT.iter`` at the top of the iteration)."""
+    global _active, _kill_at, _corrupt_at, _corrupt_rows
+    global _fail_bootstrap_remaining, bootstrap_attempts_seen
+    _kill_at = kill_at_iteration
+    _corrupt_at = corrupt_gradients_at
+    _corrupt_rows = int(corrupt_rows)
+    _fail_bootstrap_remaining = int(fail_bootstrap_attempts)
+    bootstrap_attempts_seen = 0
+    _active = (_kill_at is not None or _corrupt_at is not None
+               or _fail_bootstrap_remaining > 0)
+
+
+def clear() -> None:
+    global _active, _kill_at, _corrupt_at, _fail_bootstrap_remaining
+    _active = False
+    _kill_at = None
+    _corrupt_at = None
+    _fail_bootstrap_remaining = 0
+
+
+def is_active() -> bool:
+    return _active
+
+
+@contextlib.contextmanager
+def injected(**kwargs):
+    inject(**kwargs)
+    try:
+        yield
+    finally:
+        clear()
+
+
+def maybe_kill(iteration: int) -> None:
+    if _active and _kill_at is not None and iteration == _kill_at:
+        raise TrainingKilled(
+            f"fault injection: training killed at iteration {iteration}")
+
+
+def maybe_corrupt_gradients(iteration: int, grad, hess):
+    """Return (grad, hess) with the head of the batch NaN-poisoned when
+    this iteration is the armed corruption target."""
+    if not (_active and _corrupt_at is not None and iteration == _corrupt_at):
+        return grad, hess
+    import jax.numpy as jnp
+    n = min(_corrupt_rows, int(grad.shape[0]))
+    grad = jnp.asarray(grad).at[:n].set(jnp.nan)
+    hess = jnp.asarray(hess).at[:n].set(jnp.nan)
+    return grad, hess
+
+
+def maybe_fail_bootstrap() -> None:
+    global _fail_bootstrap_remaining, bootstrap_attempts_seen
+    if not _active:
+        return
+    bootstrap_attempts_seen += 1
+    if _fail_bootstrap_remaining > 0:
+        _fail_bootstrap_remaining -= 1
+        raise InjectedBootstrapError(
+            "fault injection: bootstrap attempt failed "
+            f"({_fail_bootstrap_remaining} injected failures remaining)")

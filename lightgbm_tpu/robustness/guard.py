@@ -1,0 +1,82 @@
+"""Non-finite guard rails on the boosting iteration.
+
+A single NaN/Inf gradient batch silently poisons every subsequent tree
+(leaf values, scores, then the whole model); GPU boosting practice
+(XGBoost GPU, PAPERS.md) shows per-iteration statistics checks are
+cheap relative to histogram work.  ``nonfinite_policy`` selects what
+happens when gradients/hessians/scores stop being finite:
+
+  * ``raise`` — abort with an actionable error naming the iteration;
+  * ``skip_iteration`` — log one warning, drop the iteration (no tree
+    is built from the poisoned batch), continue training;
+  * ``clamp`` — zero the non-finite gradient/hessian entries (the
+    poisoned rows drop out of the tree's sufficient statistics, like an
+    out-of-bag row) and continue.
+
+The check is ONE device-side scalar reduction (`sum(g)+sum(h)+sum(s)`
+is finite iff every element is, modulo sum overflow — which is itself a
+diagnosis) and one host sync per iteration.  Activating a policy keeps
+training on the eager per-stage path: the fused single-program
+iteration cannot surface a mid-program verdict to the host without
+breaking its one-dispatch contract (models/boosting.py gates on this).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..utils import log
+from ..utils.log import LightGBMError
+
+POLICIES = ("raise", "skip_iteration", "clamp")
+_OFF = ("", "none", "off")
+
+
+class NonFiniteGuard:
+    """Per-iteration finiteness check over (grad, hess, scores)."""
+
+    def __init__(self, policy: str):
+        if policy not in POLICIES:
+            log.fatal("Unknown nonfinite_policy %s (expected one of %s)",
+                      policy, "|".join(POLICIES))
+        self.policy = policy
+        self.skipped_iterations = []
+        self.clamped_iterations = []
+
+    @classmethod
+    def from_config(cls, config) -> Optional["NonFiniteGuard"]:
+        policy = str(getattr(config, "nonfinite_policy", "none")).lower()
+        if policy in _OFF:
+            return None
+        return cls(policy)
+
+    def filter(self, iteration: int, grad, hess, scores=None):
+        """Returns (grad, hess, skip).  ``skip`` True means the caller
+        must drop this boosting iteration entirely."""
+        import jax.numpy as jnp
+        total = jnp.sum(grad) + jnp.sum(hess)
+        if scores is not None:
+            total = total + jnp.sum(scores)
+        if bool(jnp.isfinite(total)):
+            return grad, hess, False
+        if self.policy == "raise":
+            raise LightGBMError(
+                f"non-finite gradients/hessians/scores at iteration "
+                f"{iteration}: the input batch, a custom objective, or an "
+                f"exploding learning_rate produced NaN/Inf.  Set "
+                f"nonfinite_policy=skip_iteration or clamp to degrade "
+                f"gracefully instead of aborting.")
+        if self.policy == "skip_iteration":
+            log.warning("nonfinite_policy=skip_iteration: non-finite "
+                        "gradients/hessians/scores at iteration %d; "
+                        "skipping this boosting iteration", iteration)
+            self.skipped_iterations.append(int(iteration))
+            return grad, hess, True
+        # clamp: zero the poisoned entries so the affected rows drop out
+        # of the tree's sufficient statistics (like out-of-bag rows)
+        log.warning("nonfinite_policy=clamp: non-finite gradient/hessian "
+                    "entries at iteration %d zeroed", iteration)
+        self.clamped_iterations.append(int(iteration))
+        grad = jnp.where(jnp.isfinite(grad), grad, 0.0)
+        hess = jnp.where(jnp.isfinite(hess), hess, 0.0)
+        return grad, hess, False

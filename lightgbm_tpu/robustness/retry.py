@@ -1,0 +1,60 @@
+"""Exponential backoff with a deadline.
+
+The distributed bootstrap (``jax.distributed.initialize``) and anything
+else that talks to a flaky coordinator retries through here; the policy
+is the standard large-TPU one (cf. PAPERS.md, Gemma-on-TPU ops
+practice): capped exponential backoff, a wall-clock deadline, and a
+clear terminal error instead of a hang.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional, Tuple, Type
+
+from ..utils import log
+from ..utils.log import LightGBMError
+
+
+def retry_with_backoff(fn: Callable,
+                       attempts: int = 5,
+                       base_delay: float = 1.0,
+                       max_delay: float = 30.0,
+                       deadline: Optional[float] = None,
+                       retriable: Tuple[Type[BaseException], ...] = (
+                           RuntimeError, OSError, ConnectionError,
+                           TimeoutError),
+                       fatal_if: Optional[Callable[[BaseException], bool]]
+                       = None,
+                       describe: str = "operation",
+                       sleep: Callable[[float], None] = time.sleep):
+    """Call ``fn`` until it succeeds, a non-retriable error escapes, the
+    attempt budget runs out, or the next delay would cross ``deadline``
+    seconds of total elapsed time.  ``fatal_if(exc)`` short-circuits
+    retrying for errors that can never heal (e.g. "already initialized").
+    Returns ``fn()``'s result; raises ``LightGBMError`` on exhaustion
+    with the last underlying error chained."""
+    start = time.monotonic()
+    last: Optional[BaseException] = None
+    attempt = 0
+    for attempt in range(1, max(int(attempts), 1) + 1):
+        try:
+            return fn()
+        except retriable as exc:
+            if fatal_if is not None and fatal_if(exc):
+                raise
+            last = exc
+            elapsed = time.monotonic() - start
+            delay = min(base_delay * (2.0 ** (attempt - 1)), max_delay)
+            out_of_budget = attempt >= attempts or (
+                deadline is not None and elapsed + delay > deadline)
+            if out_of_budget:
+                break
+            log.warning("%s failed (attempt %d/%d, %.1fs elapsed): %s; "
+                        "retrying in %.1fs", describe, attempt, attempts,
+                        elapsed, exc, delay)
+            sleep(delay)
+    elapsed = time.monotonic() - start
+    raise LightGBMError(
+        f"{describe} failed after {attempt} attempt(s) over "
+        f"{elapsed:.1f}s: {last}") from last

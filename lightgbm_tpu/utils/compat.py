@@ -1,0 +1,48 @@
+"""JAX version compatibility shims.
+
+The distributed plane is written against the current `jax.shard_map`
+with its vma ("varying-mesh-axes") type system; older runtimes (< 0.5)
+only ship `jax.experimental.shard_map.shard_map` with the `check_rep`
+static check and no vma marking.  Production fleets pin old runtimes
+for months, so the training path degrades instead of crashing with
+``AttributeError: module 'jax' has no attribute 'shard_map'``:
+
+  * `shard_map(...)` resolves the best available implementation and
+    translates `check_vma` (new) to `check_rep=False` (old — the vma
+    annotations the programs rely on don't exist there, so the static
+    replication check must be off to avoid spurious rejections);
+  * `mark_device_varying(x, axis)` is the vma marking when the runtime
+    has it (`jax.lax.pcast`) and the identity otherwise.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None):
+    """Version-portable `jax.shard_map` (keyword-compatible with the
+    `functools.partial(..., mesh=..., check_vma=...)` call sites)."""
+    if hasattr(jax, "shard_map"):
+        kw = {} if check_vma is None else {"check_vma": check_vma}
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kw)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=False)
+
+
+def mark_device_varying(x, axis_name: str):
+    """vma marking for loop carries initialized from constants; identity
+    on runtimes without the vma type system (their shard_map runs with
+    the static check disabled, see `shard_map` above)."""
+    if not hasattr(jax, "typeof") or not hasattr(jax.lax, "pcast"):
+        return x
+
+    def mark(a):
+        vma = getattr(jax.typeof(a), "vma", frozenset())
+        if axis_name in vma:
+            return a
+        return jax.lax.pcast(a, (axis_name,), to="varying")
+
+    return jax.tree.map(mark, x)

@@ -1,0 +1,365 @@
+"""Fault-tolerant training runtime (lightgbm_tpu/robustness/).
+
+Covers the ISSUE-1 acceptance surface: kill-at-iteration-k -> resume
+parity (model text identical to an uninterrupted run, including under
+bagging/GOSS RNG state), every nonfinite_policy mode, checkpoint
+retention/atomicity, and bootstrap retry-then-succeed via deterministic
+fault injection.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.robustness import faultinject
+from lightgbm_tpu.robustness.checkpoint import (CheckpointCallback,
+                                                CheckpointManager)
+from lightgbm_tpu.robustness.retry import retry_with_backoff
+from lightgbm_tpu.utils import log as _log
+from lightgbm_tpu.utils.log import LightGBMError
+
+
+def _data(rng, n=400, f=8, binary=True):
+    X = rng.normal(size=(n, f))
+    w = rng.normal(size=f)
+    raw = X @ w + rng.normal(size=n)
+    y = (raw > 0).astype(np.float64) if binary else raw
+    return X, y
+
+
+def _norm(model_text):
+    """Model text modulo the config-echo lines that legitimately differ
+    between runs (the checkpoint paths themselves)."""
+    return "\n".join(l for l in model_text.split("\n")
+                     if not l.startswith(("[checkpoint_dir",
+                                          "[checkpoint_resume")))
+
+
+def _kill_and_resume(params, X, y, rounds, kill_at, valid=None):
+    """Train-to-kill then resume; returns the resumed model text."""
+    def mk_valid():
+        return ([lgb.Dataset(v[0], label=v[1]) for v in valid]
+                if valid else None)
+    try:
+        with faultinject.injected(kill_at_iteration=kill_at):
+            lgb.train(params, lgb.Dataset(X, label=y),
+                      num_boost_round=rounds, valid_sets=mk_valid())
+        raise AssertionError("fault injection did not kill training")
+    except faultinject.TrainingKilled:
+        pass
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=rounds,
+                    valid_sets=mk_valid(), resume=True)
+    return bst.model_to_string()
+
+
+# ---------------------------------------------------------------------------
+# kill -> resume parity
+# ---------------------------------------------------------------------------
+def test_resume_parity_bagging_fused(rng, tmp_path):
+    """Kill at iteration 13 of 20, resume from the iteration-10
+    checkpoint: model text must be byte-identical to an uninterrupted
+    run — under bagging + feature_fraction RNG (fused physical path)."""
+    X, y = _data(rng)
+    base = dict(objective="binary", num_leaves=15, learning_rate=0.1,
+                bagging_fraction=0.7, bagging_freq=2, feature_fraction=0.8,
+                seed=7, verbosity=-1, metric="", checkpoint_interval=4)
+    ref = lgb.train(dict(base, checkpoint_dir=str(tmp_path / "a")),
+                    lgb.Dataset(X, label=y), num_boost_round=14)
+    resumed = _kill_and_resume(dict(base, checkpoint_dir=str(tmp_path / "b")),
+                               X, y, rounds=14, kill_at=10)
+    assert _norm(ref.model_to_string()) == _norm(resumed)
+
+
+def test_resume_parity_goss(rng, tmp_path):
+    """Same parity under GOSS sampling RNG state."""
+    X, y = _data(rng)
+    base = dict(objective="binary", num_leaves=15,
+                data_sample_strategy="goss", seed=5, verbosity=-1,
+                metric="", checkpoint_interval=4)
+    ref = lgb.train(dict(base, checkpoint_dir=str(tmp_path / "a")),
+                    lgb.Dataset(X, label=y), num_boost_round=12)
+    resumed = _kill_and_resume(dict(base, checkpoint_dir=str(tmp_path / "b")),
+                               X, y, rounds=12, kill_at=9)
+    assert _norm(ref.model_to_string()) == _norm(resumed)
+
+
+def test_resume_parity_eager_custom_objective(rng, tmp_path):
+    """Parity on the eager path (callable objective disables fusion),
+    with a validation set whose restored scores must also match."""
+    X, y = _data(rng, binary=False)
+
+    def fobj(preds, ds):
+        return preds - ds.get_label(), np.ones_like(preds)
+
+    base = dict(objective=fobj, num_leaves=15, feature_fraction=0.7,
+                seed=11, verbosity=-1, metric="l2", checkpoint_interval=4)
+    valid = [(X[:100], y[:100])]
+    ref = lgb.train(dict(base, checkpoint_dir=str(tmp_path / "a")),
+                    lgb.Dataset(X, label=y), num_boost_round=10,
+                    valid_sets=[lgb.Dataset(X[:100], label=y[:100])])
+    resumed = _kill_and_resume(dict(base, checkpoint_dir=str(tmp_path / "b")),
+                               X, y, rounds=10, kill_at=7, valid=valid)
+    assert _norm(ref.model_to_string()) == _norm(resumed)
+
+
+def test_resume_without_checkpoint_starts_fresh(rng, tmp_path):
+    """resume=True over an empty checkpoint_dir trains from scratch."""
+    X, y = _data(rng)
+    params = dict(objective="binary", num_leaves=7, verbosity=-1, metric="",
+                  checkpoint_dir=str(tmp_path / "empty"),
+                  checkpoint_interval=5)
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=6,
+                    resume=True)
+    assert bst.num_trees() == 6
+
+
+def test_resume_requires_checkpoint_config(rng):
+    X, y = _data(rng)
+    with pytest.raises(LightGBMError, match="checkpoint_dir"):
+        lgb.train(dict(objective="binary", verbosity=-1),
+                  lgb.Dataset(X, label=y), num_boost_round=2, resume=True)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint files: retention + atomicity
+# ---------------------------------------------------------------------------
+def test_checkpoint_retention_and_layout(rng, tmp_path):
+    X, y = _data(rng)
+    ckdir = tmp_path / "ck"
+    lgb.train(dict(objective="binary", num_leaves=7, verbosity=-1,
+                   metric="", checkpoint_dir=str(ckdir),
+                   checkpoint_interval=2, checkpoint_keep=2),
+              lgb.Dataset(X, label=y), num_boost_round=10)
+    entries = sorted(os.listdir(ckdir))
+    # keep-last-2 of the 5 aligned iterations, no temp leftovers
+    assert entries == ["ckpt_00000008", "ckpt_00000010"]
+    for e in entries:
+        assert sorted(os.listdir(ckdir / e)) == [
+            "arrays.npz", "model.txt", "state.json"]
+
+
+def test_checkpoint_latest_skips_torn_write(rng, tmp_path):
+    """A truncated newest checkpoint (crash mid-stage would be a tmp dir;
+    a corrupted one is worse) degrades to the previous snapshot."""
+    X, y = _data(rng)
+    ckdir = tmp_path / "ck"
+    lgb.train(dict(objective="binary", num_leaves=7, verbosity=-1,
+                   metric="", checkpoint_dir=str(ckdir),
+                   checkpoint_interval=3, checkpoint_keep=3),
+              lgb.Dataset(X, label=y), num_boost_round=9)
+    mgr = CheckpointManager(str(ckdir), keep=3)
+    assert mgr.iterations() == [3, 6, 9]
+    # tear the newest: drop its arrays file
+    os.remove(ckdir / "ckpt_00000009" / "arrays.npz")
+    state = mgr.latest()
+    assert state is not None and state.iteration == 6
+
+
+def test_checkpoint_callback_rejects_cv(rng, tmp_path):
+    X, y = _data(rng)
+    cb = CheckpointCallback(str(tmp_path / "ck"), interval=2)
+    with pytest.raises(LightGBMError, match="cv"):
+        lgb.cv(dict(objective="binary", num_leaves=7, verbosity=-1),
+               lgb.Dataset(X, label=y), num_boost_round=4, nfold=2,
+               callbacks=[cb])
+
+
+# ---------------------------------------------------------------------------
+# non-finite guard rails
+# ---------------------------------------------------------------------------
+def _train_policy(X, y, policy, rounds=8, corrupt_at=3, capture=None):
+    if capture is not None:
+        _log.register_callback(capture.append)
+    try:
+        with faultinject.injected(corrupt_gradients_at=corrupt_at):
+            return lgb.train(
+                dict(objective="regression", num_leaves=7, verbosity=1,
+                     metric="", nonfinite_policy=policy),
+                lgb.Dataset(X, label=y), num_boost_round=rounds)
+    finally:
+        if capture is not None:
+            _log.register_callback(None)
+
+
+def test_nonfinite_skip_iteration(rng):
+    """Injected NaN batch at iteration 3: training completes with that
+    iteration dropped and EXACTLY one warning naming it."""
+    X, y = _data(rng, binary=False)
+    msgs = []
+    bst = _train_policy(X, y, "skip_iteration", rounds=8, corrupt_at=3,
+                        capture=msgs)
+    assert bst.num_trees() == 7          # 8 rounds, one skipped
+    warns = [m for m in msgs
+             if "skip" in m and "iteration 3" in m and "Warning" in m]
+    assert len(warns) == 1
+    assert np.isfinite(bst.predict(X)).all()
+
+
+def test_nonfinite_raise(rng):
+    X, y = _data(rng, binary=False)
+    with pytest.raises(LightGBMError, match="iteration 2"):
+        _train_policy(X, y, "raise", rounds=5, corrupt_at=2)
+
+
+def test_nonfinite_clamp(rng):
+    X, y = _data(rng, binary=False)
+    bst = _train_policy(X, y, "clamp", rounds=5, corrupt_at=2)
+    assert bst.num_trees() == 5          # poisoned rows dropped, no skip
+    assert np.isfinite(bst.predict(X)).all()
+
+
+def test_nonfinite_policy_off_by_default(rng):
+    """No policy -> no guard: the fused fast path stays enabled."""
+    X, y = _data(rng)
+    bst = lgb.train(dict(objective="binary", num_leaves=7, verbosity=-1,
+                         metric=""),
+                    lgb.Dataset(X, label=y), num_boost_round=3)
+    assert bst._gbdt._nf_guard is None
+    assert bst._gbdt._fused is not None
+
+
+def test_nonfinite_unknown_policy_rejected(rng):
+    X, y = _data(rng)
+    with pytest.raises(LightGBMError, match="nonfinite_policy"):
+        lgb.train(dict(objective="binary", nonfinite_policy="bogus",
+                       verbosity=-1),
+                  lgb.Dataset(X, label=y), num_boost_round=2)
+
+
+# ---------------------------------------------------------------------------
+# hardened distributed bootstrap
+# ---------------------------------------------------------------------------
+def test_bootstrap_retry_then_succeed(monkeypatch):
+    """First 2 bootstrap attempts fail (injected); the retry loop in
+    init_network lands the third attempt."""
+    import jax
+
+    from lightgbm_tpu.parallel import network
+
+    calls = []
+    monkeypatch.setattr(network, "_initialized", False)
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: calls.append(kw))
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    with faultinject.injected(fail_bootstrap_attempts=2):
+        network.init_network(machines="hostA:9999,hostB:9999",
+                             num_machines=2, time_out=60,
+                             retries=5, retry_base_delay=0.01)
+    assert len(calls) == 1
+    assert faultinject.bootstrap_attempts_seen == 3
+    monkeypatch.setattr(network, "_initialized", False)
+
+
+def test_bootstrap_exhausted_attempts_raise(monkeypatch):
+    import jax
+
+    from lightgbm_tpu.parallel import network
+
+    monkeypatch.setattr(network, "_initialized", False)
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: None)
+    with faultinject.injected(fail_bootstrap_attempts=10):
+        with pytest.raises(LightGBMError, match="bootstrap"):
+            network.init_network(machines="hostA:9999,hostB:9999",
+                                 num_machines=2, time_out=60,
+                                 retries=3, retry_base_delay=0.01)
+
+
+def test_bootstrap_num_machines_disagreement(monkeypatch):
+    """machines list length vs num_machines mismatch fails fast with a
+    clear error instead of hanging the coordinator barrier."""
+    from lightgbm_tpu.parallel import network
+
+    monkeypatch.setattr(network, "_initialized", False)
+    with pytest.raises(LightGBMError, match="num_machines=3"):
+        network.init_network(machines="hostA:1,hostB:2", num_machines=3)
+
+
+def test_bootstrap_process_count_disagreement(monkeypatch):
+    """Bootstrap that comes up with the wrong group size raises the
+    rank-disagreement error, not a later hang."""
+    import jax
+
+    from lightgbm_tpu.parallel import network
+
+    monkeypatch.setattr(network, "_initialized", False)
+    monkeypatch.setattr(jax.distributed, "initialize", lambda **kw: None)
+    monkeypatch.setattr(jax, "process_count", lambda: 1)
+    with pytest.raises(LightGBMError, match="disagree"):
+        network.init_network(machines="hostA:9999,hostB:9999",
+                             num_machines=2, retries=1)
+    monkeypatch.setattr(network, "_initialized", False)
+
+
+def test_retry_with_backoff_does_not_retry_fatal():
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise RuntimeError("coordinator already initialized")
+
+    with pytest.raises(RuntimeError, match="already initialized"):
+        retry_with_backoff(fn, attempts=5, base_delay=0.01,
+                           fatal_if=lambda e: "already initialized"
+                           in str(e),
+                           sleep=lambda s: None)
+    assert len(calls) == 1
+
+
+# ---------------------------------------------------------------------------
+# satellites riding this PR
+# ---------------------------------------------------------------------------
+def test_early_stopping_custom_train_name(rng):
+    """A train set named anything but "training" must not drive early
+    stopping, and its eval rows carry the user's name (ADVICE round 5:
+    callback.py:96)."""
+    X, y = _data(rng)
+    ds = lgb.Dataset(X, label=y)
+    history = {}
+    bst = lgb.train(
+        dict(objective="binary", num_leaves=7, verbosity=-1,
+             metric="binary_logloss", early_stopping_round=3),
+        ds, num_boost_round=30,
+        valid_sets=[ds, lgb.Dataset(X[:80], label=y[:80])],
+        valid_names=["train", "v0"],
+        callbacks=[lgb.record_evaluation(history)])
+    assert "train" in history and "v0" in history
+    assert "training" not in history
+    # train loss improves monotonically -> stopping must come from v0's
+    # patience, not be blocked forever by the improving train rows
+    assert bst.best_iteration >= 1
+
+
+def test_predict_disable_shape_check_pads_zero(rng):
+    """Absent feature columns pad with 0.0, matching the reference's
+    zero-initialized row buffer (ADVICE round 5: basic.py:595)."""
+    X, y = _data(rng, f=8)
+    bst = lgb.train(dict(objective="binary", num_leaves=15, verbosity=-1,
+                         metric=""),
+                    lgb.Dataset(X, label=y), num_boost_round=10)
+    narrow = X[:50, :5]
+    padded = np.concatenate([narrow, np.zeros((50, 3))], axis=1)
+    got = bst.predict(narrow, predict_disable_shape_check=True)
+    want = bst.predict(padded)
+    np.testing.assert_allclose(got, want, rtol=0, atol=0)
+
+
+def test_unknown_param_warns_per_train_call(rng):
+    """The unknown-parameter warning fires again in a LATER train() call
+    (dedupe scoped per call, not per process — ADVICE round 5:
+    config.py:395)."""
+    X, y = _data(rng)
+    msgs = []
+    _log.register_callback(msgs.append)
+    try:
+        for _ in range(2):
+            lgb.train(dict(objective="binary", num_leaves=7, verbosity=1,
+                           metric="", num_leafs=31),
+                      lgb.Dataset(X, label=y), num_boost_round=1)
+    finally:
+        _log.register_callback(None)
+    warns = [m for m in msgs if "Unknown parameter: num_leafs" in m]
+    assert len(warns) == 2

@@ -50,6 +50,82 @@ def _parse_overrides(items):
     return out
 
 
+def _fault_smoke(args):
+    """Robustness-cost smoke (`--fault`): the checkpoint guard rails
+    must stay under `--max-overhead-pct` of training wall-clock at the
+    bench config, and kill+resume must land.  Two interleaved full
+    trainings per arm (no-checkpoint vs checkpointing) cancel the slow
+    tunnel drift like the A/B harness does; the report adds the resume
+    wall-clock for a kill at 3/4 of the run."""
+    import shutil
+    import tempfile
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.robustness import faultinject
+
+    rng = np.random.RandomState(7)
+    X = rng.normal(size=(args.rows, args.features)).astype(np.float32)
+    w = rng.normal(size=args.features)
+    y = ((X.dot(w) * 0.5 + rng.normal(size=args.rows)) > 0).astype(np.float32)
+    rounds = args.iters * args.blocks
+    interval = args.ckpt_interval
+    base = {"objective": "binary", "num_leaves": args.leaves,
+            "learning_rate": 0.1, "max_bin": 255, "verbosity": -1,
+            "metric": ""}
+    ds = lgb.Dataset(X, label=y)
+    ds.construct(base)
+    work = tempfile.mkdtemp(prefix="ab-fault-")
+
+    def run(extra=None, nbr=rounds, resume=False):
+        t0 = time.time()
+        bst = lgb.train({**base, **(extra or {})}, ds, num_boost_round=nbr,
+                        resume=resume)
+        return time.time() - t0, bst
+
+    try:
+        run(nbr=max(interval, 2))                 # compile warmup
+        base_times, ckpt_times = [], []
+        for rep in range(args.fault_reps):
+            base_times.append(run()[0])
+            ckpt_dir = os.path.join(work, f"ck{rep}")
+            ckpt_times.append(run({"checkpoint_dir": ckpt_dir,
+                                   "checkpoint_interval": interval})[0])
+        t_base = float(np.median(base_times))
+        t_ckpt = float(np.median(ckpt_times))
+        overhead_pct = 100.0 * (t_ckpt - t_base) / t_base
+
+        resume_dir = os.path.join(work, "resume")
+        ck = {"checkpoint_dir": resume_dir, "checkpoint_interval": interval}
+        kill_at = max((3 * rounds // 4) // interval * interval + 1, 1)
+        try:
+            with faultinject.injected(kill_at_iteration=kill_at):
+                run(ck)
+            raise SystemExit("--fault: kill injection did not fire")
+        except faultinject.TrainingKilled:
+            pass
+        resume_s, bst = run(ck, resume=True)
+        resumed_iters = rounds - (kill_at // interval) * interval
+        report = {
+            "fault_mode": True, "rows": args.rows, "rounds": rounds,
+            "checkpoint_interval": interval,
+            "base_s": [round(t, 3) for t in base_times],
+            "ckpt_s": [round(t, 3) for t in ckpt_times],
+            "checkpoint_overhead_pct": round(overhead_pct, 2),
+            "max_overhead_pct": args.max_overhead_pct,
+            "overhead_ok": overhead_pct < args.max_overhead_pct,
+            "resume_wallclock_s": round(resume_s, 3),
+            "resumed_iterations": resumed_iters,
+            "resumed_trees": int(bst.num_trees()),
+        }
+        print(json.dumps(report))
+        if not report["overhead_ok"]:
+            raise SystemExit(
+                f"--fault: checkpoint overhead {overhead_pct:.2f}% exceeds "
+                f"the {args.max_overhead_pct}% budget")
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--rows", type=int, default=1_000_000)
@@ -64,7 +140,20 @@ def main():
                     help="param override for arm A (repeatable)")
     ap.add_argument("--b", action="append", metavar="K=V",
                     help="param override for arm B (repeatable)")
+    ap.add_argument("--fault", action="store_true",
+                    help="robustness smoke: checkpoint overhead %%, "
+                    "kill+resume wall-clock (asserts the overhead budget)")
+    ap.add_argument("--ckpt-interval", type=int, default=10,
+                    help="--fault: checkpoint every N iterations")
+    ap.add_argument("--fault-reps", type=int, default=3,
+                    help="--fault: interleaved trainings per arm")
+    ap.add_argument("--max-overhead-pct", type=float, default=3.0,
+                    help="--fault: checkpoint overhead budget to assert")
     args = ap.parse_args()
+
+    if args.fault:
+        _fault_smoke(args)
+        return
 
     import jax.numpy as jnp
     import lightgbm_tpu as lgb
