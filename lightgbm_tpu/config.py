@@ -254,6 +254,21 @@ _PARAMS: List[_Param] = [
     # IN-CONTEXT cost as the paired e2e delta ("" | "hist" | "search")
     _p("tpu_ab_double", "", str),
     _p("tpu_partition_kernel", "pallas", str),  # pallas | xla
+    # split mega-kernel: partition + BOTH children's histograms in one
+    # Pallas program per split (ops/split_megakernel_pallas.py) — no
+    # parent-histogram read, no subtraction trick, no (L+1)-slot
+    # histogram state in the while-loop carry.  "auto" probes the kernel
+    # on TPU and falls back to the current split path; "pallas" forces
+    # the attempt; "xla" runs the same math as plain XLA ops (the
+    # correctness oracle, any backend); "off" disables
+    _p("tpu_megakernel", "auto", str),
+    # radix-4 compaction network in the partition/mega kernels: half the
+    # roll-network steps of the binary network (bit-identical layouts;
+    # an instruction-budget lever — see PERF.md round 6)
+    _p("tpu_compact_radix", False, bool),
+    # run the Pallas kernels through the interpreter on any backend
+    # (testing/debug: enables the kernel paths off-TPU; SLOW)
+    _p("tpu_kernel_interpret", False, bool),
     # rows per partition/histogram chunk; 4096 measured best end-to-end
     # on v5e (round 3: fixed cost 15.9 -> 12.1 ms/iter vs 8192 at equal
     # slope — smaller per-split padding waste)

@@ -324,6 +324,19 @@ class GBDT:
     def _setup_training(self, train_data: BinnedDataset) -> None:
         cfg = self.config
         self.learner = SerialTreeLearner(train_data, cfg)
+        # one line of truth about which device kernels actually engaged
+        # (init-time probes fall back silently; the A/B harness and the
+        # bench read these flags to validate an arm really ran what its
+        # params asked for — PERF.md round 5 "kernels confirmed active")
+        _lr = self.learner
+        log.debug(
+            "tree kernels: partition=%s search=%s hist_state=%s mega=%s "
+            "compact=%s",
+            "pallas" if _lr._use_pallas_part else "xla",
+            "pallas" if _lr._use_pallas_search else "xla",
+            "flat" if _lr._use_flat_hist else "xla",
+            _lr._use_mega or "off",
+            "radix4" if _lr._compact_radix else "binary")
         self.sharded_builder = None
         if cfg.tree_learner != "serial":
             import jax as _jax

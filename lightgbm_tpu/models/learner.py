@@ -370,6 +370,11 @@ class SerialTreeLearner:
         # to C-1 rows.  Root range starts at C.
         self.row0 = C
         self.N_pad = C + ((self.N + C - 1) // C + 2) * C
+        # tpu_kernel_interpret runs every Pallas kernel through the
+        # interpreter, enabling the kernel code paths on any backend
+        # (the off-TPU correctness lane for the kernels; SLOW)
+        self._interp = bool(getattr(config, "tpu_kernel_interpret", False))
+        kernel_backend_ok = jax.default_backend() == "tpu" or self._interp
         self._use_pallas = (jax.default_backend() == "tpu"
                             and config.tpu_hist_kernel == "pallas")
         if self._use_pallas:
@@ -402,7 +407,7 @@ class SerialTreeLearner:
         # sublane-padded row buffers: bins to a multiple of 32 (u8 tile),
         # grad/hess/rowid to 8 f32 rows.
         self._use_pallas_part = (
-            jax.default_backend() == "tpu"
+            kernel_backend_ok
             and config.tpu_partition_kernel == "pallas"
             and not self.has_categorical
             and self.cegb_lazy is None
@@ -410,6 +415,8 @@ class SerialTreeLearner:
             and self.F > 0
             and dataset.binned is not None
             and dataset.binned.dtype == np.uint8)
+        self._compact_radix = bool(getattr(config, "tpu_compact_radix",
+                                           False))
         self._pb_rows = self.G
         # (8, N_pad) f32 ghi payload in BOTH partition modes: rows are
         # (grad, hess, rowid-bits, then optional score/objective-payload
@@ -429,13 +436,29 @@ class SerialTreeLearner:
                                     and g32 - self.G >= 4 and g32 >= 16)
                 cpr = self.row_chunk
                 tiny = 4 * cpr
-                out = partition_leaf_pallas(
-                    jnp.zeros((g32, tiny), jnp.uint8),
-                    jnp.zeros((8, tiny), jnp.float32),
-                    jnp.zeros((sc_rows_for(g32), tiny), jnp.int32),
-                    make_scalars(cpr, cpr, 0, 0, 0, 255, 0, 0, 128, 0),
-                    row_chunk=cpr, pack_rowid=self._pack_rowid)
-                jax.block_until_ready(out)
+
+                def _part_probe(radix):
+                    out = partition_leaf_pallas(
+                        jnp.zeros((g32, tiny), jnp.uint8),
+                        jnp.zeros((8, tiny), jnp.float32),
+                        jnp.zeros((sc_rows_for(g32), tiny), jnp.int32),
+                        make_scalars(cpr, cpr, 0, 0, 0, 255, 0, 0, 128, 0),
+                        row_chunk=cpr, pack_rowid=self._pack_rowid,
+                        compact_radix=radix, interpret=self._interp)
+                    jax.block_until_ready(out)
+
+                try:
+                    _part_probe(self._compact_radix)
+                except Exception as exc:
+                    if not self._compact_radix:
+                        raise
+                    # the radix-4 network is an opt-in lever: fall back
+                    # to the proven binary network, not to the XLA path
+                    log.warning("tpu_compact_radix unavailable (%s); "
+                                "using the binary compaction network",
+                                str(exc).split("\n")[0][:120])
+                    self._compact_radix = False
+                    _part_probe(False)
                 self._pb_rows = g32
                 self._ghi_rows = 8
             except Exception as exc:
@@ -538,7 +561,7 @@ class SerialTreeLearner:
                     min_gain_to_split=self.min_gain_to_split,
                     min_data_in_leaf=self.min_data_in_leaf,
                     min_sum_hessian=self.min_sum_hessian,
-                    max_depth=self.max_depth)
+                    max_depth=self.max_depth, interpret=self._interp)
                 jax.block_until_ready(t)
             except Exception as exc:
                 log.warning("pallas split-search kernel unavailable (%s); "
@@ -560,8 +583,13 @@ class SerialTreeLearner:
                             if str(getattr(config, "tpu_hist_dtype",
                                            "float32")) == "bfloat16_pair"
                             else jnp.float32)
+        self._init_megakernel(config, dataset, parallel_mode)
+        # no histogram state exists on the mega path (the children
+        # histograms feed the split search in-register), so the flat
+        # state and its probe compile are skipped entirely there
         self._use_flat_hist = (self._use_pallas_search
                                and not self._use_pallas
+                               and self._use_mega is None
                                and getattr(config, "tpu_hist_state",
                                            "auto") != "xla")
         self._flat_geom = None
@@ -574,7 +602,8 @@ class SerialTreeLearner:
                 out = hist_rmw_pallas(
                     jnp.zeros((4, 8, WL), jnp.float32),
                     jnp.zeros((8, WL), jnp.float32),
-                    jnp.asarray([0, 1, 2, 1], jnp.int32))
+                    jnp.asarray([0, 1, 2, 1], jnp.int32),
+                    interpret=self._interp)
                 jax.block_until_ready(out)
             except Exception as exc:
                 log.warning("pallas hist-state kernel unavailable (%s); "
@@ -590,6 +619,81 @@ class SerialTreeLearner:
         self._best_split_vmapped = jax.vmap(self._leaf_best_split,
                                             in_axes=axes)
         self._build = jax.jit(self._build_impl)
+
+    def _init_megakernel(self, config, dataset, parallel_mode):
+        """Split mega-kernel gate + probe (partition + both-children
+        histograms in ONE Pallas program per split;
+        ops/split_megakernel_pallas.py).  Direct both-children
+        accumulation removes the parent-histogram read, the
+        smaller/larger selection + subtraction machinery and the
+        (L+1)-slot histogram state from the while-loop carry (the
+        round-4 "fixed-cost smoking gun": two contextual full-state
+        copies per split).  "xla" runs the identical math as plain XLA
+        ops — the oracle and the any-backend fallback form.  NOTE the
+        mega path's histogram chunk grid is the parent cover, so its
+        trees are bit-identical to the mega XLA oracle but only
+        numerically equivalent to the subtraction-path trees."""
+        mega_mode = str(getattr(config, "tpu_megakernel", "auto")
+                        or "off").lower()
+        self._use_mega = None
+        mega_eligible = (self._fast_search and self._plain_view
+                         and self.forced is None
+                         and not self.extra_trees
+                         and self.feature_contri is None
+                         and parallel_mode == "serial"
+                         and self.F > 0
+                         and not self.has_categorical
+                         and self.cegb_lazy is None
+                         and self.B <= 256
+                         and dataset.binned is not None
+                         and dataset.binned.dtype == np.uint8
+                         # the in-context doubling probe hooks the
+                         # per-split _hist_leaf calls, which the mega
+                         # path does not make — measuring "hist" with
+                         # mega active would silently read ~0
+                         and self._ab_double != "hist")
+        if mega_mode == "xla":
+            if mega_eligible:
+                self._use_mega = "xla"
+            else:
+                log.warning("tpu_megakernel=xla needs the plain "
+                            "all-numerical serial fast path; using the "
+                            "current split path")
+        elif mega_mode in ("auto", "pallas"):
+            if mega_eligible and self._use_pallas_part:
+                try:
+                    from ..ops.partition_pallas import (make_scalars,
+                                                        sc_rows_for)
+                    from ..ops.split_megakernel_pallas import (
+                        split_megakernel_pallas)
+                    cpr = self.row_chunk
+                    tiny = 4 * cpr
+                    out = split_megakernel_pallas(
+                        jnp.zeros((self._pb_rows, tiny), jnp.uint8),
+                        jnp.zeros((8, tiny), jnp.float32),
+                        jnp.zeros((sc_rows_for(self._pb_rows), tiny),
+                                  jnp.int32),
+                        make_scalars(cpr, cpr, 0, 0, 0, 255, 0, 0, 128, 0),
+                        row_chunk=cpr, num_bins=self.B,
+                        num_groups=self.G,
+                        pack_rowid=self._pack_rowid,
+                        compact_radix=self._compact_radix,
+                        interpret=self._interp)
+                    jax.block_until_ready(out)
+                    self._use_mega = "pallas"
+                except Exception as exc:
+                    log.warning("split mega-kernel unavailable (%s); "
+                                "using the current split path",
+                                str(exc).split("\n")[0][:120])
+            elif mega_mode == "pallas":
+                log.warning("tpu_megakernel=pallas needs the Pallas "
+                            "partition geometry on a kernel-capable "
+                            "backend; using the current split path")
+        elif mega_mode != "off":
+            log.warning("unknown tpu_megakernel=%r; treating as off",
+                        mega_mode)
+        if self._use_mega is not None:
+            log.debug("split mega-kernel active (%s mode)", self._use_mega)
 
     def _rand_bins(self, key):
         """One random threshold per feature (reference:
@@ -828,9 +932,54 @@ class SerialTreeLearner:
         pb, pg, sp, nl = partition_leaf_pallas(
             st["part_bins"], st["part_ghi"], st["sc_packed"],
             scalars, row_chunk=self.row_chunk, ghi_live=self._ghi_live,
-            pack_rowid=getattr(self, "_pack_rowid", False))
+            pack_rowid=getattr(self, "_pack_rowid", False),
+            compact_radix=self._compact_radix, interpret=self._interp)
         moved = {"part_bins": pb, "part_ghi": pg, "sc_packed": sp}
         return moved, nl[0, 0]
+
+    def _split_leaf_mega(self, st, start, cnt, col, decision_scalars,
+                         hist_scale=None):
+        """Mega-path split: partition the leaf AND produce BOTH
+        children's histograms (ops/split_megakernel_pallas.py) — one
+        Pallas program in "pallas" mode, the bit-identical XLA oracle
+        formulation in "xla" mode.  Returns (moved, left_cnt,
+        (hl_g, hl_h, hr_g, hr_h)) with the hist planes (G, Bp)."""
+        from ..ops.split_megakernel_pallas import (both_children_hist_xla,
+                                                   split_megakernel_pallas,
+                                                   unpack_hist4)
+        bstart, isb, nb, dbin, mtype, thr, dl, is_cat, cat_set = \
+            decision_scalars
+        if self._use_mega == "pallas":
+            from ..ops.partition_pallas import make_scalars
+            scalars = make_scalars(start, cnt, col, bstart, isb, nb, dbin,
+                                   mtype, thr, dl)
+            pb, pg, sp, nl, acc = split_megakernel_pallas(
+                st["part_bins"], st["part_ghi"], st["sc_packed"], scalars,
+                row_chunk=self.row_chunk, num_bins=self.B,
+                num_groups=self.G, ghi_live=self._ghi_live,
+                pack_rowid=getattr(self, "_pack_rowid", False),
+                compact_radix=self._compact_radix, interpret=self._interp)
+            moved = {"part_bins": pb, "part_ghi": pg, "sc_packed": sp}
+            left_cnt = nl[0, 0]
+        else:
+            # oracle mode: the SAME chunk grid and accumulation math as
+            # the kernel, as plain XLA ops, over the pre-partition rows
+            acc = both_children_hist_xla(
+                st["part_bins"], st["part_ghi"], start, cnt, col,
+                (bstart, isb, nb, dbin, mtype, thr, dl),
+                row_chunk=self.row_chunk, num_bins=self.B,
+                num_groups=self.G, vary=self._pvary)
+            moved, left_cnt = self._partition_leaf(st, start, cnt, col,
+                                                   decision_scalars)
+        hl_g, hl_h, hr_g, hr_h = unpack_hist4(acc, self.B)
+        if hist_scale is not None:
+            # quantized training: integer carriers accumulated exactly;
+            # the (grad, hess) scales apply once per histogram
+            hl_g = hl_g * hist_scale[0]
+            hr_g = hr_g * hist_scale[0]
+            hl_h = hl_h * hist_scale[1]
+            hr_h = hr_h * hist_scale[1]
+        return moved, left_cnt, (hl_g, hl_h, hr_g, hr_h)
 
     # ------------------------------------------------------------------
     def _load_forced_splits(self, filename, dataset, meta):
@@ -1542,24 +1691,30 @@ class SerialTreeLearner:
             .at[LM_FORCED].set(_i2f(jnp.full((L + 1,), -1, jnp.int32))) \
             .at[:, 0].set(col0)
 
-        use_flat = self._use_flat_hist and hist_scale is None
-        if use_flat:
-            hist0 = jnp.zeros((L + 1, 8, self._flat_geom[2]),
-                              jnp.float32).at[0].set(
-                self._flatten_hist(root_hist))
-        else:
-            hist0 = jnp.zeros((L + 1, G, B, 2),
-                              dtype=jnp.float32).at[0].set(root_hist)
+        use_mega = self._use_mega is not None
+        use_flat = (self._use_flat_hist and hist_scale is None
+                    and not use_mega)
         state = {
             "s": jnp.int32(0),
             "done": jnp.bool_(False),
             "part_bins": part_bins,
             "part_ghi": part_ghi0,
-            "hist": hist0,
             "leafmat": leafmat,
             "nodemat": jnp.zeros((NND, nodes + 1), jnp.float32),
             "feat_used": feat_used0,
         }
+        if not use_mega:
+            # the mega path computes BOTH children's histograms per split
+            # and consumes them in-register: no per-leaf histogram state
+            # rides the while loop at all (and with it go the two
+            # contextual full-state copies per split — PERF.md round 4)
+            if use_flat:
+                state["hist"] = jnp.zeros(
+                    (L + 1, 8, self._flat_geom[2]), jnp.float32).at[0].set(
+                    self._flatten_hist(root_hist))
+            else:
+                state["hist"] = jnp.zeros(
+                    (L + 1, G, B, 2), dtype=jnp.float32).at[0].set(root_hist)
         if self.has_categorical:
             state["best_cat_set"] = jnp.zeros(
                 (L + 1, self.BF), jnp.bool_).at[0].set(best0.cat_set)
@@ -1761,9 +1916,17 @@ class SerialTreeLearner:
                 cnt = jnp.where(valid, _f2i(pcol[LM_CNT]), 0)
                 cnt_g = _f2i(pcol[LM_CNT_G])
 
-                moved, left_cnt = self._partition_leaf(
-                    st, start, cnt, col,
-                    (bstart, isb, nb, dbin, mtype, thr, dl, is_cat, cat_set))
+                mega_hists = None
+                if use_mega:
+                    moved, left_cnt, mega_hists = self._split_leaf_mega(
+                        st, start, cnt, col,
+                        (bstart, isb, nb, dbin, mtype, thr, dl, is_cat,
+                         cat_set), hist_scale)
+                else:
+                    moved, left_cnt = self._partition_leaf(
+                        st, start, cnt, col,
+                        (bstart, isb, nb, dbin, mtype, thr, dl, is_cat,
+                         cat_set))
                 right_cnt = cnt - left_cnt
                 # bag-aware counts come from the (global) histogram estimate
                 # cached with the best split, not from physical range sizes:
@@ -1781,10 +1944,22 @@ class SerialTreeLearner:
                 # smaller child's histogram; larger by subtraction.  The
                 # smaller/larger choice must use GLOBAL counts so every
                 # device computes (and psums) the same child's histogram.
-                small_is_left = left_cnt_g <= right_cnt_g
-                sm_start = jnp.where(small_is_left, l_start, r_start)
-                sm_cnt = jnp.where(small_is_left, left_cnt, right_cnt)
-                if use_flat:
+                # (On the mega path BOTH children came from the kernel —
+                # no subtraction, no histogram state.)
+                if not use_mega:
+                    small_is_left = left_cnt_g <= right_cnt_g
+                    sm_start = jnp.where(small_is_left, l_start, r_start)
+                    sm_cnt = jnp.where(small_is_left, left_cnt, right_cnt)
+                if use_mega:
+                    hist = None
+                    hist_left = hist_right = None
+                    if not self._use_pallas_search:
+                        hl_g, hl_h, hr_g, hr_h = mega_hists
+                        hist_left = jnp.stack(
+                            [hl_g[:, :B], hl_h[:, :B]], axis=2)
+                        hist_right = jnp.stack(
+                            [hr_g[:, :B], hr_h[:, :B]], axis=2)
+                elif use_flat:
                     # in-place one-row DMA read/subtract/write of the
                     # lane-flattened state (ops/hist_state_pallas.py) —
                     # replaces the dynamic-slice formulation whose
@@ -1796,7 +1971,8 @@ class SerialTreeLearner:
                     hist, hl_flat, hr_flat = hist_rmw_pallas(
                         st["hist"], small_flat,
                         jnp.stack([best_leaf, wr_a, wr_b,
-                                   small_is_left.astype(jnp.int32)]))
+                                   small_is_left.astype(jnp.int32)]),
+                        interpret=self._interp)
                     hist_left = hist_right = None
                 else:
                     hist_small = self._psum(self._hist_leaf(
@@ -1936,7 +2112,13 @@ class SerialTreeLearner:
                     # packed [LM_BGAIN..LM_BISCAT] leafmat segments
                     from ..ops.split_pallas import best_split_pair_pallas
                     BFs = self.BF
-                    if use_flat:
+                    if use_mega:
+                        hl_g, hl_h, hr_g, hr_h = mega_hists
+                        hg = jnp.concatenate([hl_g[:, :BFs],
+                                              hr_g[:, :BFs]], axis=0)
+                        hh = jnp.concatenate([hl_h[:, :BFs],
+                                              hr_h[:, :BFs]], axis=0)
+                    elif use_flat:
                         Gf, Bf, _ = self._flat_geom
                         hl = hl_flat.reshape(2, Gf, Bf)
                         hr = hr_flat.reshape(2, Gf, Bf)
@@ -1972,7 +2154,7 @@ class SerialTreeLearner:
                         min_gain_to_split=self.min_gain_to_split,
                         min_data_in_leaf=self.min_data_in_leaf,
                         min_sum_hessian=self.min_sum_hessian,
-                        max_depth=self.max_depth)
+                        max_depth=self.max_depth, interpret=self._interp)
                     if self._ab_double == "search":
                         # measurement-only in-context doubling: the
                         # opaque select blocks CSE; results bit-identical
@@ -1985,7 +2167,8 @@ class SerialTreeLearner:
                             min_gain_to_split=self.min_gain_to_split,
                             min_data_in_leaf=self.min_data_in_leaf,
                             min_sum_hessian=self.min_sum_hessian,
-                            max_depth=self.max_depth)
+                            max_depth=self.max_depth,
+                            interpret=self._interp)
                         tile = jnp.where(opq[0] < 1.0, tile2, tile)
                     col_l = jnp.concatenate(
                         [head_l, tile[0, :13],
@@ -2077,7 +2260,7 @@ class SerialTreeLearner:
                 upd.update({
                     "s": s + valid.astype(jnp.int32),
                     "done": ~valid & ~skip_pending & ~adv_reject,
-                    "hist": hist,
+                    **({} if use_mega else {"hist": hist}),
                     "leafmat": lm2,
                     "feat_used": jnp.where(valid, feat_used_new,
                                            st["feat_used"]),
@@ -2140,7 +2323,8 @@ class SerialTreeLearner:
         if "best_cat_set" in st:
             rec["best_cat_set"] = st["best_cat_set"][:L]
             rec["node_cat_set"] = st["node_cat_set"][:nodes]
-        rec["hist"] = st["hist"][:L]
+        if "hist" in st:   # absent on the mega path (no histogram state)
+            rec["hist"] = st["hist"][:L]
         rec["indices"] = _f2i(st["part_ghi"][2])
         rec["part_grad"] = st["part_ghi"][0]
         rec["part_hess"] = st["part_ghi"][1]

@@ -44,8 +44,8 @@ def flat_geometry(num_groups: int, num_bins: int):
     return Gp, Bp, WL
 
 
-@functools.partial(jax.jit, static_argnames=())
-def hist_rmw_pallas(hist_state, hist_small, idx):
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def hist_rmw_pallas(hist_state, hist_small, idx, *, interpret: bool = False):
     """In-place child-histogram update of the flat state.
 
     Args:
@@ -107,4 +107,5 @@ def hist_rmw_pallas(hist_state, hist_small, idx):
         ],
         grid_spec=grid_spec,
         input_output_aliases={1: 0},
+        interpret=interpret,
     )(idx.astype(jnp.int32), hist_state, hist_small)

@@ -116,6 +116,117 @@ def _compact(payload, flag, shift0, C, logc):
     return cur
 
 
+def _compact_radix4(payload, flag, shift0, C, logc):
+    """Same contract as ``_compact`` but consuming the deficit TWO bits
+    per step (radix-4): ceil(logc/2) network steps instead of logc.
+
+    Each merged step moves every live lane by digit * 4^k where digit is
+    the lane's k-th base-4 deficit digit.  Destinations after a merged
+    step equal the binary network's positions after its two constituent
+    steps, which are collision-free, so the merged move is injective on
+    live lanes and the roll-select mechanism stays sound.  The metadata
+    row rides the SAME rolls as the payload (one (P+1, C) roll per
+    distance instead of separate payload+meta rolls), so a step costs 3
+    rolls + 3 selects where two binary steps cost 4 rolls + 4 selects
+    plus twice the mask arithmetic — the partition kernel is
+    VPU-issue-bound on per-step fixed work, not element throughput
+    (PERF.md round 5), which is what this trades for.
+    """
+    live = jnp.int32(1 << 16)
+    meta = jnp.where(flag != 0, shift0 | live, 0)
+    aug = jnp.concatenate([payload, meta], axis=0)
+    P = payload.shape[0]
+
+    def dig_of(mrow, k, mask_d):
+        d = jax.lax.shift_right_logical(
+            mrow & (live - 1), jnp.broadcast_to(k, mrow.shape)) & mask_d
+        return jnp.where((mrow & live) != 0, d, 0)
+
+    for k in range(0, logc, 2):
+        s = 1 << k
+        nd = 2 if k + 1 < logc else 1      # bits consumed this step
+        mask_d = (1 << nd) - 1
+        d_self = dig_of(aug[P:P + 1], k, mask_d)
+        r1 = pltpu_roll(aug, C - s)
+        m1 = dig_of(r1[P:P + 1], k, mask_d) == 1
+        # an element that moves away and is not overwritten leaves a
+        # hole: clear its live bit (mirrors the binary network)
+        base = jnp.concatenate(
+            [aug[0:P],
+             jnp.where(d_self != 0, aug[P:P + 1] & (live - 1),
+                       aug[P:P + 1])], axis=0)
+        if nd == 2:
+            r2 = pltpu_roll(aug, C - 2 * s)
+            r3 = pltpu_roll(aug, C - 3 * s)
+            m2 = dig_of(r2[P:P + 1], k, mask_d) == 2
+            m3 = dig_of(r3[P:P + 1], k, mask_d) == 3
+            aug = jnp.where(m1, r1,
+                            jnp.where(m2, r2, jnp.where(m3, r3, base)))
+        else:
+            aug = jnp.where(m1, r1, base)
+    return aug[0:P]
+
+
+def payload_codecs(G32: int, ghi_live: int, pack_rowid: bool):
+    """Packed-payload codec closures shared by the partition kernel and
+    the split mega-kernel (ops/split_megakernel_pallas.py).
+
+    Returns (P, W, pack_bins, unpack_bins, make_payload, split_payload):
+    W = G32 // 4 packed bin words; P = compaction payload sublanes.  All
+    row picks are STATIC sublane slices — masked row selects/reductions
+    take a per-tile slow path in Mosaic (round-5 measurement: an
+    iota-compare formulation of the rowid packing ran 15x slower).
+    """
+    W = G32 // 4
+    P = W + ghi_live - (1 if pack_rowid else 0)
+
+    def pack_bins(bins_i32):
+        """(G32, C) i32 byte values -> (W, C) packed words."""
+        return (bins_i32[0:W] | (bins_i32[W:2 * W] << 8) |
+                (bins_i32[2 * W:3 * W] << 16) | (bins_i32[3 * W:4 * W] << 24))
+
+    def unpack_bins(packed):
+        """(W, C) packed words -> (G32, C) i32 byte values."""
+        return jnp.concatenate(
+            [packed & 255, (packed >> 8) & 255,
+             (packed >> 16) & 255, (packed >> 24) & 255], axis=0)
+
+    def make_payload(packed, ghi_i):
+        """(P, C) compaction payload from packed words + live ghi rows;
+        with pack_rowid the rowid bytes overwrite the zero byte-3 slots
+        of words W-4..W-1 and ghi row 2 is dropped."""
+        if not pack_rowid:
+            return jnp.concatenate([packed, ghi_i], axis=0)
+        rowid = ghi_i[2:3]                               # (1, C) i32
+        top = [packed[W - 4 + j:W - 3 + j] |
+               ((jax.lax.shift_right_logical(
+                   rowid, jnp.broadcast_to(8 * j, rowid.shape)) & 255)
+                << 24)
+               for j in range(4)]
+        extra = [ghi_i[3:ghi_live]] if ghi_live > 3 else []
+        return jnp.concatenate(
+            [packed[0:W - 4]] + top + [ghi_i[0:2]] + extra, axis=0)
+
+    def split_payload(pay):
+        """(P, C) payload -> ((W, C) clean packed words, (ghi_live, C)
+        ghi rows in storage order), reconstructing the rowid row."""
+        if not pack_rowid:
+            return pay[0:W], pay[W:P]
+        rowid = None
+        for j in range(4):
+            byte_j = (jax.lax.shift_right_logical(
+                pay[W - 4 + j:W - 3 + j],
+                jnp.broadcast_to(24, (1, pay.shape[1]))) & 255) << (8 * j)
+            rowid = byte_j if rowid is None else rowid | byte_j
+        packed = jnp.concatenate(
+            [pay[0:W - 4], pay[W - 4:W] & 0x00FFFFFF], axis=0)
+        tail = [pay[W + 2:P]] if P > W + 2 else []
+        ghi = jnp.concatenate([pay[W:W + 2], rowid] + tail, axis=0)
+        return packed, ghi
+
+    return P, W, pack_bins, unpack_bins, make_payload, split_payload
+
+
 def pltpu_roll(x, shift):
     from jax.experimental.pallas import tpu as pltpu
     return pltpu.roll(x, shift, 1)
@@ -125,9 +236,29 @@ def _cdiv(a, c):
     return jax.lax.div(a + (c - 1), c)
 
 
+def _decide_left(colv, bstart, isb, nb, dbin, mtype, thr, dl):
+    """Numerical split decision on raw group-column values, all-i32
+    (bool vectors with Python-literal branches trip an i8->i1
+    truncation Mosaic can't lower).  The ONE copy of this arithmetic
+    shared by the partition kernel, the split mega-kernel and its XLA
+    oracle (ops/split_megakernel_pallas.py) — the mega path's
+    bit-exactness contract rides on all of them agreeing; the XLA
+    fallback formulation lives in ops/partition.py split_decision /
+    models/learner.py _goes_left."""
+    fb_raw = colv - bstart
+    in_rb = (fb_raw >= 1) & (fb_raw <= nb - 1)
+    fb = jnp.where(isb == 1, jnp.where(in_rb, fb_raw, dbin), colv)
+    miss_i = jnp.where(
+        mtype == 1, (fb == dbin).astype(jnp.int32),
+        jnp.where(mtype == 2, (fb == nb - 1).astype(jnp.int32), 0))
+    nat_i = (fb <= thr).astype(jnp.int32)
+    return jnp.where(miss_i != 0, dl, nat_i)
+
+
 def partition_leaf_pallas(part_bins, part_ghi, sc_packed, scalars, *,
                           row_chunk: int, ghi_live: int = 3,
                           pack_rowid: bool = False,
+                          compact_radix: bool = False,
                           interpret: bool = False):
     """Two-way stable partition of the leaf range described by
     ``scalars`` (see the S_* layout above), in place.
@@ -148,6 +279,9 @@ def partition_leaf_pallas(part_bins, part_ghi, sc_packed, scalars, *,
         (PERF.md), so this drops P by one for free when G <= G32-4.
         Kernel-internal only: the HBM layout of part_ghi is unchanged
         and the pad bin rows come back zeroed.
+      compact_radix: use the radix-4 compaction network
+        (``_compact_radix4``: half the network steps) instead of the
+        binary one.  Bit-identical output; an issue-budget lever only.
     Returns (part_bins', part_ghi', sc_packed', nl) with the first three
     aliased in place; nl is an (8, 128) i32 tile whose [0, 0] element is
     the left count.
@@ -164,62 +298,15 @@ def partition_leaf_pallas(part_bins, part_ghi, sc_packed, scalars, *,
     C = row_chunk
     assert C >= 256 and (C & (C - 1)) == 0 and Np % 128 == 0
     logc = C.bit_length() - 1
-    W = G32 // 4        # packed bin words
     assert 3 <= ghi_live <= GH
     if pack_rowid:
-        assert W >= 4, "pack_rowid needs >= 4 packed words"
+        assert G32 // 4 >= 4, "pack_rowid needs >= 4 packed words"
     # payload sublanes: bins words + live ghi rows (minus the rowid row
     # when it rides inside the spare bin bytes)
-    P = W + ghi_live - (1 if pack_rowid else 0)
+    P, W, pack_bins, unpack_bins, make_payload, split_payload = \
+        payload_codecs(G32, ghi_live, pack_rowid)
     assert P <= SCR
-
-    def pack_bins(bins_i32):
-        """(G32, C) i32 byte values -> (W, C) packed words."""
-        return (bins_i32[0:W] | (bins_i32[W:2 * W] << 8) |
-                (bins_i32[2 * W:3 * W] << 16) | (bins_i32[3 * W:4 * W] << 24))
-
-    def unpack_bins(packed):
-        """(W, C) packed words -> (G32, C) i32 byte values."""
-        return jnp.concatenate(
-            [packed & 255, (packed >> 8) & 255,
-             (packed >> 16) & 255, (packed >> 24) & 255], axis=0)
-
-    def make_payload(packed, ghi_i):
-        """(P, C) compaction payload from packed words + live ghi rows;
-        with pack_rowid the rowid bytes overwrite the zero byte-3 slots
-        of words W-4..W-1 and ghi row 2 is dropped.  All row picks are
-        STATIC sublane slices — masked row selects/reductions take a
-        per-tile slow path in Mosaic (round-5 measurement: an
-        iota-compare formulation of this same packing ran 15x slower)."""
-        if not pack_rowid:
-            return jnp.concatenate([packed, ghi_i], axis=0)
-        rowid = ghi_i[2:3]                               # (1, C) i32
-        top = [packed[W - 4 + j:W - 3 + j] |
-               ((jax.lax.shift_right_logical(
-                   rowid, jnp.broadcast_to(8 * j, rowid.shape)) & 255)
-                << 24)
-               for j in range(4)]
-        extra = [ghi_i[3:ghi_live]] if ghi_live > 3 else []
-        return jnp.concatenate(
-            [packed[0:W - 4]] + top + [ghi_i[0:2]] + extra, axis=0)
-
-    def split_payload(pay):
-        """(P, C) payload -> ((W, C) clean packed words, (ghi_live, C)
-        ghi rows in storage order), reconstructing the rowid row.
-        Static sublane slices only (see make_payload)."""
-        if not pack_rowid:
-            return pay[0:W], pay[W:P]
-        rowid = None
-        for j in range(4):
-            byte_j = (jax.lax.shift_right_logical(
-                pay[W - 4 + j:W - 3 + j],
-                jnp.broadcast_to(24, (1, pay.shape[1]))) & 255) << (8 * j)
-            rowid = byte_j if rowid is None else rowid | byte_j
-        packed = jnp.concatenate(
-            [pay[0:W - 4], pay[W - 4:W] & 0x00FFFFFF], axis=0)
-        tail = [pay[W + 2:P]] if P > W + 2 else []
-        ghi = jnp.concatenate([pay[W:W + 2], rowid] + tail, axis=0)
-        return packed, ghi
+    compact = _compact_radix4 if compact_radix else _compact
 
     def kernel(s_ref, pb_in, pg_in, sp_in, pb, pg, sp, nl_ref,
                rb, rg, rs, stgl, stgr, wb, wg, wp, exb, exg, sems):
@@ -279,20 +366,9 @@ def partition_leaf_pallas(part_bins, part_ghi, sc_packed, scalars, *,
                            keepdims=True)                     # (1, C)
             colv = jax.lax.shift_right_logical(
                 word, jnp.broadcast_to(col_sh, word.shape)) & 255
-            bstart = s_ref[S_BSTART]
-            fb_raw = colv - bstart
-            in_rb = (fb_raw >= 1) & (fb_raw <= s_ref[S_NB] - 1)
-            fb = jnp.where(s_ref[S_ISB] == 1,
-                           jnp.where(in_rb, fb_raw, s_ref[S_DBIN]), colv)
-            mtype = s_ref[S_MTYPE]
-            # all-i32 logic: bool vectors with Python-literal branches
-            # trip an i8->i1 truncation Mosaic can't lower
-            miss_i = jnp.where(
-                mtype == 1, (fb == s_ref[S_DBIN]).astype(jnp.int32),
-                jnp.where(mtype == 2,
-                          (fb == s_ref[S_NB] - 1).astype(jnp.int32), 0))
-            nat_i = (fb <= s_ref[S_THR]).astype(jnp.int32)
-            gl_i = jnp.where(miss_i != 0, s_ref[S_DL], nat_i)
+            gl_i = _decide_left(colv, s_ref[S_BSTART], s_ref[S_ISB],
+                                s_ref[S_NB], s_ref[S_DBIN],
+                                s_ref[S_MTYPE], s_ref[S_THR], s_ref[S_DL])
 
             pos = ci * C + lane                 # cover-relative position
             before_i = (pos < rem).astype(jnp.int32)
@@ -305,8 +381,8 @@ def partition_leaf_pallas(part_bins, part_ghi, sc_packed, scalars, *,
             nl_cnt = nl_cnt + nlc
             nrc = C - nlc
 
-            lcomp = _compact(payload, left, pnr, C, logc)
-            rcomp = _compact(payload, 1 - left, lane - pnr, C, logc)
+            lcomp = compact(payload, left, pnr, C, logc)
+            rcomp = compact(payload, 1 - left, lane - pnr, C, logc)
 
             def stage(stg, comp, fill, n_add):
                 # place comp[0:n_add) at staging positions [fill, +n_add)

@@ -57,6 +57,7 @@ _TMP_PREFIX = ".tmp-"
 MODEL_FILE = "model.txt"
 STATE_FILE = "state.json"
 ARRAYS_FILE = "arrays.npz"
+HISTORY_FILE = "history.jsonl"
 
 
 @dataclass
@@ -204,6 +205,79 @@ class CheckpointManager:
         self.dir = str(checkpoint_dir)
         self.keep = max(int(keep), 1)
         os.makedirs(self.dir, exist_ok=True)
+        # entries of history.jsonl this manager knows are on disk; None
+        # until the first save, which REWRITES the log (truncating any
+        # stale tail from a killed run) before switching to appends
+        self._hist_logged: Optional[int] = None
+
+    # -- eval-history append log --------------------------------------
+    # state.json used to re-serialize the FULL eval history at every
+    # checkpoint, so the per-checkpoint cost grew linearly with
+    # iterations trained (PERF.md).  The history now lives in one
+    # append-only <checkpoint_dir>/history.jsonl shared by all
+    # checkpoints (one JSON line per evaluated iteration); each
+    # state.json records only ITS history LENGTH, and restore caps the
+    # log at that length to reconstruct the full history.  The log
+    # grows O(total iterations) on disk, but a checkpoint append is
+    # O(delta) instead of O(history).
+    #
+    # ONE TRAINING RUN PER checkpoint_dir: like the ckpt_NNNN
+    # directories themselves (which same-iteration writers replace
+    # wholesale), the shared log assumes a single live writer — two
+    # INDEPENDENT runs pointed at one directory interleave/truncate
+    # each other's history exactly as they already clobber each other's
+    # checkpoints.  (Multi-process SPMD is fine: only rank 0 writes.)
+    @property
+    def history_path(self) -> str:
+        return os.path.join(self.dir, HISTORY_FILE)
+
+    def _sync_history(self, history: List[Any]) -> None:
+        rows = _history_to_json(history)
+        if (self._hist_logged is None and not rows
+                and not os.path.exists(self.history_path)):
+            # no evals recorded and no stale log: don't create an empty
+            # file (runs without valid sets keep a clean directory)
+            self._hist_logged = 0
+            return
+        if self._hist_logged is None or self._hist_logged > len(rows):
+            # first save of this run (or a rewound history): rewrite the
+            # log atomically so stale tails from a killed run vanish
+            tmp = self.history_path + f".tmp-{os.getpid()}"
+            with open(tmp, "w") as fh:
+                for row in rows:
+                    fh.write(json.dumps(row) + "\n")
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.rename(tmp, self.history_path)
+        elif self._hist_logged < len(rows):
+            with open(self.history_path, "a") as fh:
+                for row in rows[self._hist_logged:]:
+                    fh.write(json.dumps(row) + "\n")
+                fh.flush()
+                os.fsync(fh.fileno())
+        self._hist_logged = len(rows)
+
+    def _read_history(self, upto: int) -> List[Any]:
+        if upto <= 0 or not os.path.exists(self.history_path):
+            if upto > 0:
+                log.warning("checkpoint expects %d eval-history entries "
+                            "but %s is missing; resuming with an empty "
+                            "history", upto, self.history_path)
+            return []
+        rows: List[Any] = []
+        with open(self.history_path) as fh:
+            for line in fh:
+                if len(rows) >= upto:
+                    break
+                try:
+                    rows.append(json.loads(line))
+                except ValueError:
+                    break       # torn trailing line from a crash
+        if len(rows) < upto:
+            log.warning("eval-history log holds %d of the %d entries "
+                        "this checkpoint recorded; the tail is lost",
+                        len(rows), upto)
+        return _history_from_json(rows)
 
     # -- listing -------------------------------------------------------
     def iterations(self) -> List[int]:
@@ -229,6 +303,7 @@ class CheckpointManager:
             shutil.rmtree(tmp)
         os.makedirs(tmp)
         try:
+            self._sync_history(state.eval_history)
             self._write_payload(tmp, state)
             if os.path.exists(final):
                 shutil.rmtree(final)
@@ -260,14 +335,16 @@ class CheckpointManager:
         _fsync_write(ARRAYS_FILE, "wb",
                      lambda fh: np.savez(fh, **arrays))
         meta = {
-            "format_version": 1,
+            "format_version": 2,
             "iteration": state.iteration,
             "num_valid_scores": len(state.valid_scores),
             "rng_names": sorted(state.rng),
             "bag_cnt": state.bag_cnt,
             "empty_run": state.empty_run,
             "objective_state": state.objective_state,
-            "eval_history": _history_to_json(state.eval_history),
+            # the history itself lives in the shared append-only
+            # history.jsonl; each checkpoint stores only its LENGTH
+            "eval_history_len": len(state.eval_history),
             "best_iteration": state.best_iteration,
             "best_score": state.best_score,
         }
@@ -286,6 +363,12 @@ class CheckpointManager:
             if name.startswith(_TMP_PREFIX) and name.endswith(suffix):
                 shutil.rmtree(os.path.join(self.dir, name),
                               ignore_errors=True)
+            elif name == f"{HISTORY_FILE}.tmp{suffix}":
+                # staging file from a crashed history rewrite
+                try:
+                    os.remove(os.path.join(self.dir, name))
+                except OSError:
+                    pass
 
     # -- read ----------------------------------------------------------
     def load(self, iteration: int) -> CheckpointState:
@@ -302,6 +385,11 @@ class CheckpointManager:
                    for name in meta.get("rng_names", [])}
             bag_mask = (np.asarray(npz["bag_mask"])
                         if "bag_mask" in npz.files else None)
+        if "eval_history" in meta:     # format_version 1 compatibility
+            history = _history_from_json(meta.get("eval_history") or [])
+        else:
+            history = self._read_history(int(meta.get("eval_history_len",
+                                                      0)))
         return CheckpointState(
             iteration=int(meta["iteration"]),
             model_text=model_text,
@@ -312,7 +400,7 @@ class CheckpointManager:
             bag_cnt=meta.get("bag_cnt"),
             empty_run=int(meta.get("empty_run", 0)),
             objective_state=meta.get("objective_state") or {},
-            eval_history=_history_from_json(meta.get("eval_history") or []),
+            eval_history=history,
             best_iteration=int(meta.get("best_iteration", -1)),
             best_score=meta.get("best_score") or {},
         )

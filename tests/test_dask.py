@@ -232,3 +232,35 @@ def test_real_dask_roundtrip():
         est.fit(dX, dy, client=client)
         pred = np.asarray(est.predict(dX))
         assert np.mean(pred == y) > 0.9
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not DASK_INSTALLED, reason="dask not installed")
+def test_real_dask_distributed_two_workers_matches_gather():
+    """distributed=True on a REAL 2-process LocalCluster: each dask
+    worker becomes a jax.distributed rank over its resident partitions
+    (the per-worker plane the fake-client test drives via
+    subprocesses), and the result must match the gather-to-client
+    path's model — data-parallel histograms change only f32 summation
+    order, so predictions agree to float noise.  Slow: spawns worker
+    processes and a jax.distributed coordinator."""
+    import dask.array as da
+    from distributed import Client, LocalCluster
+    X, y = _make_data(n=1200)
+    with LocalCluster(n_workers=2, threads_per_worker=1, processes=True,
+                      dashboard_address=None) as cluster, \
+            Client(cluster) as client:
+        dX = da.from_array(X, chunks=(300, X.shape[1]))
+        dy = da.from_array(y, chunks=(300,))
+        kw = dict(n_estimators=8, num_leaves=15, verbosity=-1,
+                  min_child_samples=5)
+        dist = DaskLGBMClassifier(**kw).fit(dX, dy, client=client,
+                                            distributed=True)
+        gath = DaskLGBMClassifier(**kw).fit(dX, dy, client=client,
+                                            distributed=False)
+        pd_dist = np.asarray(dist.predict(dX, raw_score=True))
+        pd_gath = np.asarray(gath.predict(dX, raw_score=True))
+        np.testing.assert_allclose(pd_dist, pd_gath, rtol=1e-3,
+                                   atol=1e-4)
+        pred = np.asarray(dist.predict(dX))
+        assert np.mean(pred == y) > 0.9

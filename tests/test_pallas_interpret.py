@@ -11,6 +11,8 @@ from lightgbm_tpu.ops.partition_pallas import (partition_leaf_pallas,
                                                make_scalars, sc_rows_for)
 from lightgbm_tpu.ops import split as so
 from lightgbm_tpu.ops.split_pallas import best_split_pair_pallas
+from lightgbm_tpu.ops.split_megakernel_pallas import (
+    both_children_hist_xla, split_megakernel_pallas, unpack_hist4)
 
 
 def _oracle(pb, pg, start, cnt, col, bstart, isb, nb, dbin, mtype, thr, dl):
@@ -111,6 +113,115 @@ def test_split_kernel_interpreted():
         assert row[2:3].view(np.int32)[0] == int(ref.threshold)
         np.testing.assert_allclose(row[0], float(ref.gain),
                                    rtol=2e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("trial", [0, 1])
+def test_partition_kernel_radix4_interpreted(trial):
+    """The radix-4 compaction network must produce the identical stable
+    partition layout as the binary network (trial 1 adds pack_rowid)."""
+    C, G32, G = 256, 32, 28
+    Np = 8 * C
+    rng = np.random.RandomState(40 + trial)
+    pack = trial == 1
+    pb = rng.randint(0, 250, (G32, Np)).astype(np.uint8)
+    if pack:
+        pb[G:] = 0
+    pg = rng.randn(8, Np).astype(np.float32)
+    start = int(rng.randint(C, 4 * C))
+    cnt = int(rng.randint(1, 3 * C))
+    col = int(rng.randint(0, G))
+    nb = int(rng.randint(10, 250))
+    thr = int(rng.randint(0, nb))
+    epb, epg, enl = _oracle(pb, pg, start, cnt, col, 0, 0, nb, 0, 0, thr, 1)
+    sc = make_scalars(start, cnt, col, 0, 0, nb, 0, 0, thr, 1)
+    rpb, rpg, _, rnl = partition_leaf_pallas(
+        jnp.asarray(pb), jnp.asarray(pg),
+        jnp.zeros((sc_rows_for(G32), Np), jnp.int32), sc,
+        row_chunk=C, pack_rowid=pack, compact_radix=True, interpret=True)
+    assert int(np.asarray(rnl)[0, 0]) == enl
+    np.testing.assert_array_equal(np.asarray(rpb), epb)
+    np.testing.assert_array_equal(
+        np.asarray(rpg)[:3].view(np.int32), epg[:3].view(np.int32))
+
+
+@pytest.mark.parametrize("trial,radix", [(0, False), (1, True)])
+def test_megakernel_interpreted(trial, radix):
+    """Mega-kernel: the partition must match the NumPy oracle bit-exact
+    AND the both-children histogram accumulator must match the XLA
+    oracle (both_children_hist_xla) bit-exact — the same chunk grid and
+    accumulation math by construction."""
+    C, G32, G, B = 256, 32, 28, 255
+    Np = 8 * C
+    rng = np.random.RandomState(60 + trial)
+    pb = rng.randint(0, 250, (G32, Np)).astype(np.uint8)
+    pg = rng.randn(8, Np).astype(np.float32)
+    start = int(rng.randint(C, 4 * C))
+    cnt = int(rng.randint(1, 3 * C))
+    col = int(rng.randint(0, G))
+    nb = int(rng.randint(10, 250))
+    mtype = int(rng.randint(0, 3))
+    dbin = int(rng.randint(0, nb))
+    thr = int(rng.randint(0, nb))
+    dl = int(rng.rand() < 0.5)
+    epb, epg, enl = _oracle(pb, pg, start, cnt, col, 0, 0, nb, dbin,
+                            mtype, thr, dl)
+    sc = make_scalars(start, cnt, col, 0, 0, nb, dbin, mtype, thr, dl)
+    rpb, rpg, _, rnl, acc = split_megakernel_pallas(
+        jnp.asarray(pb), jnp.asarray(pg),
+        jnp.zeros((sc_rows_for(G32), Np), jnp.int32), sc,
+        row_chunk=C, num_bins=B, num_groups=G, compact_radix=radix,
+        interpret=True)
+    assert int(np.asarray(rnl)[0, 0]) == enl
+    np.testing.assert_array_equal(np.asarray(rpb), epb)
+    np.testing.assert_array_equal(
+        np.asarray(rpg)[:3].view(np.int32), epg[:3].view(np.int32))
+    acc_oracle = both_children_hist_xla(
+        jnp.asarray(pb), jnp.asarray(pg), jnp.int32(start), jnp.int32(cnt),
+        jnp.int32(col),
+        tuple(jnp.int32(v) for v in (0, 0, nb, dbin, mtype, thr, dl)),
+        row_chunk=C, num_bins=B, num_groups=G)
+    np.testing.assert_array_equal(np.asarray(acc), np.asarray(acc_oracle))
+    # independent NumPy reference for the histogram VALUES (allclose:
+    # different summation order than the f32 matmul accumulation)
+    colv = pb[col, start:start + cnt].astype(np.int32)
+    if mtype == 1:
+        miss = colv == dbin
+    elif mtype == 2:
+        miss = colv == nb - 1
+    else:
+        miss = np.zeros_like(colv, bool)
+    gl = np.where(miss, dl != 0, colv <= thr)
+    hl_g, hl_h, hr_g, hr_h = [np.asarray(x) for x in unpack_hist4(acc, B)]
+    gseg = pg[0, start:start + cnt].astype(np.float64)
+    hseg = pg[1, start:start + cnt].astype(np.float64)
+    for gi in (0, col, G - 1):
+        binseg = pb[gi, start:start + cnt]
+        for side, (eg, eh) in ((gl, (hl_g, hl_h)), (~gl, (hr_g, hr_h))):
+            refg = np.zeros(256)
+            refh = np.zeros(256)
+            np.add.at(refg, binseg[side], gseg[side])
+            np.add.at(refh, binseg[side], hseg[side])
+            np.testing.assert_allclose(eg[gi], refg, rtol=1e-4, atol=1e-4)
+            np.testing.assert_allclose(eh[gi], refh, rtol=1e-4, atol=1e-4)
+
+
+def test_megakernel_zero_count_interpreted():
+    """cnt == 0 (the trash-slot iteration): no rows move, the left count
+    clamps to 0 and the histogram accumulator is all-zero."""
+    C, G32, G, B = 256, 32, 28, 255
+    Np = 8 * C
+    rng = np.random.RandomState(99)
+    pb = rng.randint(0, 250, (G32, Np)).astype(np.uint8)
+    pg = rng.randn(8, Np).astype(np.float32)
+    sc = make_scalars(3 * C + 17, 0, 5, 0, 0, 200, 0, 0, 100, 0)
+    rpb, rpg, _, rnl, acc = split_megakernel_pallas(
+        jnp.asarray(pb), jnp.asarray(pg),
+        jnp.zeros((sc_rows_for(G32), Np), jnp.int32), sc,
+        row_chunk=C, num_bins=B, num_groups=G, interpret=True)
+    assert int(np.asarray(rnl)[0, 0]) == 0
+    np.testing.assert_array_equal(np.asarray(rpb), pb)
+    np.testing.assert_array_equal(np.asarray(rpg)[:3], pg[:3])
+    assert not np.asarray(acc).any()
 
 
 @pytest.mark.parametrize("trial", [0, 1])

@@ -88,10 +88,77 @@ def test_train_pallas_matches_xla():
     def train(kernel):
         params = {"objective": "regression", "num_leaves": 31,
                   "verbosity": -1, "tpu_partition_kernel": kernel,
-                  "min_data_in_leaf": 20}
+                  "min_data_in_leaf": 20, "tpu_megakernel": "off"}
         return lgb.train(params, lgb.Dataset(X, label=y),
                          num_boost_round=10)
 
     p_pal = train("pallas").predict(X[:500])
     p_xla = train("xla").predict(X[:500])
     np.testing.assert_array_equal(p_pal, p_xla)
+
+
+def test_megakernel_matches_oracles_on_device():
+    """Mega-kernel on a real TPU: partition bit-equal to the NumPy
+    oracle, histogram accumulator bit-equal to the XLA oracle, for both
+    compaction networks and the zero-count trash-slot call."""
+    from lightgbm_tpu.ops.partition_pallas import (make_scalars,
+                                                   sc_rows_for)
+    from lightgbm_tpu.ops.split_megakernel_pallas import (
+        both_children_hist_xla, split_megakernel_pallas)
+    C, G32, G, B = 1024, 32, 28, 255
+    Np = 10 * C
+    rng = np.random.RandomState(11)
+    for trial in range(4):
+        pb = rng.randint(0, 250, (G32, Np)).astype(np.uint8)
+        pg = rng.randn(8, Np).astype(np.float32)
+        start = int(rng.randint(C, 5 * C))
+        cnt = 0 if trial == 3 else int(rng.randint(1, 4 * C))
+        col = int(rng.randint(0, G))
+        nb = int(rng.randint(10, 250))
+        mtype = int(rng.randint(0, 3))
+        dbin = int(rng.randint(0, nb))
+        thr = int(rng.randint(0, nb))
+        dl = int(rng.rand() < 0.5)
+        epb, epg, enl = _oracle(pb, pg, start, cnt, col, 0, 0, nb, dbin,
+                                mtype, thr, dl)
+        sc = make_scalars(start, cnt, col, 0, 0, nb, dbin, mtype, thr, dl)
+        rpb, rpg, _, rnl, acc = split_megakernel_pallas(
+            jnp.asarray(pb), jnp.asarray(pg),
+            jnp.zeros((sc_rows_for(G32), Np), jnp.int32), sc,
+            row_chunk=C, num_bins=B, num_groups=G,
+            compact_radix=(trial == 2))
+        assert int(np.asarray(rnl)[0, 0]) == enl
+        np.testing.assert_array_equal(np.asarray(rpb), epb)
+        np.testing.assert_array_equal(
+            np.asarray(rpg)[:3].view(np.int32), epg[:3].view(np.int32))
+        acc_o = both_children_hist_xla(
+            jnp.asarray(pb), jnp.asarray(pg), jnp.int32(start),
+            jnp.int32(cnt), jnp.int32(col),
+            tuple(jnp.int32(v) for v in (0, 0, nb, dbin, mtype, thr, dl)),
+            row_chunk=C, num_bins=B, num_groups=G)
+        np.testing.assert_array_equal(np.asarray(acc), np.asarray(acc_o))
+
+
+def test_train_megakernel_matches_its_oracle_on_device():
+    """E2E on device: tpu_megakernel=pallas trees bit-identical to the
+    tpu_megakernel=xla oracle formulation (both run the Pallas
+    partition and pair-search; only the fused histogram differs)."""
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(7)
+    N, F = 5000, 8
+    X = rng.randn(N, F)
+    y = (X[:, 0] * 2 + np.sin(X[:, 1] * 3)
+         + 0.3 * rng.randn(N) > 0).astype(np.float64)
+
+    def train(mode):
+        params = {"objective": "binary", "num_leaves": 63,
+                  "verbosity": -1, "min_data_in_leaf": 20,
+                  "tpu_megakernel": mode}
+        return lgb.train(params, lgb.Dataset(X, label=y),
+                         num_boost_round=8)
+
+    bx = train("xla")
+    bp = train("pallas")
+    assert bp._gbdt.learner._use_mega == "pallas"
+    np.testing.assert_array_equal(bp.predict(X[:2000]),
+                                  bx.predict(X[:2000]))

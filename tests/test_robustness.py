@@ -157,6 +157,98 @@ def test_checkpoint_latest_skips_torn_write(rng, tmp_path):
     assert state is not None and state.iteration == 6
 
 
+def test_checkpoint_history_delta_log(rng, tmp_path):
+    """The eval history is an append-only history.jsonl shared by all
+    checkpoints: state.json carries only the length (per-checkpoint
+    cost no longer grows with iterations trained), and restore
+    reconstructs the full history capped at that length."""
+    import json as _json
+
+    X, y = _data(rng)
+    ck = str(tmp_path / "hist")
+    params = dict(objective="binary", num_leaves=15, learning_rate=0.1,
+                  seed=3, verbosity=-1, checkpoint_dir=ck,
+                  checkpoint_interval=3, checkpoint_keep=2)
+    lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=12,
+              valid_sets=[lgb.Dataset(X[:100], label=y[:100])])
+    # every evaluated iteration appended one line
+    hist_path = os.path.join(ck, "history.jsonl")
+    with open(hist_path) as fh:
+        lines = [l for l in fh.read().splitlines() if l.strip()]
+    assert len(lines) == 12
+    # state.json stores the LENGTH, never the history itself
+    newest = sorted(d for d in os.listdir(ck) if d.startswith("ckpt_"))[-1]
+    with open(os.path.join(ck, newest, "state.json")) as fh:
+        meta = _json.load(fh)
+    assert "eval_history" not in meta
+    assert meta["eval_history_len"] == 12
+    # restore reconstructs the full capped history
+    from lightgbm_tpu.robustness.checkpoint import CheckpointManager
+    state = CheckpointManager(ck).latest()
+    assert len(state.eval_history) == 12
+    assert state.eval_history[0][0][0] == "valid_0"
+    # torn trailing line (crash mid-append) degrades to the parsed prefix
+    with open(hist_path, "a") as fh:
+        fh.write('[["valid_0", "binary_log')
+    state2 = CheckpointManager(ck).latest()
+    assert len(state2.eval_history) == 12
+
+
+def test_checkpoint_history_resume_truncates_stale_tail(rng, tmp_path):
+    """A killed run leaves history lines past the resumed checkpoint;
+    the first post-resume save must rewrite the log so the resumed
+    run's history is exactly the uninterrupted run's."""
+    import json as _json
+
+    X, y = _data(rng)
+    base = dict(objective="binary", num_leaves=15, learning_rate=0.1,
+                seed=5, verbosity=-1, checkpoint_interval=3)
+    va = [(X[:100], y[:100])]
+    ref = lgb.train(dict(base, checkpoint_dir=str(tmp_path / "a")),
+                    lgb.Dataset(X, label=y), num_boost_round=12,
+                    valid_sets=[lgb.Dataset(X[:100], label=y[:100])])
+    resumed = _kill_and_resume(dict(base,
+                                    checkpoint_dir=str(tmp_path / "b")),
+                               X, y, rounds=12, kill_at=8, valid=va)
+    assert _norm(ref.model_to_string()) == _norm(resumed)
+    for arm in ("a", "b"):
+        with open(tmp_path / arm / "history.jsonl") as fh:
+            lines = [l for l in fh.read().splitlines() if l.strip()]
+        assert len(lines) == 12, arm
+    a = [_json.loads(l) for l in
+         open(tmp_path / "a" / "history.jsonl").read().splitlines()]
+    b = [_json.loads(l) for l in
+         open(tmp_path / "b" / "history.jsonl").read().splitlines()]
+    assert a == b
+
+
+def test_checkpoint_legacy_full_history_state_loads(rng, tmp_path):
+    """format_version-1 checkpoints (full eval_history inline in
+    state.json) must keep loading."""
+    import json as _json
+
+    X, y = _data(rng)
+    ck = str(tmp_path / "legacy")
+    params = dict(objective="binary", num_leaves=15, verbosity=-1,
+                  checkpoint_dir=ck, checkpoint_interval=4)
+    lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=8,
+              valid_sets=[lgb.Dataset(X[:100], label=y[:100])])
+    newest = sorted(d for d in os.listdir(ck) if d.startswith("ckpt_"))[-1]
+    sp = os.path.join(ck, newest, "state.json")
+    with open(sp) as fh:
+        meta = _json.load(fh)
+    meta.pop("eval_history_len")
+    meta["format_version"] = 1
+    meta["eval_history"] = [[["valid_0", "binary_logloss", 0.5, False]]]
+    with open(sp, "w") as fh:
+        _json.dump(meta, fh)
+    os.remove(os.path.join(ck, "history.jsonl"))
+    from lightgbm_tpu.robustness.checkpoint import CheckpointManager
+    state = CheckpointManager(ck).latest()
+    assert state.eval_history == [[("valid_0", "binary_logloss", 0.5,
+                                    False)]]
+
+
 def test_checkpoint_callback_rejects_cv(rng, tmp_path):
     X, y = _data(rng)
     cb = CheckpointCallback(str(tmp_path / "ck"), interval=2)

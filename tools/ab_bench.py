@@ -223,9 +223,14 @@ def main():
 
     def kernel_flags(bst):
         lr = bst._gbdt.learner
-        return {k: bool(getattr(lr, k, False)) for k in
-                ("_use_pallas_part", "_use_pallas_search",
-                 "_use_flat_hist", "_pack_rowid", "_use_pallas")}
+        out = {k: bool(getattr(lr, k, False)) for k in
+               ("_use_pallas_part", "_use_pallas_search",
+                "_use_flat_hist", "_pack_rowid", "_use_pallas",
+                "_compact_radix")}
+        # None | "pallas" | "xla" — the arm report must show whether the
+        # mega-kernel actually engaged (probe fallbacks are silent)
+        out["_use_mega"] = getattr(lr, "_use_mega", None)
+        return out
 
     sa, sb = stats(times["A"]), stats(times["B"])
     paired = np.asarray(times["B"]) - np.asarray(times["A"])

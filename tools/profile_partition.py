@@ -1,9 +1,19 @@
-"""Per-chunk cost attribution for the Pallas partition kernel on a live
-TPU.  Times R back-to-back partitions of an N-row leaf under each
-_profile_variant ("full" / "onenet" / "nonet" — the latter two produce
-wrong layouts by design) and several chunk sizes, with the
+"""Per-chunk cost attribution for the Pallas partition / split-mega
+kernels on a live TPU.  Times R back-to-back partitions of an N-row
+leaf under each variant and several chunk sizes, with the
 many-reps-in-one-program + single-materialization discipline PERF.md
 prescribes for this tunnel.
+
+Variants:
+  full / onenet / nonet — the partition kernel with both / one / zero
+    compaction networks (the ablations produce WRONG layouts by design;
+    they exist only here, for attribution);
+  radix                 — partition kernel, radix-4 compaction network;
+  mega / mega-radix     — the split mega-kernel (partition + BOTH
+    children's histograms in one program): its per-chunk delta over
+    "full" is the in-kernel histogram cost the e2e paired A/B
+    (tools/ab_bench.py --b tpu_megakernel=pallas) trades against the
+    per-split fixed work it removes.
 
 Usage: python tools/profile_partition.py [N] [reps]
 """
@@ -56,13 +66,24 @@ def run(C, variant):
     sc = np.zeros((sc_rows_for(G32), Npad), np.int32)
     scal = make_scalars(jnp.int32(C), jnp.int32(N), 3, 0, 0, 255, 0, 0,
                         128, 1)
+    mega = variant.startswith("mega")
+    radix = variant.endswith("radix")
 
-    _set_variant(variant)
+    _set_variant(variant if variant in ("full", "onenet", "nonet")
+                 else "full")
 
     def one(c, _):
         pb, pg, sp = c
+        if mega:
+            from lightgbm_tpu.ops.split_megakernel_pallas import (
+                split_megakernel_pallas)
+            pb, pg, sp, nl, acc = split_megakernel_pallas(
+                pb, pg, sp, scal, row_chunk=C, num_bins=255,
+                num_groups=28, ghi_live=GHL, compact_radix=radix)
+            return (pb, pg, sp), nl[0, 0] + jnp.sum(acc).astype(jnp.int32)
         pb, pg, sp, nl = partition_leaf_pallas(
-            pb, pg, sp, scal, row_chunk=C, ghi_live=GHL)
+            pb, pg, sp, scal, row_chunk=C, ghi_live=GHL,
+            compact_radix=radix)
         return (pb, pg, sp), nl[0, 0]
 
     @jax.jit
@@ -88,7 +109,8 @@ def run(C, variant):
 if __name__ == "__main__":
     print(f"N={N} reps={REPS} device={jax.devices()}")
     for C in (4096, 2048, 8192):
-        for variant in ("full", "onenet", "nonet"):
+        for variant in ("full", "onenet", "nonet", "radix", "mega",
+                        "mega-radix"):
             try:
                 run(C, variant)
             except Exception as e:

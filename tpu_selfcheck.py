@@ -3,10 +3,17 @@ everything the CPU suite cannot (`python tpu_selfcheck.py`).
 
 Covers, in order:
   1. partition kernel vs the NumPy oracle (bit-exact, incl. rowid rows);
-  2. split-search kernel vs the XLA fast search;
-  3. rowid-row integrity through a full build_tree (guards the tunnel-XLA
+  2. radix-4 compaction network vs the same oracle (tpu_compact_radix);
+  3. split-search kernel vs the XLA fast search;
+  4. rowid-row integrity through a full build_tree (guards the tunnel-XLA
      stack+concat miscompile found in round 3 — see PERF.md);
-  4. end-to-end train parity: Pallas kernels vs the XLA fallback path.
+  5. hist-state RMW kernel vs numpy;
+  6. split mega-kernel vs the NumPy partition oracle + the XLA
+     both-children histogram oracle (bit-exact, incl. the zero-count
+     trash-slot call);
+  7. end-to-end train parity: Pallas kernels vs the XLA fallback path
+     (tpu_megakernel=off), then mega-pallas vs mega-xla (the mega path
+     is bit-identical to ITS oracle, not to the subtraction path).
 """
 import sys, os
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -65,9 +72,28 @@ for trial in range(6):
     nliv = 5 if pack else 3
     np.testing.assert_array_equal(np.asarray(rpg)[:nliv].view(np.int32),
                                   epg[:nliv].view(np.int32))
-print("[1/5] partition kernel vs oracle (incl pack_rowid): OK", flush=True)
+print("[1/7] partition kernel vs oracle (incl pack_rowid): OK", flush=True)
 
-# ---- 2. search kernel vs XLA fast search ----
+# ---- 2. radix-4 compaction network vs oracle ----
+for trial in range(3):
+    pb = rng.randint(0, 250, (G32, Np)).astype(np.uint8)
+    pg = rng.randn(8, Np).astype(np.float32)
+    start = int(rng.randint(C, 5*C)); cnt = int(rng.randint(0, 4*C))
+    col = int(rng.randint(0, 28)); nb = int(rng.randint(10, 250))
+    thr = int(rng.randint(0, nb)); dl = int(rng.rand() < 0.5)
+    epb, epg, enl = _oracle(pb, pg, start, cnt, col, 0, 0, nb, 0, 0, thr, dl)
+    sc = make_scalars(start, cnt, col, 0, 0, nb, 0, 0, thr, dl)
+    rpb, rpg, _, rnl = partition_leaf_pallas(
+        jnp.asarray(pb), jnp.asarray(pg),
+        jnp.zeros((sc_rows_for(G32), Np), jnp.int32), sc, row_chunk=C,
+        compact_radix=True)
+    assert int(np.asarray(rnl)[0, 0]) == enl, trial
+    np.testing.assert_array_equal(np.asarray(rpb), epb)
+    np.testing.assert_array_equal(np.asarray(rpg)[:3].view(np.int32),
+                                  epg[:3].view(np.int32))
+print("[2/7] radix-4 compaction network vs oracle: OK", flush=True)
+
+# ---- 3. search kernel vs XLA fast search ----
 F, BF = 28, 255
 num_bin = rng.randint(3, BF + 1, size=F).astype(np.int32)
 missing = rng.randint(0, 3, size=F).astype(np.int32)
@@ -103,9 +129,9 @@ tile = np.asarray(best_split_pair_pallas(
 for c, ref in enumerate(refs):
     assert tile[c, 1:2].view(np.int32)[0] == int(ref.feature)
     assert tile[c, 2:3].view(np.int32)[0] == int(ref.threshold)
-print("[2/5] search kernel vs XLA fast search: OK", flush=True)
+print("[3/7] search kernel vs XLA fast search: OK", flush=True)
 
-# ---- 3. rowid integrity through build_tree ----
+# ---- 4. rowid integrity through build_tree ----
 N = 40000
 X = rng.normal(size=(N, 8)).astype(np.float32)
 y = (X[:, 0] > 0).astype(np.float32)
@@ -119,9 +145,9 @@ idx = np.asarray(rec["indices"])
 r0 = g.learner.row0
 assert np.array_equal(np.sort(idx[r0:r0+N]), np.arange(N)), \
     "rowid row corrupted (stack+concat miscompile regression?)"
-print("[3/5] rowid integrity: OK", flush=True)
+print("[4/7] rowid integrity: OK", flush=True)
 
-# ---- 4. hist-state RMW kernel vs numpy ----
+# ---- 5. hist-state RMW kernel vs numpy ----
 from lightgbm_tpu.ops.hist_state_pallas import flat_geometry, hist_rmw_pallas
 Gf, Bf, WL = flat_geometry(28, 255)
 st_h = rng.randn(34, 8, WL).astype(np.float32)
@@ -137,12 +163,47 @@ for (bl, wa, wb, sil) in [(3, 3, 7, 1), (5, 5, 9, 0), (2, 33, 33, 1)]:
     np.testing.assert_array_equal(np.asarray(rgt), er)
     exp = st_h.copy(); exp[wa] = el; exp[wb] = er
     np.testing.assert_array_equal(np.asarray(out), exp)
-print("[4/5] hist-state RMW kernel: OK", flush=True)
+print("[5/7] hist-state RMW kernel: OK", flush=True)
 
-# ---- 5. E2E pallas (flat + xla hist state) vs xla ----
-def train(pallas, hist_state="auto"):
+# ---- 6. mega-kernel vs oracles (kernel-level) ----
+from lightgbm_tpu.ops.split_megakernel_pallas import (
+    both_children_hist_xla, split_megakernel_pallas)
+G, B = 28, 255
+for trial in range(4):
+    pb = rng.randint(0, 250, (G32, Np)).astype(np.uint8)
+    pg = rng.randn(8, Np).astype(np.float32)
+    start = int(rng.randint(C, 5*C))
+    cnt = 0 if trial == 3 else int(rng.randint(1, 4*C))   # 3: trash slot
+    col = int(rng.randint(0, G)); nb = int(rng.randint(10, 250))
+    mtype = int(rng.randint(0, 3)); dbin = int(rng.randint(0, nb))
+    thr = int(rng.randint(0, nb)); dl = int(rng.rand() < 0.5)
+    radix = trial == 2
+    epb, epg, enl = _oracle(pb, pg, start, cnt, col, 0, 0, nb, dbin,
+                            mtype, thr, dl)
+    sc = make_scalars(start, cnt, col, 0, 0, nb, dbin, mtype, thr, dl)
+    rpb, rpg, _, rnl, acc = split_megakernel_pallas(
+        jnp.asarray(pb), jnp.asarray(pg),
+        jnp.zeros((sc_rows_for(G32), Np), jnp.int32), sc, row_chunk=C,
+        num_bins=B, num_groups=G, compact_radix=radix)
+    assert int(np.asarray(rnl)[0, 0]) == enl, trial
+    np.testing.assert_array_equal(np.asarray(rpb), epb)
+    np.testing.assert_array_equal(np.asarray(rpg)[:3].view(np.int32),
+                                  epg[:3].view(np.int32))
+    acc_o = both_children_hist_xla(
+        jnp.asarray(pb), jnp.asarray(pg), jnp.int32(start),
+        jnp.int32(cnt), jnp.int32(col),
+        tuple(jnp.int32(v) for v in (0, 0, nb, dbin, mtype, thr, dl)),
+        row_chunk=C, num_bins=B, num_groups=G)
+    np.testing.assert_array_equal(np.asarray(acc), np.asarray(acc_o))
+    if cnt == 0:
+        assert not np.asarray(acc).any()
+print("[6/7] mega-kernel vs partition+hist oracles: OK", flush=True)
+
+# ---- 7. E2E pallas (flat + xla hist state) vs xla; then mega ----
+def train(pallas, hist_state="auto", mega="off", radix=False):
     params = {"objective": "binary", "num_leaves": 63, "verbosity": -1,
-              "min_data_in_leaf": 20, "tpu_hist_state": hist_state}
+              "min_data_in_leaf": 20, "tpu_hist_state": hist_state,
+              "tpu_megakernel": mega, "tpu_compact_radix": radix}
     if not pallas:
         params["tpu_partition_kernel"] = "xla"
     b = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=8)
@@ -151,6 +212,14 @@ ref = train(False)
 d1 = float(np.abs(train(True) - ref).max())
 d2 = float(np.abs(train(True, "xla") - ref).max())
 assert d1 == 0.0 and d2 == 0.0, (d1, d2)
-print("[5/5] end-to-end pallas(flat/xla-state) vs xla: OK (diff 0.0)",
-      flush=True)
+# mega-pallas must equal ITS oracle (mega-xla) bit-exactly on device;
+# both differ from the subtraction path only by f32 summation grouping
+mega_ref = train(True, mega="xla")
+d3 = float(np.abs(train(True, mega="pallas") - mega_ref).max())
+d4 = float(np.abs(train(True, mega="pallas", radix=True) - mega_ref).max())
+assert d3 == 0.0 and d4 == 0.0, (d3, d4)
+d5 = float(np.abs(mega_ref - ref).max())
+assert d5 < 1e-4, d5
+print(f"[7/7] e2e pallas vs xla (diff 0.0) + mega vs mega-oracle "
+      f"(diff 0.0; vs subtraction path {d5:.2e}): OK", flush=True)
 print("TPU SELF-CHECK: ALL OK")
