@@ -567,7 +567,18 @@ class Booster:
     def predict(self, data, start_iteration: int = 0,
                 num_iteration: Optional[int] = None,
                 raw_score: bool = False, pred_leaf: bool = False,
-                pred_contrib: bool = False, **kwargs) -> np.ndarray:
+                pred_contrib: bool = False,
+                validate_features: bool = False, **kwargs) -> np.ndarray:
+        if validate_features and hasattr(data, "columns"):
+            # reference: Predictor's data_names vs model feature-name
+            # check (c_api.cpp LGBM_BoosterPredictForMats
+            # validate_features path)
+            got = [str(c) for c in data.columns]
+            want = self.feature_name()
+            if got != want:
+                raise LightGBMError(
+                    "Data names mismatch with model feature names: "
+                    f"expected {want}, got {got}")
         if num_iteration is None:
             # after early stopping, default to the best iteration
             # (reference: basic.py Booster.predict)
@@ -607,7 +618,8 @@ class Booster:
                 mat = np.concatenate([np.asarray(mat, pad.dtype), pad],
                                      axis=1)
         if pred_leaf:
-            return self._gbdt.predict_leaf_index(mat)
+            return self._gbdt.predict_leaf_index(mat, start_iteration,
+                                                 num_iteration)
         if pred_contrib:
             return self.predict_contrib(mat, start_iteration, num_iteration)
         es_kw = {k: kwargs[k] for k in
@@ -619,11 +631,12 @@ class Booster:
 
     def predict_contrib(self, data, start_iteration=0, num_iteration=-1):
         """SHAP feature contributions via per-tree path attribution
-        (reference: tree.h PredictContrib / TreeSHAP)."""
-        from .models.shap import predict_contrib
-        self._gbdt._flush_pending()
-        return predict_contrib(self._gbdt, np.asarray(data, dtype=np.float64),
-                               start_iteration, num_iteration)
+        (reference: tree.h PredictContrib / TreeSHAP).  Served by the
+        device TreeSHAP kernel when eligible (models/serving.py), with
+        the exact host recursion as oracle and fallback."""
+        return self._gbdt.predict_contrib(
+            np.asarray(data, dtype=np.float64), start_iteration,
+            num_iteration)
 
     # ------------------------------------------------------------------
     def model_to_string(self, num_iteration: int = -1,
@@ -859,6 +872,11 @@ class Booster:
                     scores[:, k] += pred
                 else:
                     scores += pred
+        # the in-place leaf_value rewrites are a model mutation: bump the
+        # version (and drop packs) so the serving pack warmed by the
+        # predict_leaf_index call above can never serve pre-refit values
+        ng._model_version += 1
+        ng.serving.invalidate()
         return new_booster
 
     def free_dataset(self) -> "Booster":

@@ -229,6 +229,10 @@ _PARAMS: List[_Param] = [
        ("ndcg_eval_at", "ndcg_at", "map_eval_at", "map_at")),
     _p("multi_error_top_k", 1, int, (), ">0"),
     _p("auc_mu_weights", "", str),
+    # TPU extension: gather score/label pairs across ranks for an EXACT
+    # global AUC under data-parallel row sharding (default stays the
+    # reference-shaped per-rank weighted mean, which warns once)
+    _p("distributed_exact_auc", False, bool),
     # --- Network ---
     _p("num_machines", 1, int, ("num_machine",), ">0"),
     _p("local_listen_port", 12400, int, ("local_port", "port"), ">0"),

@@ -26,6 +26,7 @@ from ..utils import log
 from .learner import SerialTreeLearner
 from .metric import Metric, create_metrics
 from .objective import ObjectiveFunction
+from .serving import ServingEngine
 from .tree import Tree, tree_from_device_record
 
 K_EPSILON = 1e-15
@@ -264,9 +265,12 @@ class GBDT:
         self.device_trees: List[Dict[str, Any]] = []  # node arrays + leaf values
         self._continued = False        # set by continue_from
         # bumped on every structural model change (append/pop/scale) so
-        # derived caches (the stacked device-predict arrays) can never
+        # derived caches (the serving engine's packed forests) can never
         # serve a stale model of the same length
         self._model_version = 0
+        # device-resident serving engine: packed forests, bucketed
+        # batches, compiled-predictor cache (models/serving.py)
+        self.serving = ServingEngine(self)
         self.iter = 0
         self.shrinkage_rate = float(config.learning_rate)
         self.num_tree_per_iteration = (objective.num_model_per_iteration
@@ -1230,6 +1234,7 @@ class GBDT:
             "has_cat_split": bool(
                 np.any(host_record["node_is_cat"][:num_nodes]))})
         self._model_version += 1
+        self.serving.invalidate()
         # stop only when a FULL iteration's K class trees are all empty
         # (gbdt.cpp TrainOneIter's per-class should_continue)
         self._empty_run = self._empty_run + 1 if num_nodes == 0 else 0
@@ -1265,6 +1270,7 @@ class GBDT:
         self.device_trees = [None] * len(self.models)
         self.iter = len(self.models) // K
         self._model_version += 1
+        self.serving.invalidate()
         # DART continuation: init-model trees are excluded from dropping
         # (reference: dart.hpp:108-122 draws over the session's iter_ only,
         # offset by num_init_iteration_)
@@ -1855,206 +1861,34 @@ class GBDT:
 
     def _predict_raw_device(self, data: np.ndarray, start_iteration: int,
                             end_iter: int):
-        """Batch prediction on device: bin the rows with the TRAINING
-        mappers (exact for in-session trees — thresholds are bin uppers)
-        and traverse all trees in one jitted vmap (the TPU replacement
-        for the reference's OpenMP batch predictor, predictor.hpp:30).
-        Returns None when this model can't take the device path (loaded
-        trees, linear leaves, no train data)."""
-        K = self.num_tree_per_iteration
-        if (self.train_data is None or self.config.linear_tree
-                or getattr(self.train_data, "bin_mappers", None) is None
-                or end_iter <= start_iteration):
-            return None
-        ckey = (start_iteration, end_iter, len(self.models),
-                self._model_version)
-        cache = getattr(self, "_stack_cache", None)
-        # the stacked traversal compiles per tree COUNT and the node
-        # stacking costs a device round trip; a COLD cache only pays for
-        # itself on big batches, but once warm the same program serves
-        # any batch size
-        if np.asarray(data).shape[0] < 4096 and \
-                (cache is None or cache[0] != ckey):
-            return None
-        dts = self.device_trees[start_iteration * K:end_iter * K]
-        if len(dts) != (end_iter - start_iteration) * K or \
-                any(d is None for d in dts):
-            return None
-        # categorical splits traverse on device via the OOV-sentinel bin
-        # (bin_matrix(cat_oov_sentinel=True)): unseen categories and NaN
-        # bin to num_bin, fail every category-set membership test, and
-        # fall to the right child — the reference predictor's
-        # CategoricalDecision (tree.h) on raw values.  The sentinel can't
-        # survive EFB bundling or a full 256-bin u8 feature, so those
-        # configurations keep the host walk.
-        has_cat = any(d.get("has_cat_split", "is_cat" in d["nodes"])
-                      for d in dts)
-        if has_cat and not self._cat_sentinel_ok():
-            return None
-        try:
-            binned = self.train_data.bin_matrix(np.asarray(data),
-                                                cat_oov_sentinel=has_cat)
-        except Exception:
-            return None
-        binned_dev = jnp.asarray(binned)
-        if not hasattr(self, "_stacked_predict"):
-            def stacked(nodes, deltas, b):
-                leaves = jax.vmap(
-                    lambda nd: predict_leaf_binned(b, nd))(nodes)   # (T, n)
-                vals = jax.vmap(jnp.take)(deltas, leaves)           # (T, n)
-                return jnp.sum(vals, axis=0)
-            self._stacked_predict = jax.jit(stacked)
-        # stack the per-tree node arrays on the HOST with ONE device_get
-        # (per-tree jnp.stack dispatches hundreds of tiny tunnel ops) and
-        # cache per (range, model length)
-        if cache is None or cache[0] != ckey:
-            sel_all = self.device_trees[start_iteration * K:end_iter * K]
-            host = jax.device_get([(d["nodes"], d["leaf_value"])
-                                   for d in sel_all])
-            per_k = []
-            for k in range(K):
-                hk = host[k::K]
-                nodes = jax.tree.map(lambda *a: jnp.asarray(np.stack(a)),
-                                     *[h[0] for h in hk])
-                deltas = jnp.asarray(np.stack([h[1] for h in hk]))
-                per_k.append((nodes, deltas))
-            cache = (ckey, per_k)
-            self._stack_cache = cache
-        n = data.shape[0]
-        out = np.zeros((n, K), dtype=np.float64)
-        for k in range(K):
-            nodes, deltas = cache[1][k]
-            col = np.asarray(self._stacked_predict(nodes, deltas,
-                                                   binned_dev),
-                             dtype=np.float64)
-            # boost-from-average is folded into the first HOST tree only;
-            # the device deltas exclude it
-            if start_iteration == 0 and abs(self.init_scores[k]) > K_EPSILON:
-                col = col + self.init_scores[k]
-            out[:, k] = col
-        return out
+        """Batch prediction on device via the serving engine
+        (models/serving.py): rows are binned with the TRAINING mappers
+        (exact for in-session trees — thresholds are bin uppers), padded
+        to a power-of-two bucket, and traverse the packed forest in one
+        jitted vmap — the TPU replacement for the reference's OpenMP
+        batch predictor (predictor.hpp:30).  ``start``/``end`` slicing
+        is a tree mask, so repeated serving calls never re-stack or
+        re-trace.  Returns None when this model can't take the device
+        path (loaded trees, linear leaves, no train data)."""
+        return self.serving.raw_insession(np.asarray(data),
+                                          start_iteration, end_iter)
 
     def _predict_raw_device_loaded(self, data: np.ndarray,
                                    start_iteration: int, end_iter: int,
                                    leaves_only: bool = False):
         """Device batch prediction for LOADED models (real thresholds, no
-        bin mappers): raw values convert to per-feature threshold-index
-        space with exact float64 searchsorted on the host, and the trees
-        traverse on device in integer space (ops/predict.py
-        predict_leaf_thridx) — the device analog of the reference's
-        OpenMP batch predictor (predictor.hpp:30) for model_file
-        boosters.  Returns None for categorical/linear trees."""
-        from ..ops.predict import predict_leaf_thridx
-        from .tree import K_CATEGORICAL_MASK
-        K = self.num_tree_per_iteration
-        if end_iter <= start_iteration:
-            return None
-        ckey0 = (start_iteration, end_iter, len(self.models),
-                 self._model_version)
-        warm = getattr(self, "_loaded_cache", None)
-        # cold-cache stacking only pays for itself on big batches (see
-        # _predict_raw_device); a warm cache serves any size
-        if np.asarray(data).shape[0] < 4096 and \
-                (warm is None or warm[0] != ckey0):
-            return None
-        trees = self.models[start_iteration * K:end_iter * K]
-        if any(t.is_linear or
-               (len(t.decision_type) and
-                (np.asarray(t.decision_type) & K_CATEGORICAL_MASK).any())
-               for t in trees):
-            return None
-        cache = getattr(self, "_loaded_cache", None)
-        ckey = (start_iteration, end_iter, len(self.models),
-                self._model_version)
-        if cache is None or cache[0] != ckey:
-            feat_thr: Dict[int, set] = {}
-            for t in trees:
-                for f, thr in zip(np.asarray(t.split_feature),
-                                  np.asarray(t.threshold)):
-                    feat_thr.setdefault(int(f), set()).add(float(thr))
-            feats = sorted(feat_thr)
-            enum = {f: i for i, f in enumerate(feats)}
-            thr_list = [np.asarray(sorted(feat_thr[f]), np.float64)
-                        for f in feats]
-            b0 = np.asarray([int(np.searchsorted(tl, 0.0, side="left"))
-                             for tl in thr_list], np.int32)
-            nmax = max(max((len(t.split_feature) for t in trees),
-                           default=1), 1)
-            per_k = []
-            for k in range(K):
-                ts = trees[k::K]
-                T = len(ts)
-                arrs = {name: np.zeros((T, nmax), np.int32)
-                        for name in ("col", "kidx", "default_left",
-                                     "mtype", "left", "right")}
-                arrs["left"][:] = -1
-                arrs["right"][:] = -1
-                nn = np.zeros((T,), np.int32)
-                lv = np.zeros((T, nmax + 1), np.float32)
-                for ti, t in enumerate(ts):
-                    m = len(t.split_feature)
-                    nn[ti] = m
-                    lv[ti, :len(t.leaf_value)] = t.leaf_value
-                    if m == 0:
-                        if len(t.leaf_value):
-                            lv[ti, 0] = t.leaf_value[0]
-                        continue
-                    dt = np.asarray(t.decision_type).astype(np.int32)
-                    arrs["col"][ti, :m] = [enum[int(f)]
-                                           for f in t.split_feature]
-                    arrs["kidx"][ti, :m] = [
-                        int(np.searchsorted(thr_list[enum[int(f)]],
-                                            float(v), side="left"))
-                        for f, v in zip(t.split_feature, t.threshold)]
-                    arrs["default_left"][ti, :m] = (dt >> 1) & 1
-                    arrs["mtype"][ti, :m] = (dt >> 2) & 3
-                    arrs["left"][ti, :m] = t.left_child
-                    arrs["right"][ti, :m] = t.right_child
-                node = {n: jnp.asarray(a) for n, a in arrs.items()}
-                node["num_nodes"] = jnp.asarray(nn)
-                node["b0"] = jnp.broadcast_to(jnp.asarray(b0),
-                                              (T, len(feats)))
-                per_k.append((node, jnp.asarray(lv)))
-            self._loaded_cache = (ckey, feats, thr_list, per_k)
-            cache = self._loaded_cache
-        _, feats, thr_list, per_k = cache
-        data = np.asarray(data, dtype=np.float64)
-        packed = np.zeros((max(len(feats), 1), data.shape[0]), np.int32)
-        for i, f in enumerate(feats):
-            v = data[:, f]
-            nan = np.isnan(v)
-            fv = np.where(nan, 0.0, v)
-            b = np.searchsorted(thr_list[i], v, side="left")
-            packed[i] = (b.astype(np.int64) * 4 + nan * 2 +
-                         (np.abs(fv) <= 1e-35)).astype(np.int32)
-        packed_dev = jnp.asarray(packed)
-        if not hasattr(self, "_stacked_thridx"):
-            def stacked(node, lv, pv):
-                leaves = jax.vmap(
-                    lambda nd: predict_leaf_thridx(pv, nd)
-                )({k: v for k, v in node.items()})
-                return jnp.sum(jax.vmap(jnp.take)(lv, leaves), axis=0)
-            self._stacked_thridx = jax.jit(stacked)
-
-            def stacked_leaves(node, pv):
-                return jax.vmap(
-                    lambda nd: predict_leaf_thridx(pv, nd)
-                )({k: v for k, v in node.items()})
-            self._stacked_thridx_leaves = jax.jit(stacked_leaves)
+        bin mappers) via the serving engine: raw values convert to
+        per-feature threshold-index space with exact float64
+        searchsorted on the host, and the trees traverse on device in
+        integer space (ops/predict.py predict_leaf_thridx) — the device
+        analog of the reference's OpenMP batch predictor
+        (predictor.hpp:30) for model_file boosters.  Returns None for
+        categorical/linear trees."""
         if leaves_only:
-            T = len(trees)
-            out = np.zeros((data.shape[0], T), dtype=np.int32)
-            for k in range(K):
-                node, _ = per_k[k]
-                out[:, k::K] = np.asarray(
-                    self._stacked_thridx_leaves(node, packed_dev)).T
-            return out
-        out = np.zeros((data.shape[0], K), dtype=np.float64)
-        for k in range(K):
-            node, lv = per_k[k]
-            out[:, k] = np.asarray(
-                self._stacked_thridx(node, lv, packed_dev))
-        return out
+            return self.serving.leaves_loaded(np.asarray(data),
+                                              start_iteration, end_iter)
+        return self.serving.raw_loaded(np.asarray(data),
+                                       start_iteration, end_iter)
 
     def predict_raw(self, data: np.ndarray, start_iteration: int = 0,
                     num_iteration: int = -1,
@@ -2093,6 +1927,15 @@ class GBDT:
                 if self.average_output and end_iter > start_iteration:
                     dev /= (end_iter - start_iteration)
                 return dev[:, 0] if K == 1 else dev
+        else:
+            # early stopping routes through the same engine: blocks of
+            # ``freq`` iterations accumulate on device (tree-masked) and
+            # settled rows leave the bucket between blocks
+            dev = self.serving.raw_early_stop(
+                data, start_iteration, end_iter, pred_early_stop_freq,
+                pred_early_stop_margin)
+            if dev is not None:
+                return dev[:, 0] if K == 1 else dev
         active = np.ones(n, dtype=bool) if use_es else None
         any_stopped = False
         for it in range(start_iteration, end_iter):
@@ -2127,18 +1970,58 @@ class GBDT:
         conv = self.objective.convert_output(jnp.asarray(raw))
         return np.asarray(conv)
 
-    def predict_leaf_index(self, data: np.ndarray) -> np.ndarray:
+    def predict_leaf_index(self, data: np.ndarray, start_iteration: int = 0,
+                           num_iteration: int = -1) -> np.ndarray:
+        """Leaf index per (row, tree) over iterations [start, start+num)
+        (reference: predictor.hpp predict_leaf_index + the c_api's
+        start_iteration/num_iteration slicing)."""
         self._flush_pending()
         data = np.asarray(data, dtype=np.float64)
         K = self.num_tree_per_iteration
-        dev = self._predict_raw_device_loaded(
-            data, 0, len(self.models) // max(K, 1), leaves_only=True)
+        total_iters = len(self.models) // max(K, 1)
+        end_iter = total_iters if num_iteration <= 0 else min(
+            total_iters, start_iteration + num_iteration)
+        # a start past the model end yields an empty (n, 0) result like
+        # the other pred kinds, not a negative-dimension crash
+        end_iter = max(end_iter, start_iteration)
+        dev = self.serving.leaves_insession(data, start_iteration, end_iter)
+        if dev is None:
+            dev = self._predict_raw_device_loaded(
+                data, start_iteration, end_iter, leaves_only=True)
         if dev is not None:
             return dev
-        out = np.zeros((data.shape[0], len(self.models)), dtype=np.int32)
-        for t, tree in enumerate(self.models):
-            out[:, t] = tree.predict_leaf(data)
+        out = np.zeros((data.shape[0], (end_iter - start_iteration) * K),
+                       dtype=np.int32)
+        for t in range(start_iteration * K, end_iter * K):
+            out[:, t - start_iteration * K] = \
+                self.models[t].predict_leaf(data)
         return out
+
+    def predict_contrib(self, data: np.ndarray, start_iteration: int = 0,
+                        num_iteration: int = -1) -> np.ndarray:
+        """SHAP feature contributions (reference: c_api predict with
+        predict_contrib=true): the serving engine's vectorized device
+        TreeSHAP (ops/shap.py) when the model is device-eligible, else
+        the exact host recursion (models/shap.py, the oracle)."""
+        from .shap import predict_contrib as host_contrib
+        self._flush_pending()
+        data = np.asarray(data, dtype=np.float64)
+        K = self.num_tree_per_iteration
+        total_iters = len(self.models) // max(K, 1)
+        # 0 means "all iterations", matching predict_raw /
+        # predict_leaf_index (the reference wrapper's num_iteration<=0)
+        if num_iteration <= 0:
+            num_iteration = -1
+        end_iter = total_iters if num_iteration < 0 else min(
+            total_iters, start_iteration + num_iteration)
+        dev = self.serving.contrib(data, start_iteration, end_iter)
+        if dev is not None:
+            n = data.shape[0]
+            nf = self.max_feature_idx + 1
+            if K == 1:
+                return dev[:, 0, :]
+            return dev.reshape(n, K * (nf + 1))
+        return host_contrib(self, data, start_iteration, num_iteration)
 
     def rollback_one_iter(self) -> None:
         """reference: gbdt.cpp RollbackOneIter:443."""
@@ -2152,6 +2035,7 @@ class GBDT:
                         "(loaded trees have no device arrays)")
             return
         self._model_version += 1
+        self.serving.invalidate()
         for k in range(K):
             dt = self.device_trees.pop()
             tree = self.models.pop()
@@ -2296,6 +2180,7 @@ class DART(GBDT):
         dt = self.device_trees[t_idx]
         dt["leaf_value"] = dt["leaf_value"] * factor
         self._model_version += 1
+        self.serving.invalidate()
 
     def _add_tree_to_scores(self, t_idx: int, factor: float,
                             train: bool = True, valid: bool = True) -> None:

@@ -277,6 +277,34 @@ class AUCMetric(Metric):
     is_max_better = True
 
     def eval(self, score, objective):
+        from ..parallel import network
+        if network.num_machines() > 1 and bool(
+                getattr(self.config, "distributed_exact_auc", False)):
+            # EXACT global AUC under data-parallel row sharding: gather
+            # every rank's (score, label, weight) rows once and run the
+            # tie-aware sorted-cumsum evaluation over the full dataset.
+            # The sort makes rank concatenation order irrelevant, so
+            # this equals the single-process value to fp roundoff.
+            # (The warned per-rank weighted mean stays the default:
+            # the gather is O(total rows) host traffic per eval.)
+            # gather the ORIGINAL f64 metadata arrays, not the f32
+            # device copies init() keeps — and keep the whole gather +
+            # evaluation under x64, else the allgather and the sorted
+            # cumsums silently truncate to f32 (collapsing distinct
+            # scores into ties) and the exactness claim is void
+            meta = self.metadata
+            with jax.experimental.enable_x64():
+                s = network.global_concat(
+                    np.asarray(score, dtype=np.float64))
+                y = network.global_concat(np.asarray(meta.label,
+                                                     dtype=np.float64))
+                w_local = (np.asarray(meta.weight, dtype=np.float64)
+                           if meta.weight is not None
+                           else np.ones(len(np.asarray(meta.label)),
+                                        dtype=np.float64))
+                w = network.global_concat(w_local)
+                return [(self.name, float(_weighted_auc(
+                    jnp.asarray(s), jnp.asarray(y), jnp.asarray(w))))]
         return [(self.name, self._rank_mean(float(_weighted_auc(
             jnp.asarray(score), self.label, self.weight))))]
 

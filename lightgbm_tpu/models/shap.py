@@ -326,6 +326,83 @@ def _tree_shap_batch(tree, X, phi):
     recurse(0, 0, [], 1.0, np.ones(n), -1)
 
 
+# ---------------------------------------------------------------------------
+# Per-leaf unique-path extraction for the DEVICE TreeSHAP kernel
+# (ops/shap.py).  The recursion's path state at a leaf is row-independent
+# except for the one_fractions: each unique path element is one feature
+# with a scalar zero fraction (product of count ratios over the merged
+# same-feature nodes) and a set of (node, direction) conditions whose
+# conjunction is the row's one_fraction.  Extracting those per leaf turns
+# the recursion into dense per-(element, row) array ops.
+# ---------------------------------------------------------------------------
+
+def tree_leaf_paths(tree):
+    """Per-leaf unique path elements of a host tree.
+
+    Returns ``{leaf_id: [(feature, zero_fraction, [(node, dir), ...]),
+    ...]}`` where ``dir`` is 1 when the leaf path goes LEFT at ``node``
+    (a row is "hot" on the element iff its decision agrees at every
+    listed node).  Merged duplicate-feature elements multiply their
+    zero fractions exactly like the recursion's unwind+re-extend."""
+    out = {}
+
+    def rec(node, elems):
+        if node < 0:
+            out[~node] = elems
+            return
+        f = int(tree.split_feature[node])
+        w = _node_weight(tree, node)
+        lc = int(tree.left_child[node])
+        rc = int(tree.right_child[node])
+        for child, zc, d in ((lc, _child_weight(tree, lc) / w, 1),
+                             (rc, _child_weight(tree, rc) / w, 0)):
+            new = list(elems)
+            hit = next((i for i, e in enumerate(new) if e[0] == f), None)
+            if hit is not None:
+                prev = new.pop(hit)
+                new.append((f, prev[1] * zc, prev[2] + [(node, d)]))
+            else:
+                new.append((f, zc, [(node, d)]))
+            rec(child, new)
+
+    if tree.num_leaves > 1:
+        rec(0, [])
+    return out
+
+
+def tree_path_arrays(tree):
+    """Padded per-tree path matrices for the device kernel.
+
+    Returns a dict of numpy arrays (tree-local padding; the serving
+    engine pads to forest maxima before stacking):
+      ``zf``    (L, D) f64  zero fraction per element (pad 1.0)
+      ``feat``  (L, D) i32  feature id (pad 0 — contributes 0, see below)
+      ``node``  (L, D, M) i32  node-condition ids (pad 0)
+      ``dir``   (L, D, M) i8   1=left, 0=right, 2=pad (always agrees)
+      ``leaf_value`` (L,) f64  (pad 0.0)
+    Pad elements use zf=1 with an always-hot condition, making their
+    factor exactly 1 and their contribution (hot - zf) == 0."""
+    paths = tree_leaf_paths(tree)
+    L = max(tree.num_leaves, 1)
+    D = max((len(e) for e in paths.values()), default=0)
+    M = max((len(el[2]) for e in paths.values() for el in e), default=0)
+    zf = np.ones((L, max(D, 1)), dtype=np.float64)
+    feat = np.zeros((L, max(D, 1)), dtype=np.int32)
+    nodec = np.zeros((L, max(D, 1), max(M, 1)), dtype=np.int32)
+    dirc = np.full((L, max(D, 1), max(M, 1)), 2, dtype=np.int8)
+    lv = np.zeros(L, dtype=np.float64)
+    for leaf, elems in paths.items():
+        lv[leaf] = float(tree.leaf_value[leaf])
+        for d, (f, z, conds) in enumerate(elems):
+            zf[leaf, d] = z
+            feat[leaf, d] = f
+            for m, (nid, dr) in enumerate(conds):
+                nodec[leaf, d, m] = nid
+                dirc[leaf, d, m] = dr
+    return {"zf": zf, "feat": feat, "node": nodec, "dir": dirc,
+            "leaf_value": lv}
+
+
 def predict_contrib(gbdt, data: np.ndarray, start_iteration: int = 0,
                     num_iteration: int = -1) -> np.ndarray:
     """SHAP values with the expected-value bias in the last column

@@ -174,3 +174,30 @@ def global_array(value: float) -> List[float]:
     from jax.experimental import multihost_utils
     return [float(v) for v in
             multihost_utils.process_allgather(np.asarray(value))]
+
+
+def global_concat(values: np.ndarray) -> np.ndarray:
+    """Concatenate every rank's rows (rank order, unequal lengths OK).
+
+    The gather primitive behind exact global non-decomposable metrics
+    over rank-sharded rows (e.g. ``distributed_exact_auc``): ranks pad
+    their shard to the group max length, allgather once, and strip the
+    padding with the gathered true lengths.  (The reference has no
+    counterpart — src/metric/ never calls Network; this powers the
+    EXACT option layered over the reference-shaped weighted-mean
+    default, see models/metric.py _rank_mean.)"""
+    import jax
+    arr = np.asarray(values)
+    if jax.process_count() <= 1:
+        return arr
+    from jax.experimental import multihost_utils
+    n_local = arr.shape[0]
+    sizes = multihost_utils.process_allgather(
+        np.asarray(n_local, dtype=np.int64))
+    n_max = int(np.max(sizes))
+    if n_max > n_local:
+        pad = np.zeros((n_max - n_local,) + arr.shape[1:], arr.dtype)
+        arr = np.concatenate([arr, pad], axis=0)
+    gathered = multihost_utils.process_allgather(arr)   # (P, n_max, ...)
+    return np.concatenate(
+        [gathered[p, :int(sizes[p])] for p in range(len(sizes))], axis=0)

@@ -318,16 +318,27 @@ class LGBMModel(_SKBaseEstimator):
         """Predict (reference: sklearn.py LGBMModel.predict:930)."""
         if self._Booster is None:
             raise LightGBMError("Estimator not fitted, call fit first")
-        Xm = _to_2d(X)
-        if Xm.shape[1] != self._n_features:
+        # frames pass through AS FRAMES so Booster.predict applies the
+        # training pandas_categorical code mapping (and, with
+        # validate_features, the column-name check) — the reference
+        # sklearn wrapper does the same; converting here would feed raw
+        # category values (or crash on string categories) for a model
+        # trained on codes
+        if hasattr(X, "columns"):
+            arg, ncol = X, X.shape[1]
+        else:
+            arg = _to_2d(X)
+            ncol = arg.shape[1]
+        if ncol != self._n_features:
             raise ValueError(
                 f"Number of features of the model must match the input. "
                 f"Model n_features_ is {self._n_features} and input "
-                f"n_features is {Xm.shape[1]}")
+                f"n_features is {ncol}")
         return self._Booster.predict(
-            Xm, raw_score=raw_score, start_iteration=start_iteration,
+            arg, raw_score=raw_score, start_iteration=start_iteration,
             num_iteration=num_iteration, pred_leaf=pred_leaf,
-            pred_contrib=pred_contrib, **kwargs)
+            pred_contrib=pred_contrib, validate_features=validate_features,
+            **kwargs)
 
     # -- fitted attributes ------------------------------------------------
     @property
@@ -485,7 +496,9 @@ class LGBMClassifier(_SKClassifierMixin, LGBMModel):
                                  start_iteration=start_iteration,
                                  num_iteration=num_iteration,
                                  pred_leaf=pred_leaf,
-                                 pred_contrib=pred_contrib, **kwargs)
+                                 pred_contrib=pred_contrib,
+                                 validate_features=validate_features,
+                                 **kwargs)
         if raw_score or pred_leaf or pred_contrib:
             return result
         if callable(self._objective):
@@ -509,7 +522,9 @@ class LGBMClassifier(_SKClassifierMixin, LGBMModel):
                                     start_iteration=start_iteration,
                                     num_iteration=num_iteration,
                                     pred_leaf=pred_leaf,
-                                    pred_contrib=pred_contrib, **kwargs)
+                                    pred_contrib=pred_contrib,
+                                    validate_features=validate_features,
+                                    **kwargs)
         if raw_score or pred_leaf or pred_contrib or \
                 callable(self._objective):
             return result

@@ -135,7 +135,7 @@ def test_device_predict_small_batch_warm_cache(rng):
     g._flush_pending()
     small = X[:64]
     # cold cache: small batches decline the device path
-    assert not hasattr(g, "_stack_cache")
+    assert not g.serving._warm("insession")
     assert g._predict_raw_device(small, 0, 10) is None
     # a big batch warms the cache; the SAME compiled traversal then
     # serves small batches

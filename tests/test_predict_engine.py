@@ -1,0 +1,276 @@
+"""Device serving engine (models/serving.py + ops/shap.py).
+
+Covers the PR-3 acceptance gates: device TreeSHAP parity <= 1e-10
+against the host recursion oracle on a categorical+NaN+multiclass model
+matrix, the compile-count guard (N same-bucket calls = exactly one
+trace per (pred kind, bucket)), and cache invalidation on model
+mutation (update/rollback) with stale results proven impossible.
+
+Models are module-scoped: every test shares three trainings (the
+engine's packs/jit caches are per-booster, so sharing models does not
+share the state under test except where a test explicitly warms it).
+"""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.models.shap import predict_contrib as host_contrib
+
+BASE = {"verbosity": -1, "min_data_in_leaf": 10, "metric": ""}
+N, F = 4500, 8
+
+
+def _matrix(seed=7):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(N, F))
+    X[:, 5] = rng.randint(0, 12, size=N)      # categorical column
+    X[::7, 2] = np.nan                        # NaN column
+    signal = (X[:, 0] * 2 + np.sin(X[:, 1] * 2)
+              + np.where(np.isin(X[:, 5], [2, 5, 7]), 1.5, -0.5)
+              + np.nan_to_num(X[:, 2]))
+    return X, signal
+
+
+@pytest.fixture(scope="module")
+def reg_model():
+    """Regression, numeric-only columns of the shared matrix."""
+    X, signal = _matrix()
+    y = signal + 0.1 * np.random.RandomState(1).normal(size=N)
+    bst = lgb.train(dict(BASE, objective="regression", num_leaves=31),
+                    lgb.Dataset(X[:, :5], label=y), num_boost_round=10)
+    bst._gbdt._flush_pending()
+    return bst, X[:, :5].astype(np.float64)
+
+
+@pytest.fixture(scope="module")
+def bin_model():
+    """Binary + categorical + NaN, 20 rounds (early-stop fixture).
+    IMBALANCED (30/70) so boost_from_average folds a non-trivial init
+    score into tree 0 — early-stop margins must include it on the
+    device path too (review finding, PR 3)."""
+    X, signal = _matrix(11)
+    y = (signal > np.quantile(signal, 0.7)).astype(np.float64)
+    bst = lgb.train(dict(BASE, objective="binary", num_leaves=31,
+                         categorical_feature=[5], enable_bundle=False),
+                    lgb.Dataset(X, label=y), num_boost_round=20)
+    bst._gbdt._flush_pending()
+    return bst, X.astype(np.float64)
+
+
+@pytest.fixture(scope="module")
+def mc_model():
+    """Multiclass + categorical, 5 rounds."""
+    X, signal = _matrix(13)
+    y = np.digitize(signal, np.quantile(signal, [1 / 3, 2 / 3]))
+    bst = lgb.train(dict(BASE, objective="multiclass", num_class=3,
+                         num_leaves=15, categorical_feature=[5],
+                         enable_bundle=False),
+                    lgb.Dataset(X, label=y), num_boost_round=5)
+    bst._gbdt._flush_pending()
+    return bst, X.astype(np.float64)
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: device pred_contrib vs host oracle <= 1e-10 on a
+# categorical + NaN + multiclass model matrix
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("model", ["reg", "bin", "mc"])
+def test_device_contrib_matches_host_oracle(model, reg_model, bin_model,
+                                            mc_model):
+    bst, X = {"reg": reg_model, "bin": bin_model, "mc": mc_model}[model]
+    g = bst._gbdt
+    Xq = X[:400]
+    dev = g.serving.contrib(Xq, 0, len(g.models) // g.num_tree_per_iteration)
+    assert dev is not None, "device TreeSHAP must engage for this model"
+    got = bst.predict(Xq, pred_contrib=True)
+    oracle = host_contrib(g, Xq, 0, -1)
+    np.testing.assert_allclose(got, oracle, rtol=0, atol=1e-10)
+    # additivity: contributions sum to the raw score
+    raw = np.asarray(bst.predict(Xq, raw_score=True))
+    K = g.num_tree_per_iteration
+    nf = g.max_feature_idx + 1
+    sums = got.reshape(len(Xq), K, nf + 1).sum(axis=2)
+    np.testing.assert_allclose(np.squeeze(sums), np.squeeze(raw),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_device_contrib_slicing_matches_host(reg_model):
+    bst, X = reg_model
+    g = bst._gbdt
+    Xq = X[:200]
+    for s, m in [(0, 4), (3, 5), (5, -1)]:
+        dev = bst.predict(Xq, pred_contrib=True, start_iteration=s,
+                          num_iteration=m)
+        oracle = host_contrib(g, Xq, s, m)
+        np.testing.assert_allclose(dev, oracle, rtol=0, atol=1e-10)
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: compile-count guard — N same-bucket calls, one trace per
+# (pred kind, bucket); invalidation on update/rollback, stale impossible
+# ---------------------------------------------------------------------------
+def test_compile_count_one_trace_per_bucket(reg_model):
+    bst, X = reg_model
+    eng = bst._gbdt.serving
+    bst.predict(X, raw_score=True)       # N >= 4096: warms the pack
+    assert eng._warm("insession"), "big batch must warm the engine"
+    for n in (700, 700, 600, 900, 513):          # all pad to bucket 1024
+        bst.predict(X[:n], raw_score=True)
+        bst.predict(X[:n], pred_contrib=True)
+        bst.predict(X[:n], pred_leaf=True)
+
+    def contrib_traces(bucket):
+        # contrib compiles one program per depth-group of the packed
+        # forest; each (group, bucket) must still trace exactly once
+        return {k: v for k, v in eng.stats()["traces"].items()
+                if k[0].startswith("contrib") and k[1] == bucket}
+
+    tr = eng.stats()["traces"]
+    assert tr[("raw", 1024)] == 1, tr
+    assert tr[("leaf", 1024)] == 1, tr
+    c1024 = contrib_traces(1024)
+    assert c1024 and all(v == 1 for v in c1024.values()), c1024
+    # a different bucket is a new trace, exactly one
+    bst.predict(X[:200], raw_score=True)
+    bst.predict(X[:129], raw_score=True)
+    assert eng.stats()["traces"][("raw", 256)] == 1
+    # same bucket, sliced iteration ranges: still no re-trace (the
+    # range rides a tree-mask argument, not the jit cache key)
+    bst.predict(X[:700], raw_score=True, start_iteration=2,
+                num_iteration=3)
+    bst.predict(X[:700], pred_contrib=True, num_iteration=4)
+    tr = eng.stats()["traces"]
+    assert tr[("raw", 1024)] == 1
+    assert contrib_traces(1024) == c1024
+
+
+def test_cache_invalidates_on_update_and_rollback():
+    rng = np.random.RandomState(3)
+    X = rng.normal(size=(N, 6))
+    y = X[:, 0] + 0.2 * rng.normal(size=N)
+    ds = lgb.Dataset(X, label=y)
+    params = dict(BASE, objective="regression", num_leaves=15)
+    bst = lgb.Booster(params=params, train_set=ds)
+    for _ in range(5):
+        bst.update()
+    g = bst._gbdt
+    g._flush_pending()
+    p5 = bst.predict(X, raw_score=True)           # warms pack @ 5 trees
+    c5 = bst.predict(X[:500], pred_contrib=True)
+    assert g.serving._warm("insession"), "device path must be serving"
+    v5 = g._model_version
+    # mutation: one more iteration -> version bump -> packs rebuilt
+    bst.update()
+    g._flush_pending()
+    assert g._model_version > v5
+    p6 = bst.predict(X, raw_score=True)
+    c6 = bst.predict(X[:500], pred_contrib=True)
+    assert not np.allclose(p5, p6), "stale pack served after update"
+    assert not np.allclose(c5, c6), "stale contrib pack served after update"
+    # rollback: same tree-count shape as the 5-tree forest -> the jit
+    # cache is reused (no new trace) but the PACK must refresh
+    bst.rollback_one_iter()
+    p5b = bst.predict(X, raw_score=True)
+    c5b = bst.predict(X[:500], pred_contrib=True)
+    np.testing.assert_allclose(p5b, p5, rtol=0, atol=0)
+    np.testing.assert_allclose(c5b, c5, rtol=0, atol=0)
+    # explicit invalidate drops packs; results unchanged after rebuild
+    g.serving.invalidate()
+    assert g.serving.stats()["packs"] == []
+    np.testing.assert_allclose(bst.predict(X, raw_score=True), p5b,
+                               rtol=0, atol=0)
+
+
+# ---------------------------------------------------------------------------
+# pred_early_stop through the engine
+# ---------------------------------------------------------------------------
+def test_early_stop_device_matches_host(bin_model):
+    bst, X = bin_model
+    g = bst._gbdt
+    kw = dict(raw_score=True, pred_early_stop=True,
+              pred_early_stop_freq=5, pred_early_stop_margin=3.0)
+    dev = bst.predict(X, **kw)
+    saved = g.device_trees
+    g.device_trees = [None] * len(saved)
+    host = bst.predict(X, **kw)
+    g.device_trees = saved
+    np.testing.assert_allclose(dev, host, rtol=2e-5, atol=2e-5)
+    # degenerate margins: nothing stops == plain raw; everything stops
+    # after the first block == first-freq prediction
+    huge = bst.predict(X, raw_score=True, pred_early_stop=True,
+                       pred_early_stop_freq=5,
+                       pred_early_stop_margin=1e9)
+    np.testing.assert_allclose(huge, bst.predict(X, raw_score=True),
+                               rtol=2e-6, atol=2e-6)
+    tiny = bst.predict(X, raw_score=True, pred_early_stop=True,
+                       pred_early_stop_freq=4,
+                       pred_early_stop_margin=1e-12)
+    np.testing.assert_allclose(
+        tiny, bst.predict(X, raw_score=True, num_iteration=4),
+        rtol=2e-6, atol=2e-6)
+
+
+def test_early_stop_multiclass_device(mc_model):
+    bst, X = mc_model
+    g = bst._gbdt
+    kw = dict(raw_score=True, pred_early_stop=True,
+              pred_early_stop_freq=2, pred_early_stop_margin=1.0)
+    dev = bst.predict(X, **kw)
+    saved = g.device_trees
+    g.device_trees = [None] * len(saved)
+    host = bst.predict(X, **kw)
+    g.device_trees = saved
+    np.testing.assert_allclose(dev, host, rtol=2e-5, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# pred_leaf through the engine (in-session device path + slicing)
+# ---------------------------------------------------------------------------
+def test_pred_leaf_insession_device_and_slicing(reg_model):
+    bst, X = reg_model
+    g = bst._gbdt
+    leaves = bst.predict(X, pred_leaf=True)
+    host = np.stack([t.predict_leaf(X) for t in g.models], axis=1)
+    np.testing.assert_array_equal(leaves, host)
+    sl = bst.predict(X, pred_leaf=True, start_iteration=2,
+                     num_iteration=3)
+    np.testing.assert_array_equal(sl, host[:, 2:5])
+
+
+def test_raw_slicing_decomposes(reg_model):
+    """predict(raw) over [0, a) plus [a, end) equals the full range —
+    through the device engine (reference: test_engine.py
+    test_predict_with_start_iteration)."""
+    bst, X = reg_model
+    full = bst.predict(X, raw_score=True)
+    a = bst.predict(X, raw_score=True, num_iteration=4)
+    b = bst.predict(X, raw_score=True, start_iteration=4,
+                    num_iteration=-1)
+    np.testing.assert_allclose(a + b, full, rtol=1e-5, atol=1e-5)
+
+
+def test_refit_invalidates_serving_pack(reg_model):
+    """refit's in-place leaf rewrites must invalidate the serving pack
+    its own predict_leaf_index call warmed — a stale pack would serve
+    PRE-refit leaf values on big batches (review finding, PR 3)."""
+    bst, X = reg_model
+    y2 = np.random.RandomState(2).normal(size=len(X)) * 3 + 10.0
+    refitted = bst.refit(X, y2)           # X >= 4096: warms loaded pack
+    big = refitted.predict(X)             # big batch -> device path
+    clean = lgb.Booster(model_str=refitted.model_to_string()).predict(X)
+    np.testing.assert_allclose(big, clean, rtol=1e-6, atol=1e-6)
+    assert not np.allclose(big, bst.predict(X)), \
+        "refit output should differ from the original model"
+
+
+def test_contrib_small_batch_host_fallback_matches(mc_model):
+    """Cold-engine tiny batches fall back to the host oracle; warm
+    engine serves them from the device — both agree."""
+    bst, X = mc_model
+    bst._gbdt.serving.invalidate()                  # force a cold engine
+    tiny = X[:32]
+    cold = bst.predict(tiny, pred_contrib=True)     # host path (cold)
+    bst.predict(X[:400], pred_contrib=True)         # warm the engine
+    warm = bst.predict(tiny, pred_contrib=True)     # device path
+    np.testing.assert_allclose(cold, warm, rtol=0, atol=1e-10)

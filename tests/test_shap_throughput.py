@@ -1,7 +1,12 @@
-"""Batched TreeSHAP throughput + parity (round-4 verdict #8: the
-reference parallelizes PredictContrib over rows with OpenMP,
-src/io/tree.cpp; here the recursion carries (n,)-vector fractions so one
-tree-walk serves every row)."""
+"""TreeSHAP throughput gates on the DEVICE serving path (PR-3: the
+round-5 150s host-path relaxation is deleted; the device kernel
+restores the verdict's <5s budget on the TPU/large lane, with a
+proportionally scaled tier-1 bound pinning the CPU backend).
+
+The device kernel (ops/shap.py) re-expresses the unwound-path
+recursion as dense per-(element, row) quadrature ops; the host
+recursion (models/shap.py) stays the exact oracle, asserted here on a
+subsample."""
 
 import time
 
@@ -10,52 +15,86 @@ import pytest
 
 import lightgbm_tpu as lgb
 
+# Measured on the 2-core CPU CI host (see PERF.md round 7): the device
+# kernel runs the tier-1 shape (20k x 30 trees) in ~1.0 s warm.  The
+# bound is ~5x that measurement — tight enough to catch a return to the
+# host path's ~30x-slower regime, loose enough for CI noise.
+TIER1_ROWS, TIER1_TREES, TIER1_BOUND_S = 20_000, 30, 5.0
+# full verdict shape; <5 s applies on an accelerator backend (the
+# budget the round-4 verdict set for the benchmark host).  The 2-core
+# CPU lane pins its own measured envelope instead (~33 s, bound ~3x;
+# the host recursion projects to ~104 s on the same shape).
+FULL_ROWS, FULL_TREES = 100_000, 100
+FULL_BOUND_CPU_S = 90.0
 
-@pytest.mark.slow
-def test_pred_contrib_throughput_and_parity(rng):
-    """100k rows x 100 trees pred_contrib in < 5s (single-core CPU
-    budget scaled: the verdict's gate), exact parity vs the per-row
-    recursion oracle on a subsample, and additivity (sum of contribs ==
-    raw prediction, the TreeSHAP invariant)."""
-    n_train, n_pred, f = 20000, 100_000, 10
+
+def _train(rng, n_train, trees, f=10):
     X = rng.normal(size=(n_train, f))
     y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2] > 0).astype(np.float64)
     bst = lgb.train({"objective": "binary", "num_leaves": 31,
                      "verbosity": -1, "metric": ""},
-                    lgb.Dataset(X, label=y), num_boost_round=100)
-    Xp = rng.normal(size=(n_pred, f))
+                    lgb.Dataset(X, label=y), num_boost_round=trees)
+    bst._gbdt._flush_pending()
+    return bst
 
+
+def test_pred_contrib_device_tier1_bound(rng):
+    """Scaled serving-shape gate for the tier-1 CPU lane: the DEVICE
+    path must engage and beat a bound ~30x under the old host-path
+    cost for the same shape."""
+    bst = _train(rng, 5_000, TIER1_TREES)
+    g = bst._gbdt
+    Xp = rng.normal(size=(TIER1_ROWS, 10))
+    # warm: pack build + per-bucket trace are one-time serving costs
+    bst.predict(Xp[:4096], pred_contrib=True)
+    assert g.serving._warm("contrib"), "device TreeSHAP must engage"
     t0 = time.time()
     contrib = bst.predict(Xp, pred_contrib=True)
     wall = time.time() - t0
-    assert contrib.shape == (n_pred, f + 1)
-    # additivity: contribs + expected value == raw score, every row
+    assert contrib.shape == (TIER1_ROWS, 11)
+    assert wall < TIER1_BOUND_S, \
+        f"device pred_contrib took {wall:.1f}s for " \
+        f"{TIER1_ROWS}x{TIER1_TREES} (bound {TIER1_BOUND_S}s)"
+    # additivity invariant on the full batch
     raw = bst.predict(Xp, raw_score=True)
     np.testing.assert_allclose(contrib.sum(axis=1), raw,
                                rtol=1e-6, atol=1e-6)
-    # throughput gate.  Context (measured round 5 on THIS 1-core host):
-    # the reference C++ PredictContrib with num_threads=1 takes ~25s on
-    # this exact shape via its own CLI, and this batch recursion lands
-    # within ~4x of that in pure numpy with EXACT (4e-14) value parity
-    # against the reference's output.  The verdict's "<5s" budget
-    # presumed a multicore host; per-core the gate here is a bounded
-    # constant over the reference, not a fixed wall-clock.
-    assert wall < 150.0, f"pred_contrib took {wall:.1f}s"
+
+
+@pytest.mark.slow
+def test_pred_contrib_throughput_and_parity(rng):
+    """Verdict shape: 100k rows x 100 trees pred_contrib through the
+    device engine — <5s on an accelerator backend, measured CPU
+    envelope otherwise — plus exact parity vs the per-row recursion
+    oracle on a subsample and the additivity invariant."""
+    import jax
+    bst = _train(rng, 20_000, FULL_TREES)
+    Xp = rng.normal(size=(FULL_ROWS, 10))
+    bst.predict(Xp[:4096], pred_contrib=True)       # warm
+    t0 = time.time()
+    contrib = bst.predict(Xp, pred_contrib=True)
+    wall = time.time() - t0
+    assert contrib.shape == (FULL_ROWS, 11)
+    raw = bst.predict(Xp, raw_score=True)
+    np.testing.assert_allclose(contrib.sum(axis=1), raw,
+                               rtol=1e-6, atol=1e-6)
+    bound = 5.0 if jax.default_backend() != "cpu" else FULL_BOUND_CPU_S
+    assert wall < bound, f"pred_contrib took {wall:.1f}s (bound {bound}s)"
 
     # exact parity vs the per-(row,tree) recursion oracle on 50 rows
     from lightgbm_tpu.models import shap as shap_mod
     g = bst._gbdt
     sub = Xp[:50].astype(np.float64)
-    oracle = np.zeros((50, f + 1))
+    oracle = np.zeros((50, 11))
     for tree in g.models:
         if tree.num_leaves <= 1:
             oracle[:, -1] += tree.leaf_value[0]
             continue
         oracle[:, -1] += shap_mod._expected_value(tree)
+        maxd = tree.num_leaves + 2
+        parent = [shap_mod._PathElement() for _ in range(maxd + 2)]
         for r in range(50):
-            phi = np.zeros(f + 1)
-            maxd = tree.num_leaves + 2
-            parent = [shap_mod._PathElement() for _ in range(maxd + 2)]
+            phi = np.zeros(11)
             shap_mod._tree_shap(tree, sub[r], phi, 0, 0, parent,
                                 1.0, 1.0, -1)
             oracle[r, :-1] += phi[:-1]
@@ -63,15 +102,17 @@ def test_pred_contrib_throughput_and_parity(rng):
 
 
 def test_stacked_variant_parity(rng, monkeypatch):
-    """The env-gated stacked unwound-sum variant is bit-identical to the
-    per-position loop."""
-    import lightgbm_tpu as lgb
+    """The env-gated stacked unwound-sum variant of the HOST oracle is
+    bit-identical to its per-position loop."""
     X = rng.normal(size=(2000, 8))
     y = (X[:, 0] + X[:, 1] * X[:, 2] > 0).astype(np.float64)
     bst = lgb.train({"objective": "binary", "num_leaves": 31,
                      "verbosity": -1, "metric": ""},
                     lgb.Dataset(X, label=y), num_boost_round=10)
-    Xp = rng.normal(size=(500, 8))
-    base = bst.predict(Xp, pred_contrib=True)
+    from lightgbm_tpu.models.shap import predict_contrib as host_contrib
+    g = bst._gbdt
+    g._flush_pending()
+    Xp = np.asarray(rng.normal(size=(500, 8)), np.float64)
+    base = host_contrib(g, Xp, 0, -1)
     monkeypatch.setenv("LIGHTGBM_TPU_SHAP_STACKED", "1")
-    np.testing.assert_array_equal(bst.predict(Xp, pred_contrib=True), base)
+    np.testing.assert_array_equal(host_contrib(g, Xp, 0, -1), base)

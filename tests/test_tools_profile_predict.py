@@ -1,0 +1,42 @@
+"""Smoke for tools/profile_predict.py (PR-3 satellite): the serving
+throughput harness runs at tiny sizes, emits parseable JSON, proves the
+compile-count invariant (one trace per kind x bucket x depth-group),
+and pins device SHAP parity against the host recursion in its own
+output.  Runs in-process to share the session's jit caches (a
+subprocess would pay ~20 s of import+compile for the same cover)."""
+
+import importlib.util
+import json
+import os
+import sys
+
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool():
+    spec = importlib.util.spec_from_file_location(
+        "profile_predict", os.path.join(HERE, "tools",
+                                        "profile_predict.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_profile_predict_smoke(capsys):
+    tool = _load_tool()
+    rc = tool.main(["--smoke", "--rows", "1200", "--trees", "4"])
+    assert rc == 0
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    payload = json.loads(line)
+    assert payload["metric"] == "predict_serving"
+    detail = payload["detail"]
+    assert detail["multi_traced"] == {}, \
+        f"retrace detected: {detail['multi_traced']}"
+    assert detail["grid"], "grid must not be empty"
+    row = detail["grid"][0]
+    assert row["raw_warm_s"] >= 0 and row["contrib_warm_s"] >= 0
+    assert row["host_parity_max_abs"] < 1e-10
+    # every traced (kind, bucket) was called at least once yet traced
+    # exactly once
+    assert all(v == 1 for v in detail["traces"].values())

@@ -1,0 +1,127 @@
+"""Predict + pred_contrib serving throughput harness.
+
+Times the device serving engine (models/serving.py) over a rows x trees
+grid: warm raw-score predict, warm pred_contrib (vectorized device
+TreeSHAP, ops/shap.py), the host TreeSHAP recursion on a subsample (the
+before/after the engine replaces), and the per-(kind, bucket) compile
+counts proving repeated serving-shaped calls never re-trace.
+
+Prints ONE JSON line (like bench.py):
+
+  {"metric": "predict_serving", "detail": {"grid": [...],
+   "traces": {...}, "device": "..."}}
+
+Usage:
+  python tools/profile_predict.py [--rows 100000] [--trees 100]
+      [--features 10] [--smoke]
+
+``--smoke`` shrinks the grid to seconds for the tier-1 lane.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def _train(lgb, rng, n_train, features, trees):
+    X = rng.normal(size=(n_train, features))
+    y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2] > 0).astype(np.float64)
+    bst = lgb.train({"objective": "binary", "num_leaves": 31,
+                     "verbosity": -1, "metric": ""},
+                    lgb.Dataset(X, label=y), num_boost_round=trees)
+    bst._gbdt._flush_pending()
+    return bst
+
+
+def _timed(fn, *args, **kw):
+    t0 = time.time()
+    out = fn(*args, **kw)
+    return time.time() - t0, out
+
+
+def run(rows, trees, features, smoke, host_oracle_rows):
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(5)
+    bst = _train(lgb, rng, min(rows, 20000), features, trees)
+    g = bst._gbdt
+    # one big call warms the engine pack so small serving-shaped batches
+    # take the device path from the start (see ServingEngine.COLD_MIN_ROWS)
+    bst.predict(rng.normal(size=(max(4096, min(rows, 8192)), features)),
+                raw_score=True)
+    grid = []
+    row_grid = sorted({min(1000, rows), min(10000, rows), rows})
+    for n in row_grid:
+        Xp = rng.normal(size=(n, features))
+        # cold call pays the pack + trace; the second call is the
+        # serving-shaped steady state
+        cold_raw, _ = _timed(bst.predict, Xp, raw_score=True)
+        warm_raw, _ = _timed(bst.predict, Xp, raw_score=True)
+        cold_con, _ = _timed(bst.predict, Xp, pred_contrib=True)
+        warm_con, contrib = _timed(bst.predict, Xp, pred_contrib=True)
+        row = {"rows": n, "trees": trees,
+               "raw_cold_s": round(cold_raw, 4),
+               "raw_warm_s": round(warm_raw, 4),
+               "raw_rows_per_s": round(n / max(warm_raw, 1e-9)),
+               "contrib_cold_s": round(cold_con, 4),
+               "contrib_warm_s": round(warm_con, 4),
+               "contrib_rows_per_s": round(n / max(warm_con, 1e-9))}
+        if host_oracle_rows and n == row_grid[0]:
+            from lightgbm_tpu.models.shap import predict_contrib
+            m = min(host_oracle_rows, n)
+            host_s, host = _timed(predict_contrib, g,
+                                  np.asarray(Xp[:m], np.float64), 0, -1)
+            row["host_contrib_s"] = round(host_s, 4)
+            row["host_contrib_rows"] = m
+            row["host_parity_max_abs"] = float(
+                np.max(np.abs(np.asarray(contrib[:m]) - host)))
+        grid.append(row)
+    stats = g.serving.stats()
+    # compile-count invariant: every (kind, bucket) traced at most once
+    multi = {f"{k[0]}@{k[1]}": v for k, v in stats["traces"].items()
+             if v != 1}
+    import jax
+    return {"metric": "predict_serving",
+            "value": grid[-1]["contrib_rows_per_s"],
+            "unit": "contrib_rows_per_s",
+            "detail": {"grid": grid,
+                       "traces": {f"{k[0]}@{k[1]}": v
+                                  for k, v in stats["traces"].items()},
+                       "calls": {f"{k[0]}@{k[1]}": v
+                                 for k, v in stats["calls"].items()},
+                       "multi_traced": multi,
+                       "smoke": bool(smoke),
+                       "device": jax.default_backend()}}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=100_000)
+    ap.add_argument("--trees", type=int, default=100)
+    ap.add_argument("--features", type=int, default=10)
+    ap.add_argument("--host-oracle-rows", type=int, default=2000,
+                    help="rows for the host-recursion comparison point "
+                         "(0 disables)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny grid for the tier-1 smoke lane")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        args.rows = min(args.rows, 3000)
+        args.trees = min(args.trees, 10)
+        args.host_oracle_rows = min(args.host_oracle_rows, 200)
+    out = run(args.rows, args.trees, args.features, args.smoke,
+              args.host_oracle_rows)
+    print(json.dumps(out))
+    # non-zero exit when the compile-count invariant is violated, so the
+    # smoke lane fails loudly on a retrace regression
+    return 1 if out["detail"]["multi_traced"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
