@@ -1,0 +1,23 @@
+"""jaxlint: static analysis + compile-artifact guards for the TPU
+training/serving stack.
+
+Two tiers (driven by ``tools/jaxlint.py`` and tier-1's
+``tests/test_jaxlint.py``):
+
+* **Tier A** (:mod:`.astlint`) — AST lint with JAX-specific rules
+  JL001–JL005 (host syncs in hot paths, retrace hazards, f64 leaks,
+  Python-sized while carries, rank-divergent collectives).
+* **Tier B** (:mod:`.artifacts`, :mod:`.hlo`) — designated entry
+  points lowered to jaxpr/HLO with structural invariants asserted as
+  budgets: while-body copy counts, serving transfer/compile counts,
+  fused-step buffer donation, SHAP kernel structure.
+
+Findings and budgets ratchet against the committed
+``jaxlint_baseline.json`` (:mod:`.baseline`): pre-existing debt is
+pinned, new debt fails tier-1, and paying debt down requires shrinking
+the baseline.
+"""
+
+from . import astlint, baseline  # noqa: F401
+from .astlint import Finding, RULES, finding_counts, lint_source, lint_tree  # noqa: F401
+from .baseline import Problem, compare_tier_a, compare_tier_b  # noqa: F401

@@ -1,0 +1,236 @@
+"""Tier B of jaxlint: compile-artifact budget checks.
+
+Tier A reads source; this tier lowers the designated entry points to
+optimized HLO / live trace counters and asserts STRUCTURAL invariants
+as machine-checked budgets, so the regressions that only a profiler
+would otherwise catch fail tier-1 instead:
+
+* ``while_body.default`` / ``while_body.mega`` — op/fusion/copy counts
+  of the compiled tree-build while body (generalizing
+  tools/hlo_report.py): the default subtraction path carries exactly
+  its two known contextual hist-state copies, the mega-kernel body
+  carries zero and the (L+1)-slot state buffer must not exist at all.
+* ``serving.compiles`` — N same-bucket serving calls (raw / leaf /
+  contrib) cost exactly one XLA trace per (kind, bucket); a second
+  trace is a retrace regression.
+* ``serving.transfers`` — the compiled raw-serving program contains no
+  host callbacks and stays under a copy/transfer op budget in its
+  entry computation.
+* ``train.donation`` — the fused train step is jitted with donated
+  score/payload buffers (losing donation doubles the resident score
+  footprint and adds a copy per iteration).
+* ``shap.kernel`` — the device TreeSHAP program keeps its unrolled
+  D/q-loop structure (at most the single tree scan ``while``), runs
+  f64 under the scoped x64 context, and contains no host callbacks.
+
+Every metric is a ceiling checked against ``jaxlint_baseline.json``
+(see :mod:`lightgbm_tpu.analysis.baseline`).  All checks run on the
+current backend — CPU in tier-1 — exactly like tests/test_hlo_guard.py.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["collect_tier_b", "CHECKS"]
+
+
+# ---------------------------------------------------------------------------
+# while-body checks (tree build)
+# ---------------------------------------------------------------------------
+def check_while_body_default() -> Dict[str, int]:
+    from .hlo import report
+    r = report({})
+    return {
+        "total_ops": r["total_ops"],
+        "fusions": r["fusions"],
+        "copies": r["copies"],
+        "hist_state_copies": r["hist_state_copies"],
+    }
+
+
+def check_while_body_mega() -> Dict[str, int]:
+    from .hlo import report
+    r = report({"tpu_megakernel": "xla"})
+    return {
+        "hist_state_copies": r["hist_state_copies"],
+        "hist_state_shape_lines": r["hist_state_shape_lines"],
+        "copies": r["copies"],
+    }
+
+
+# ---------------------------------------------------------------------------
+# serving-engine checks
+# ---------------------------------------------------------------------------
+_TINY = {}
+
+
+def _tiny_serving_booster():
+    """One small trained booster shared by the serving checks (module
+    cache: artifact collection may run several checks per process)."""
+    if "bst" in _TINY:
+        return _TINY["bst"], _TINY["X"]
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(3)
+    X = rng.normal(size=(4500, 6))
+    y = X[:, 0] * 2.0 + np.sin(X[:, 1]) + 0.1 * rng.normal(size=len(X))
+    bst = lgb.train({"objective": "regression", "verbosity": -1,
+                     "num_leaves": 15, "min_data_in_leaf": 10,
+                     "metric": ""},
+                    lgb.Dataset(X[:, :], label=y), num_boost_round=5)
+    bst._gbdt._flush_pending()
+    _TINY["bst"] = bst
+    _TINY["X"] = X
+    return bst, X
+
+
+def check_serving_compiles() -> Dict[str, int]:
+    """max traces per (kind, bucket) across repeated same-bucket calls
+    — the compile-count guard as a budget."""
+    bst, X = _tiny_serving_booster()
+    eng = bst._gbdt.serving
+    eng.trace_counts.clear()
+    eng.call_counts.clear()
+    bst.predict(X, raw_score=True)            # >= COLD_MIN_ROWS: warms
+    for n in (700, 700, 600, 900):            # all pad to bucket 1024
+        bst.predict(X[:n], raw_score=True)
+        bst.predict(X[:n], pred_leaf=True)
+        bst.predict(X[:n], pred_contrib=True)
+    max_traces = max(eng.trace_counts.values(), default=0)
+    # every (kind, bucket) seen must have exactly one trace
+    multi = sum(1 for v in eng.trace_counts.values() if v > 1)
+    return {"max_traces_per_bucket": max_traces,
+            "buckets_with_retrace": multi}
+
+
+def _serving_raw_lowered_text() -> str:
+    import jax.numpy as jnp
+    bst, X = _tiny_serving_booster()
+    eng = bst._gbdt.serving
+    pack = eng._pack("insession", eng._insession_pack)
+    assert pack is not None, "tiny booster must be device-eligible"
+    binned = eng._bin(X[:128], pack["has_cat"])
+    pk = pack["per_k"][0]
+    mask = eng._tree_mask(pack["T_k"], 0, pack["T_k"])
+    fn = eng._fn("raw")
+    lowered = fn.lower(pk["nodes"], pk["deltas"], mask,
+                       jnp.asarray(binned))
+    return lowered.compile().as_text()
+
+
+def check_serving_transfers() -> Dict[str, int]:
+    from .hlo import body_counts, entry_name
+    txt = _serving_raw_lowered_text()
+    entry = entry_name(txt)
+    counts = body_counts(txt, body_name=entry) if entry else {
+        "copies": 0, "total_ops": 0}
+    callbacks = len(re.findall(r"callback", txt))
+    transfers = len(re.findall(
+        r"\b(?:copy-start|copy-done|send|recv|infeed|outfeed)\(", txt))
+    return {"entry_copies": counts["copies"],
+            "transfer_ops": transfers,
+            "host_callbacks": callbacks}
+
+
+# ---------------------------------------------------------------------------
+# donation of the fused train step's score/payload buffers
+# ---------------------------------------------------------------------------
+@contextlib.contextmanager
+def _record_jits(records: List[Tuple[str, Any]]):
+    import jax
+    orig = jax.jit
+
+    @functools.wraps(orig)
+    def spy(fun, *a, **k):
+        records.append((getattr(fun, "__qualname__", repr(fun)),
+                        k.get("donate_argnums")))
+        return orig(fun, *a, **k)
+
+    jax.jit = spy
+    try:
+        yield
+    finally:
+        jax.jit = orig
+
+
+def check_train_donation() -> Dict[str, int]:
+    """The fused per-iteration step must be jitted with donated
+    buffers; count fused steps constructed WITHOUT donation."""
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+    records: List[Tuple[str, Any]] = []
+    rng = np.random.RandomState(5)
+    X = rng.normal(size=(400, 5))
+    y = X[:, 0] - X[:, 2] + 0.1 * rng.normal(size=len(X))
+    with _record_jits(records):
+        lgb.train({"objective": "regression", "verbosity": -1,
+                   "num_leaves": 7, "min_data_in_leaf": 5,
+                   "metric": ""},
+                  lgb.Dataset(X, label=y), num_boost_round=2)
+    fused = [(q, d) for q, d in records
+             if "_setup_fused" in q and q.endswith(".step")]
+    undonated = sum(1 for _, d in fused if not d)
+    return {"fused_steps_jitted": len(fused),
+            "fused_steps_without_donation": undonated,
+            "fused_step_missing": 0 if fused else 1}
+
+
+# ---------------------------------------------------------------------------
+# device TreeSHAP program structure
+# ---------------------------------------------------------------------------
+def check_shap_kernel() -> Dict[str, int]:
+    import jax
+    import jax.numpy as jnp
+
+    from .hlo import body_counts, entry_name
+    from ..ops.shap import tree_shap_stacked
+    bst, X = _tiny_serving_booster()
+    eng = bst._gbdt.serving
+    eng._pack("insession", eng._insession_pack)
+    pack = eng._pack("contrib", eng._contrib_pack)
+    assert pack is not None, "tiny booster must be SHAP-eligible"
+    grp = pack["per_k"][0]["groups"][0]
+    binned = eng._bin(X[:128], pack["has_cat"])
+    ncols = pack["num_cols"]
+    with jax.experimental.enable_x64():
+        mask = jnp.asarray((grp["iters"] >= 0).astype("float32"))
+        fn = jax.jit(functools.partial(tree_shap_stacked,
+                                       num_columns=ncols))
+        lowered = fn.lower(jnp.asarray(binned), grp["nodes"],
+                           grp["paths"], mask, jnp.asarray(grp["tq"]),
+                           jnp.asarray(grp["om"]))
+        txt = lowered.compile().as_text()
+    entry = entry_name(txt)
+    counts = body_counts(txt, body_name=entry) if entry else {}
+    whiles = len(re.findall(r"\bwhile\(", txt))
+    callbacks = len(re.findall(r"callback", txt))
+    f64_absent = 0 if "f64[" in txt else 1
+    return {"whiles": whiles, "host_callbacks": callbacks,
+            "f64_absent": f64_absent,
+            "entry_copies": counts.get("copies", 0)}
+
+
+CHECKS = {
+    "while_body.default": check_while_body_default,
+    "while_body.mega": check_while_body_mega,
+    "serving.compiles": check_serving_compiles,
+    "serving.transfers": check_serving_transfers,
+    "train.donation": check_train_donation,
+    "shap.kernel": check_shap_kernel,
+}
+
+
+def collect_tier_b(only: Optional[List[str]] = None
+                   ) -> Dict[str, Dict[str, int]]:
+    out: Dict[str, Dict[str, int]] = {}
+    for name, fn in CHECKS.items():
+        if only and name not in only:
+            continue
+        out[name] = fn()
+    return out

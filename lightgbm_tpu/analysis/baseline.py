@@ -1,0 +1,116 @@
+"""The jaxlint baseline ratchet (``jaxlint_baseline.json``).
+
+Tier A findings are pinned EXACTLY: a finding key not in the baseline
+(or above its pinned count) fails the check, and a pinned count higher
+than what the linter now measures is STALE — fixing a violation
+requires shrinking the baseline in the same change, so the pinned debt
+only ever goes down.
+
+Tier B budgets are CEILINGS: measured values may sit below them (the
+HLO counts need headroom for toolchain drift — see
+tests/test_hlo_guard.py's ~50% margins), but never above.  Boolean
+invariants are encoded as 0/1 metrics with budget 0.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+from typing import Any, Dict, List
+
+DEFAULT_BASELINE = "jaxlint_baseline.json"
+
+
+@dataclass
+class Problem:
+    kind: str        # "new" | "stale" | "budget"
+    key: str         # finding key or "check.metric"
+    measured: int
+    pinned: int
+    message: str
+
+    def render(self) -> str:
+        return f"[{self.kind}] {self.key}: {self.message}"
+
+    def to_json(self) -> str:
+        return json.dumps({"problem": self.kind, "key": self.key,
+                           "measured": self.measured,
+                           "pinned": self.pinned,
+                           "message": self.message}, sort_keys=True)
+
+
+def load(path: str) -> Dict[str, Any]:
+    if not os.path.exists(path):
+        return {"version": 1, "tier_a": {}, "tier_b": {}}
+    with open(path, encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def save(path: str, data: Dict[str, Any]) -> None:
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(data, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+def make(tier_a_counts: Dict[str, int],
+         tier_b: Dict[str, Dict[str, int]],
+         headroom: Dict[str, Dict[str, int]] = None) -> Dict[str, Any]:
+    """Build a baseline document from measured values.  ``headroom``
+    maps check -> {metric: extra budget} for tier B ceilings that need
+    drift margin (never applied to invariant metrics pinned at 0)."""
+    tb: Dict[str, Dict[str, int]] = {}
+    for check, metrics in tier_b.items():
+        tb[check] = {}
+        for metric, value in metrics.items():
+            extra = (headroom or {}).get(check, {}).get(metric, 0)
+            tb[check][metric] = value + (extra if value else 0)
+    return {"version": 1, "tier_a": dict(sorted(tier_a_counts.items())),
+            "tier_b": tb}
+
+
+def compare_tier_a(measured: Dict[str, int],
+                   baseline: Dict[str, Any]) -> List[Problem]:
+    pinned: Dict[str, int] = baseline.get("tier_a", {})
+    problems: List[Problem] = []
+    for key in sorted(set(measured) | set(pinned)):
+        m = measured.get(key, 0)
+        p = pinned.get(key, 0)
+        if m > p:
+            problems.append(Problem(
+                "new", key, m, p,
+                f"{m - p} new finding(s) over the pinned {p}; fix them "
+                "(do not grow the baseline)"))
+        elif m < p:
+            problems.append(Problem(
+                "stale", key, m, p,
+                f"pinned {p} but only {m} remain; shrink the baseline "
+                "(tools/jaxlint.py --update-baseline) so the ratchet "
+                "holds"))
+    return problems
+
+
+def compare_tier_b(measured: Dict[str, Dict[str, int]],
+                   baseline: Dict[str, Any]) -> List[Problem]:
+    budgets: Dict[str, Dict[str, int]] = baseline.get("tier_b", {})
+    problems: List[Problem] = []
+    for check, metrics in sorted(measured.items()):
+        pinned = budgets.get(check)
+        if pinned is None:
+            problems.append(Problem(
+                "new", check, len(metrics), 0,
+                "no budget committed for this check; run "
+                "--update-baseline and review the numbers"))
+            continue
+        for metric, value in sorted(metrics.items()):
+            if metric not in pinned:
+                problems.append(Problem(
+                    "new", f"{check}.{metric}", value, 0,
+                    "metric has no committed budget"))
+            elif value > pinned[metric]:
+                problems.append(Problem(
+                    "budget", f"{check}.{metric}", value, pinned[metric],
+                    f"measured {value} exceeds the committed budget "
+                    f"{pinned[metric]} — a structural regression in "
+                    "the compiled artifact"))
+    return problems

@@ -426,6 +426,41 @@ class Booster:
             log.fatal("Booster requires train_set, model_file or model_str")
 
     # ------------------------------------------------------------------
+    # pickle / deepcopy: the GBDT holds jitted closures (fused step,
+    # traversal, the serving engine's compiled predictors) that cannot
+    # pickle, so — like the reference python-package Booster, which
+    # pickles its C handle as a model string — the state is the model
+    # text plus the picklable python attributes.  The restored booster
+    # re-warms its serving engine lazily on the FIRST predict
+    # (models/serving.py mark_rewarm): one re-pack + one trace per
+    # (kind, bucket), never a crash or a per-call cold trace.
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state.pop("train_set", None)
+        state.pop("_valid_sets", None)
+        state.pop("_init_booster", None)
+        state.pop("_objective", None)
+        g = state.pop("_gbdt", None)
+        if g is not None:
+            g._flush_pending()
+            state["_model_str"] = self.model_to_string()
+            state["_serving_was_warm"] = bool(
+                g.serving._packs or g.serving._rewarm)
+        return state
+
+    def __setstate__(self, state):
+        model_str = state.pop("_model_str", None)
+        was_warm = state.pop("_serving_was_warm", False)
+        self.__dict__.update(state)
+        self.train_set = None
+        self._valid_sets = []
+        self._gbdt = None
+        if model_str is not None:
+            self._load_model_string(model_str)
+            if was_warm:
+                self._gbdt.serving.mark_rewarm()
+
+    # ------------------------------------------------------------------
     def _continue_from(self, init_model) -> "Booster":
         """Continued training: seed this (fresh, train-set-backed) booster
         with the trees and scores of ``init_model`` (a Booster, model file

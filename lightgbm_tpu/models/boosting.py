@@ -1354,8 +1354,12 @@ class GBDT:
                 mask = jnp.where(pos, u < cfg.pos_bagging_fraction,
                                  u < cfg.neg_bagging_fraction)
                 # the ACTUAL drawn count (bagging.hpp:46
-                # bag_data_cnt_ = left_cnt), not the sizing estimate
-                cnt = max(int(jnp.sum(mask.astype(jnp.int32))), 1)
+                # bag_data_cnt_ = left_cnt), not the sizing estimate —
+                # kept as a device scalar: build_tree takes it traced,
+                # so an int() here is a host sync per bagging redraw
+                # for nothing (jaxlint JL001)
+                cnt = jnp.maximum(jnp.sum(mask.astype(jnp.int32)),
+                                  jnp.int32(1))
             else:
                 cnt = max(int(N * cfg.bagging_fraction), 1)
                 mask = jnp.zeros((N,), bool).at[

@@ -471,7 +471,12 @@ class NDCGMetric(Metric):
 
     def eval(self, score, objective):
         score = jnp.asarray(score)
-        totals = np.zeros(len(self.eval_at))
+        # per-bucket sums stay ON DEVICE inside the loop and sync once
+        # at the end: a float() per (bucket, k) serializes one blocking
+        # device round-trip per size bucket per eval round (jaxlint
+        # JL001); cross-bucket accumulation runs in f64 on host exactly
+        # as before
+        bucket_sums = []
         for b in self.buckets:
             P = b["P"]
             doc_idx = b["doc_idx"]
@@ -482,12 +487,17 @@ class NDCGMetric(Metric):
             order = jnp.argsort(-s, axis=1, stable=True)
             g_sorted = jnp.take_along_axis(g, order, axis=1)
             disc = 1.0 / jnp.log2(2.0 + jnp.arange(P, dtype=jnp.float32))
+            per_k = []
             for ki, k in enumerate(self.eval_at):
                 kk = min(k, P)
                 dcg = jnp.sum(g_sorted[:, :kk] * disc[:kk], axis=1)
                 idcg = b["idcg"][:, ki]
                 ndcg = jnp.where(idcg > 0, dcg / jnp.maximum(idcg, K_EPSILON), 1.0)
-                totals[ki] += float(jnp.sum(ndcg))
+                per_k.append(jnp.sum(ndcg))
+            bucket_sums.append(jnp.stack(per_k))
+        totals = np.sum(np.asarray(jax.device_get(bucket_sums),
+                                   dtype=np.float64), axis=0) \
+            if bucket_sums else np.zeros(len(self.eval_at))
         nq = _global_queries(totals, self.num_queries)
         return [(f"ndcg@{k}", totals[ki] / nq)
                 for ki, k in enumerate(self.eval_at)]
@@ -521,7 +531,9 @@ class MapMetric(Metric):
 
     def eval(self, score, objective):
         score = jnp.asarray(score)
-        totals = np.zeros(len(self.eval_at))
+        # same one-sync-per-eval batching as NDCGMetric.eval (jaxlint
+        # JL001): device sums per bucket, host f64 cross-bucket total
+        bucket_sums = []
         for b in self.buckets:
             P = b["P"]
             doc_idx = b["doc_idx"]
@@ -534,12 +546,17 @@ class MapMetric(Metric):
             cum_rel = jnp.cumsum(y_sorted, axis=1)
             pos = jnp.arange(1, P + 1, dtype=jnp.float32)
             prec = cum_rel / pos
+            per_k = []
             for ki, k in enumerate(self.eval_at):
                 kk = min(k, P)
                 ap_num = jnp.sum(prec[:, :kk] * y_sorted[:, :kk], axis=1)
                 denom = jnp.maximum(jnp.minimum(cum_rel[:, -1], float(kk)), 1.0)
                 ap = ap_num / denom
-                totals[ki] += float(jnp.sum(ap))
+                per_k.append(jnp.sum(ap))
+            bucket_sums.append(jnp.stack(per_k))
+        totals = np.sum(np.asarray(jax.device_get(bucket_sums),
+                                   dtype=np.float64), axis=0) \
+            if bucket_sums else np.zeros(len(self.eval_at))
         nq = _global_queries(totals, self.num_queries)
         return [(f"map@{k}", totals[ki] / nq)
                 for ki, k in enumerate(self.eval_at)]

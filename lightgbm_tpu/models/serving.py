@@ -80,14 +80,31 @@ class ServingEngine:
         self.call_counts: Dict[Any, int] = {}    # (kind, bucket) -> calls
         self._packs: Dict[str, Any] = {}         # name -> (key, payload)
         self._fns: Dict[str, Any] = {}           # kind -> jitted callable
+        # pack names to re-warm LAZILY on the first predict after a
+        # pickle/deepcopy restore: the restored copy bypasses the
+        # COLD_MIN_ROWS gate for these names (the original was serving
+        # them, so the copy is serving-shaped traffic too) instead of
+        # silently answering small batches from the host paths
+        self._rewarm: set = set()
 
     # jitted callables and device packs are neither picklable nor worth
-    # copying (sklearn deepcopy / dask shipping): a copy starts cold
+    # copying (sklearn deepcopy / dask shipping): a copy re-packs and
+    # re-traces ONCE on its first predict (see _rewarm above)
     def __getstate__(self):
-        return {"gbdt": self.gbdt}
+        # union, not fallback: a restored-then-partially-re-packed
+        # engine still owes re-warms for the names it hasn't rebuilt
+        return {"gbdt": self.gbdt,
+                "warm": sorted(set(self._packs) | self._rewarm)}
 
     def __setstate__(self, state):
         self.__init__(state["gbdt"])
+        self._rewarm = set(state.get("warm") or ())
+
+    def mark_rewarm(self, names=("insession", "contrib", "loaded")) -> None:
+        """Treat ``names`` as warm for cold-row gating until their packs
+        are actually rebuilt (Booster.__setstate__ calls this when the
+        pickled booster's engine was warm)."""
+        self._rewarm |= set(names)
 
     # -- cache plumbing -------------------------------------------------
     def _sig(self):
@@ -110,9 +127,16 @@ class ServingEngine:
         payload = build()
         if payload is not None:
             self._packs[name] = (key, payload)
+        # settle the re-warm debt either way: one failed build means
+        # this model can't serve the pack (e.g. a restored categorical
+        # model), and re-attempting the O(trees) eligibility scan on
+        # every small-batch predict would be worse than the cold gate
+        self._rewarm.discard(name)
         return payload
 
     def _warm(self, name: str) -> bool:
+        if name in self._rewarm:
+            return True
         hit = self._packs.get(name)
         return hit is not None and hit[0] == self._sig()
 

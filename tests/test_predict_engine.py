@@ -274,3 +274,115 @@ def test_contrib_small_batch_host_fallback_matches(mc_model):
     bst.predict(X[:400], pred_contrib=True)         # warm the engine
     warm = bst.predict(tiny, pred_contrib=True)     # device path
     np.testing.assert_allclose(cold, warm, rtol=0, atol=1e-10)
+
+# ---------------------------------------------------------------------------
+# predict_leaf_index start/num_iteration (PR-3 API, first covered here):
+# slicing parity on device AND host paths, plus the past-the-end edge
+# ---------------------------------------------------------------------------
+def test_pred_leaf_slicing_matrix_multiclass(mc_model):
+    """K=3: sliced leaf indices equal the matching K-interleaved column
+    block of the full matrix for every (start, num) combination."""
+    bst, X = mc_model
+    g = bst._gbdt
+    K = g.num_tree_per_iteration
+    total = len(g.models) // K
+    full = bst.predict(X, pred_leaf=True)
+    assert full.shape == (len(X), total * K)
+    for s, m in [(0, 2), (1, 3), (2, -1), (4, 1), (0, 100)]:
+        end = total if m < 0 else min(total, s + m)
+        sl = bst.predict(X, pred_leaf=True, start_iteration=s,
+                         num_iteration=m)
+        np.testing.assert_array_equal(sl, full[:, s * K:end * K])
+
+
+def test_pred_leaf_slicing_host_path_parity(reg_model, monkeypatch):
+    """The host fallback must slice identically to the device engine."""
+    bst, X = reg_model
+    g = bst._gbdt
+    dev = bst.predict(X, pred_leaf=True, start_iteration=3,
+                      num_iteration=4)
+    monkeypatch.setattr(g.serving, "leaves_insession",
+                        lambda *a, **k: None)
+    monkeypatch.setattr(g.serving, "leaves_loaded",
+                        lambda *a, **k: None)
+    host = bst.predict(X, pred_leaf=True, start_iteration=3,
+                       num_iteration=4)
+    np.testing.assert_array_equal(dev, host)
+
+
+def test_pred_leaf_past_the_end_is_empty(reg_model):
+    """start_iteration past the model end yields an empty (n, 0)
+    matrix like the other pred kinds, not a crash (and the same on the
+    host fallback path)."""
+    bst, X = reg_model
+    g = bst._gbdt
+    total = len(g.models) // g.num_tree_per_iteration
+    out = bst.predict(X[:64], pred_leaf=True, start_iteration=total + 5,
+                      num_iteration=3)
+    assert out.shape == (64, 0)
+    out2 = bst.predict(X[:64], pred_leaf=True, start_iteration=total,
+                       num_iteration=-1)
+    assert out2.shape == (64, 0)
+    # zero-width interior slice too
+    out3 = bst.predict(X[:64], pred_leaf=True, start_iteration=2,
+                       num_iteration=0)
+    # num_iteration=0 means "all remaining" (reference c_api semantics)
+    assert out3.shape == (64, total - 2)
+
+
+# ---------------------------------------------------------------------------
+# pickle / deepcopy round trip: the restored engine re-warms LAZILY on
+# the first predict — exactly one compile per (kind, bucket), never a
+# crash or a per-call cold trace (PR-3 handoff note)
+# ---------------------------------------------------------------------------
+def test_pickle_round_trip_one_compile_post_restore(reg_model):
+    import pickle
+    bst, X = reg_model
+    bst.predict(X, raw_score=True)        # ensure the engine is warm
+    ref = bst.predict(X[:300], raw_score=True)
+    bst2 = pickle.loads(pickle.dumps(bst))
+    eng2 = bst2._gbdt.serving
+    assert eng2.trace_counts == {}, "restored engine must start untraced"
+    # SMALL batch: the re-warm hint must bypass the cold-row gate so
+    # the device path engages immediately
+    p1 = bst2.predict(X[:300], raw_score=True)
+    p2 = bst2.predict(X[:300], raw_score=True)
+    np.testing.assert_allclose(p1, ref, rtol=1e-6, atol=1e-6)
+    np.testing.assert_array_equal(p1, p2)
+    traced = dict(eng2.trace_counts)
+    assert traced, "device serving must engage on the first predict"
+    assert all(v == 1 for v in traced.values()), traced
+    # same bucket again: served from the SAME compiled program
+    bst2.predict(X[:290], raw_score=True)
+    assert dict(eng2.trace_counts) == traced, "cold-traced per call"
+    # second-generation pickle: names not yet re-packed must STAY
+    # pending (union of live packs and owed re-warms, not a fallback)
+    eng3 = pickle.loads(pickle.dumps(bst2))._gbdt.serving
+    assert "contrib" in eng3._rewarm and "loaded" in eng3._rewarm, \
+        eng3._rewarm
+
+
+def test_pickle_never_warmed_keeps_cold_gating():
+    """A booster whose engine never warmed must not pay the pack cost
+    for tiny batches after unpickling (the re-warm hint is only set
+    when the original was actually serving)."""
+    import pickle
+    rng = np.random.RandomState(17)
+    X = rng.normal(size=(500, 5))
+    y = X[:, 0] + 0.1 * rng.normal(size=500)
+    bst = lgb.train(dict(BASE, objective="regression", num_leaves=7),
+                    lgb.Dataset(X, label=y), num_boost_round=3)
+    bst._gbdt._flush_pending()
+    bst2 = pickle.loads(pickle.dumps(bst))
+    bst2.predict(X[:32])
+    assert bst2._gbdt.serving.trace_counts == {}, \
+        "tiny batch on a never-warm copy must stay on the host path"
+
+
+def test_deepcopy_round_trip_predicts(reg_model):
+    import copy
+    bst, X = reg_model
+    ref = bst.predict(X[:100])
+    clone = copy.deepcopy(bst)
+    np.testing.assert_allclose(clone.predict(X[:100]), ref,
+                               rtol=1e-6, atol=1e-6)

@@ -1,0 +1,144 @@
+"""jaxlint CLI — static analysis + compile-artifact guards.
+
+    python tools/jaxlint.py                  # human-readable report
+    python tools/jaxlint.py --check          # exit 1 on any non-baseline
+                                             # finding / budget breach /
+                                             # stale baseline entry
+    python tools/jaxlint.py --json           # one JSON line per finding,
+                                             # budget metric and problem
+    python tools/jaxlint.py --tier a         # AST lint only (fast)
+    python tools/jaxlint.py --tier b         # artifact budgets only
+    python tools/jaxlint.py --update-baseline  # rewrite the ratchet
+
+Tier A findings and Tier B budgets are compared against the committed
+``jaxlint_baseline.json`` (see lightgbm_tpu/analysis/baseline.py for
+the ratchet rules).  Tier B compiles the designated entry points on the
+current backend, so run it with ``JAX_PLATFORMS=cpu`` for the
+tier-1-equivalent numbers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def _load_standalone(modname: str, relpath: str):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        modname, os.path.join(REPO_ROOT, relpath))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[modname] = mod      # dataclasses resolves __module__
+    spec.loader.exec_module(mod)
+    return mod
+
+# HLO budget headroom for legitimate toolchain drift (mirrors
+# tests/test_hlo_guard.py's ~50% ceilings); invariant metrics (pinned
+# at 0/1 exact) never get headroom — baseline.make skips zero values.
+TIER_B_HEADROOM = {
+    "while_body.default": {"total_ops": 60, "fusions": 30, "copies": 8},
+    "while_body.mega": {"copies": 8},
+    # serving.transfers gets NO headroom on purpose: zero entry copies
+    # / transfers / callbacks in the serving program is an invariant,
+    # not a drifting count
+    "shap.kernel": {"entry_copies": 6},
+}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--check", action="store_true",
+                    help="exit non-zero on any non-baseline finding")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="one machine-readable JSON line per finding")
+    ap.add_argument("--tier", choices=("a", "b", "all"), default="all")
+    ap.add_argument("--update-baseline", action="store_true")
+    ap.add_argument("--root", default=REPO_ROOT)
+    ap.add_argument("--baseline", default=None,
+                    help="baseline path (default: <root>/jaxlint_baseline.json)")
+    args = ap.parse_args(argv)
+
+    if args.tier == "a":
+        # Tier A is pure stdlib: load the lint modules straight from
+        # their files so a lint-only run (CI fast lane, pre-commit)
+        # never pays the package's jax import
+        astlint = _load_standalone("jaxlint_astlint",
+                                   "lightgbm_tpu/analysis/astlint.py")
+        baseline = _load_standalone("jaxlint_baseline_mod",
+                                    "lightgbm_tpu/analysis/baseline.py")
+    else:
+        from lightgbm_tpu.analysis import astlint, baseline
+
+    bl_path = args.baseline or os.path.join(args.root,
+                                            baseline.DEFAULT_BASELINE)
+    bl = baseline.load(bl_path)
+    problems = []
+    findings = []
+    counts = {}
+    tier_b = {}
+
+    if args.tier in ("a", "all"):
+        findings = astlint.lint_tree(args.root)
+        counts = astlint.finding_counts(findings)
+        problems += baseline.compare_tier_a(counts, bl)
+
+    if args.tier in ("b", "all"):
+        from lightgbm_tpu.analysis import artifacts
+        tier_b = artifacts.collect_tier_b()
+        problems += baseline.compare_tier_b(tier_b, bl)
+
+    if args.update_baseline:
+        if args.tier != "all":
+            print("--update-baseline needs --tier all (the baseline "
+                  "document covers both tiers)", file=sys.stderr)
+            return 2
+        baseline.save(bl_path, baseline.make(counts, tier_b,
+                                             headroom=TIER_B_HEADROOM))
+        print(f"wrote {bl_path}")
+        return 0
+
+    if args.as_json:
+        for f in findings:
+            print(f.to_json())
+        for check, metrics in sorted(tier_b.items()):
+            budgets = bl.get("tier_b", {}).get(check, {})
+            for metric, value in sorted(metrics.items()):
+                import json as _json
+                print(_json.dumps({"tier": "B", "check": check,
+                                   "metric": metric, "value": value,
+                                   "budget": budgets.get(metric)},
+                                  sort_keys=True))
+        for p in problems:
+            print(p.to_json())
+    else:
+        if findings:
+            print(f"-- tier A: {len(findings)} finding(s) "
+                  f"({len(counts)} key(s); baselined keys are OK)")
+            for f in findings:
+                print("  " + f.render())
+        if tier_b:
+            print("-- tier B artifact budgets")
+            for check, metrics in sorted(tier_b.items()):
+                budgets = bl.get("tier_b", {}).get(check, {})
+                row = ", ".join(
+                    f"{m}={v}/{budgets.get(m, '?')}"
+                    for m, v in sorted(metrics.items()))
+                print(f"  {check}: {row}   (measured/budget)")
+        if problems:
+            print(f"-- {len(problems)} problem(s) vs {bl_path}")
+            for p in problems:
+                print("  " + p.render())
+        else:
+            print(f"-- clean vs {bl_path}")
+
+    if args.check and problems:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
