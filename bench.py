@@ -80,7 +80,9 @@ def _train_blocks(lgb, rows, iters, repeats):
     if os.environ.get("BENCH_CHUNK"):
         params["tpu_row_chunk"] = int(os.environ["BENCH_CHUNK"])
     ds = lgb.Dataset(X, label=y)
+    t0 = time.time()
     ds.construct(params)
+    construct_s = time.time() - t0
 
     import jax.numpy as jnp
 
@@ -112,7 +114,7 @@ def _train_blocks(lgb, rows, iters, repeats):
             bst.update()
         sync()
         blocks.append((time.time() - t0) / iters)
-    return blocks, warm
+    return blocks, warm, construct_s
 
 
 def _real_data_accuracy():
@@ -352,7 +354,7 @@ def main():
               file=sys.stderr)
 
     tunnel = _dispatch_probe()
-    blocks, warm = _train_blocks(lgb, ROWS, ITERS, REPEATS)
+    blocks, warm, construct_s = _train_blocks(lgb, ROWS, ITERS, REPEATS)
     per_iter = float(np.median(blocks))
 
     mad = float(np.median(np.abs(np.asarray(blocks) - per_iter)))
@@ -364,6 +366,10 @@ def main():
         "spread_pct": round(100.0 * (max(blocks) - min(blocks))
                             / per_iter, 1),
         "warmup_compile_s": round(warm, 2),
+        # dataset construction wall-clock (binning + EFB + device
+        # ingest; ops/construct.py — see tools/profile_construct.py for
+        # the per-stage host-loop/vectorized/device breakdown)
+        "construct_s": round(construct_s, 2),
         "baseline_higgs_500iter_s": BASELINE_WALL_S,
         "per_iter_s": {str(ROWS): round(per_iter, 4)},
         "tunnel": tunnel,
@@ -406,7 +412,7 @@ def main():
 
     if ROWS2 and ROWS2 != ROWS:
         # affine-fit diagnostic from a second, smaller row count
-        blocks2, _ = _train_blocks(lgb, ROWS2, max(ITERS, 20), 1)
+        blocks2, _, _ = _train_blocks(lgb, ROWS2, max(ITERS, 20), 1)
         per_iter2 = float(np.median(blocks2))
         detail["per_iter_s"][str(ROWS2)] = round(per_iter2, 4)
         slope = (per_iter - per_iter2) / (ROWS - ROWS2)

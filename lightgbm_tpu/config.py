@@ -181,6 +181,17 @@ _PARAMS: List[_Param] = [
        ("cat_feature", "categorical_column", "cat_column", "categorical_features")),
     _p("forcedbins_filename", "", str),
     _p("save_binary", False, bool, ("is_save_binary", "is_save_binary_file")),
+    # dataset construction path (ops/construct.py): "off" = the original
+    # per-feature host loops (the oracle); "auto" = vectorized host
+    # construction (one batched searchsorted over all features, matmul
+    # EFB conflict counts) + direct-to-device (G, N_pad) ingest for
+    # training datasets; "on" = auto, plus the host binned matrix is
+    # not materialized (recoverable from the device buffer on demand)
+    _p("construct_device", "auto", str),
+    # free the host binned matrix once the device ingest buffer holds
+    # the data — the free_raw_data analog for the packed bin matrix (a
+    # raw float copy is only retained under linear_tree, which keeps it)
+    _p("free_host_binned", False, bool),
     _p("precise_float_parser", False, bool),
     _p("parser_config_file", "", str),
     # --- Predict ---

@@ -15,6 +15,8 @@ columns with bin offsets.
 
 from __future__ import annotations
 
+import itertools
+import os
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -23,6 +25,29 @@ from .config import Config
 from .ops.binning import (BIN_CATEGORICAL, BIN_NUMERICAL, MISSING_NAN,
                           MISSING_NONE, MISSING_ZERO, BinMapper)
 from .utils import log
+
+
+def _fill_rows_t(dst: np.ndarray, start: int, packed_cols: np.ndarray
+                 ) -> None:
+    """``dst[start:start+rows] = packed_cols.T`` in cache-sized blocks:
+    the naive full transpose-assign streams the whole strided source
+    per destination row; 8k-row blocks keep the working set (~G x 8k)
+    L2-resident."""
+    rows = packed_cols.shape[1]
+    blk = 8192
+    for s in range(0, rows, blk):
+        e = min(s + blk, rows)
+        dst[start + s:start + e] = packed_cols[:, s:e].T
+
+
+def _construct_workers(config) -> int:
+    """Host threads for the vectorized construction path: the explicit
+    ``num_threads`` param when set, else one per core.  The parallel
+    sections are GIL-releasing numpy (searchsorted, copies, sorts), so
+    plain threads scale them without changing any result — work is
+    split per-feature / per-chunk and merged in deterministic order."""
+    nt = int(getattr(config, "num_threads", 0) or 0)
+    return nt if nt > 0 else max(1, os.cpu_count() or 1)
 
 
 class Metadata:
@@ -131,6 +156,45 @@ class BinnedDataset:
         self.monotone_constraints: Optional[List[int]] = None
         self.raw_data: Optional[np.ndarray] = None   # retained for linear trees
         self._device_cache: Dict[str, Any] = {}
+        # construction path (ops/construct.py, construct_device param):
+        # _vec = vectorized bin-finding/binning, _ingest_ok = stream the
+        # packed chunks into the learner's (G, N_pad) device layout,
+        # _keep_host = materialize the row-major host binned matrix
+        self._vec: bool = False
+        self._ingest_ok: bool = False
+        self._keep_host: bool = True
+        self._batched = None                         # cached BatchedMapper
+        self.device_ingest = None                    # ops.construct.DeviceIngest
+
+    # jitted device buffers and the padded mapper tables are neither
+    # picklable nor worth shipping; a host-binned-free dataset
+    # materializes its matrix back first so no data is lost
+    def __getstate__(self):
+        st = dict(self.__dict__)
+        if st.get("binned") is None and st.get("device_ingest") is not None:
+            st["binned"] = self.device_ingest.host_binned()
+        st["device_ingest"] = None
+        st["_batched"] = None
+        return st
+
+    def batched_mapper(self):
+        """The padded-table batched values->bins mapper over all used
+        features (built once, reused by binning / bin_matrix)."""
+        if self._batched is None:
+            from .ops.construct import BatchedMapper
+            self._batched = BatchedMapper(self.bin_mappers,
+                                          self.used_features)
+        return self._batched
+
+    def host_binned(self) -> Optional[np.ndarray]:
+        """The row-major (num_data, num_groups) host bin matrix,
+        materialized from the device ingest buffer when the host copy
+        was freed (construct_device=on / free_host_binned)."""
+        if self.binned is not None:
+            return self.binned
+        if self.device_ingest is not None:
+            return self.device_ingest.host_binned()
+        return None
 
     # -- construction ---------------------------------------------------
     @staticmethod
@@ -144,6 +208,7 @@ class BinnedDataset:
         if data.ndim != 2:
             log.fatal("Data must be 2-dimensional")
         ds = BinnedDataset(config)
+        ds._resolve_construct_mode(is_reference=reference is not None)
         ds.num_data, ds.num_total_features = data.shape
         ds.feature_names = feature_names or [
             f"Column_{i}" for i in range(ds.num_total_features)]
@@ -198,6 +263,7 @@ class BinnedDataset:
         probe = np.asarray(first_nonempty[0:1], dtype=np.float64)
         F = probe.reshape(1, -1).shape[1]
         ds = BinnedDataset(config)
+        ds._resolve_construct_mode(is_reference=reference is not None)
         ds.num_data = total
         ds.num_total_features = F
         ds.feature_names = feature_names or [f"Column_{i}" for i in range(F)]
@@ -247,18 +313,34 @@ class BinnedDataset:
             # resolve any pending sparse bundling with the SAMPLE columns
             # (skip the binning pass entirely when nothing is pending)
             if getattr(ds, "_pending_sparse", None):
-                sample_cols = {
-                    f: ds.bin_mappers[f].values_to_bins(sample[:, f])
-                    for f in ds.used_features}
+                if ds._vec and ds.used_features:
+                    smat = ds.batched_mapper().map_chunk(
+                        sample[:, ds.used_features])
+                    sample_cols = {f: np.asarray(smat[:, i]) for i, f
+                                   in enumerate(ds.used_features)}
+                else:
+                    sample_cols = {
+                        f: ds.bin_mappers[f].values_to_bins(sample[:, f])
+                        for f in ds.used_features}
                 ds._finalize_groups(sample_cols)
             else:
                 ds._finalize_groups({})
 
-        # stream: bin each chunk and pack into the preallocated matrix
+        # stream: bin each chunk, pack, and push it into the host matrix
+        # and/or the device ingest buffer — chunk boundaries never change
+        # the result (the mapping is per-row; tests/test_construct_device
+        # straddles sequence boundaries to prove it)
         dtype = ds._bin_dtype()
-        out = np.zeros((total, len(ds.groups)), dtype=dtype)
+        ingest = ds._make_ingest(dtype)
+        keep = ds._keep_host and not (
+            ingest is not None
+            and bool(getattr(config, "free_host_binned", False)))
+        out = (np.zeros((total, len(ds.groups)), dtype=dtype)
+               if keep or ingest is None else None)
         raw = (np.zeros((total, F), dtype=np.float32)
                if config.linear_tree else None)
+        bmap = ds.batched_mapper() if (ds._vec and ds.used_features) \
+            else None
         row = 0
         for s in seqs:
             bs = getattr(s, "batch_size", 4096) or 4096
@@ -266,16 +348,49 @@ class BinnedDataset:
                 chunk = np.asarray(s[startr:startr + bs], dtype=np.float64)
                 if chunk.ndim == 1:
                     chunk = chunk.reshape(1, -1)
-                cols = {f: ds.bin_mappers[f].values_to_bins(chunk[:, f])
-                        for f in ds.used_features}
-                out[row:row + len(chunk)] = ds._pack_groups(
-                    cols, len(chunk)).astype(dtype)
+                if bmap is not None:
+                    mat = bmap.map_chunk(chunk[:, ds.used_features])
+                    cols = {f: np.asarray(mat[:, i]) for i, f
+                            in enumerate(ds.used_features)}
+                else:
+                    cols = {f: ds.bin_mappers[f].values_to_bins(chunk[:, f])
+                            for f in ds.used_features}
+                packed = ds._pack_groups(cols, len(chunk), dtype)
+                if out is not None:
+                    out[row:row + len(chunk)] = packed
+                if ingest is not None:
+                    ingest.push(packed)
                 if raw is not None:
                     raw[row:row + len(chunk)] = chunk.astype(np.float32)
                 row += len(chunk)
         ds.binned = out
+        if ingest is not None:
+            ingest.finish()
+            ds.device_ingest = ingest
         ds.raw_data = raw
         return ds
+
+    def _resolve_construct_mode(self, is_reference: bool) -> None:
+        """Pick the construction path for this dataset from
+        ``construct_device`` (see ops/construct.py resolve_mode)."""
+        from .parallel import network as _net
+        from .ops.construct import resolve_mode
+        self._vec, self._ingest_ok, self._keep_host = resolve_mode(
+            self.config, is_reference, _net.num_machines() > 1)
+
+    def _make_ingest(self, dtype):
+        """A DeviceIngest streaming target for this dataset's geometry,
+        or None when the device path is unavailable."""
+        if not self._ingest_ok:
+            return None
+        try:
+            from .ops.construct import DeviceIngest
+            return DeviceIngest(len(self.groups), self.num_data, dtype,
+                                int(self.config.tpu_row_chunk))
+        except Exception as exc:
+            log.warning("device ingest unavailable (%s); keeping the "
+                        "host binned matrix", str(exc).split("\n")[0][:120])
+            return None
 
     def _construct_mappers_from_sample(self, sample: np.ndarray,
                                        categorical_features) -> None:
@@ -329,28 +444,78 @@ class BinnedDataset:
         nmach = _net.num_machines()
         my_rank = _net.rank() if nmach > 1 else 0
         self._distributed = nmach > 1
-        self.bin_mappers = []
-        for f in range(self.num_total_features):
-            if self._distributed and (f % nmach) != my_rank:
-                self.bin_mappers.append(None)
-                continue
-            col = np.asarray(data[sample_idx, f], dtype=np.float64)
-            # mirror the reference's sparse sampling: non-zero values + implied zeros
-            nonzero = col[(np.abs(col) > 1e-35) | np.isnan(col)]
-            bm = BinMapper()
-            mb = cfg.max_bin
+        my_feats = [f for f in range(self.num_total_features)
+                    if not self._distributed or (f % nmach) == my_rank]
+
+        def _mb(f):
             if max_bin_by_feature and f < len(max_bin_by_feature):
-                mb = max_bin_by_feature[f]
-            bm.find_bin(
-                nonzero, total_sample_cnt=len(col), max_bin=mb,
-                min_data_in_bin=cfg.min_data_in_bin,
-                min_split_data=filter_cnt,
-                pre_filter=cfg.feature_pre_filter,
-                bin_type=BIN_CATEGORICAL if f in cat_set else BIN_NUMERICAL,
-                use_missing=cfg.use_missing,
-                zero_as_missing=cfg.zero_as_missing,
-                forced_upper_bounds=forced_bounds.get(f))
-            self.bin_mappers.append(bm)
+                return max_bin_by_feature[f]
+            return cfg.max_bin
+
+        self.bin_mappers = [None] * self.num_total_features
+        if self._vec and my_feats:
+            # vectorized bin finding (ops/construct.py): ONE column-wise
+            # sort of the whole (sample_cnt, F) matrix replaces F stable
+            # argsorts; the per-feature non-zero/NaN filtering becomes
+            # two index ranges of the sorted column
+            from .ops.construct import find_bin_sorted, sorted_sample_columns
+            rows = (data if len(sample_idx) == len(data)
+                    else data[sample_idx])
+            sub = np.asarray(
+                rows if my_feats == list(range(data.shape[1]))
+                else rows[:, my_feats], dtype=np.float64)
+            info = sorted_sample_columns(
+                sub, workers=_construct_workers(cfg))
+            sv = info["sorted"]
+
+            def _find_one(j: int) -> "BinMapper":
+                f = my_feats[j]
+                lo, hi, m = (info["lo"][j], info["hi"][j],
+                             info["non_nan"][j])
+                nz_sorted = np.concatenate([sv[:lo, j], sv[hi:m, j]])
+                return find_bin_sorted(
+                    nz_sorted, na_cnt=int(info["nan_cnt"][j]),
+                    total_sample_cnt=sample_cnt, max_bin=_mb(f),
+                    min_data_in_bin=cfg.min_data_in_bin,
+                    min_split_data=filter_cnt,
+                    pre_filter=cfg.feature_pre_filter,
+                    bin_type=(BIN_CATEGORICAL if f in cat_set
+                              else BIN_NUMERICAL),
+                    use_missing=cfg.use_missing,
+                    zero_as_missing=cfg.zero_as_missing,
+                    forced_upper_bounds=forced_bounds.get(f))
+
+            workers = _construct_workers(cfg)
+            if workers > 1 and len(my_feats) > 1:
+                # per-feature bin finding is independent; the numpy
+                # parts (concatenate, cumsum, searchsorted) release the
+                # GIL, and results land by index — deterministic
+                from concurrent.futures import ThreadPoolExecutor
+                with ThreadPoolExecutor(max_workers=workers) as ex:
+                    found = list(ex.map(_find_one,
+                                        range(len(my_feats))))
+            else:
+                found = [_find_one(j) for j in range(len(my_feats))]
+            for j, f in enumerate(my_feats):
+                self.bin_mappers[f] = found[j]
+        else:
+            for f in my_feats:
+                col = np.asarray(data[sample_idx, f], dtype=np.float64)
+                # mirror the reference's sparse sampling: non-zero values
+                # + implied zeros
+                nonzero = col[(np.abs(col) > 1e-35) | np.isnan(col)]
+                bm = BinMapper()
+                bm.find_bin(
+                    nonzero, total_sample_cnt=len(col), max_bin=_mb(f),
+                    min_data_in_bin=cfg.min_data_in_bin,
+                    min_split_data=filter_cnt,
+                    pre_filter=cfg.feature_pre_filter,
+                    bin_type=(BIN_CATEGORICAL if f in cat_set
+                              else BIN_NUMERICAL),
+                    use_missing=cfg.use_missing,
+                    zero_as_missing=cfg.zero_as_missing,
+                    forced_upper_bounds=forced_bounds.get(f))
+                self.bin_mappers[f] = bm
         if self._distributed:
             from .parallel.distributed import allgather_bin_mappers
             local = {f: bm for f, bm in enumerate(self.bin_mappers)
@@ -429,14 +594,116 @@ class BinnedDataset:
                     [f], self.bin_mappers[f].num_bin, [0]))
 
     def _bin_data(self, data: np.ndarray) -> None:
-        # bin all used features column-wise first
+        if self._vec:
+            self._bin_data_vectorized(data)
+            return
+        # oracle: bin all used features column-wise first
         cols: Dict[int, np.ndarray] = {}
         for f in self.used_features:
             cols[f] = self.bin_mappers[f].values_to_bins(data[:, f])
         self._finalize_groups(cols)
 
-        self.binned = self._pack_groups(cols, self.num_data).astype(
-            self._bin_dtype())
+        self.binned = self._pack_groups(cols, self.num_data,
+                                        self._bin_dtype())
+
+    # rows per vectorized binning chunk: big enough to amortize the
+    # batched searchsorted, small enough that the packed chunk + its
+    # transpose stay cache/transfer friendly
+    CONSTRUCT_CHUNK = 1 << 16
+
+    def _bin_data_vectorized(self, data: np.ndarray) -> None:
+        """The batched construction path: groups are finalized from a
+        <=50k-row binned sample, then row chunks are mapped with ONE
+        vectorized searchsorted over all features, packed, and (for
+        training datasets) streamed straight into the learner's
+        transposed (G, N_pad) device layout — the full host binned
+        matrix only materializes when ``_keep_host`` asks for it."""
+        n = self.num_data
+        uf = self.used_features
+        bmap = self.batched_mapper() if uf else None
+        pending = getattr(self, "_pending_sparse", None)
+        if pending:
+            # identical rng consumption to the oracle's _bundle_sparse:
+            # one choice() for the conflict sample, then the probe draws
+            rng = np.random.RandomState(self.config.data_random_seed)
+            sample = (rng.choice(n, size=min(n, 50000), replace=False)
+                      if n > 50000 else np.arange(n))
+            smat = bmap.map_chunk(np.asarray(data[np.ix_(sample, uf)],
+                                             dtype=np.float64))
+            nz = {f: np.asarray(smat[:, i]
+                                != self.bin_mappers[f].most_freq_bin)
+                  for i, f in enumerate(uf) if f in set(pending)}
+            self._bundle_greedy(pending, nz, rng)
+            self._pending_sparse = None
+        else:
+            self._finalize_groups({})
+
+        dtype = self._bin_dtype()
+        ingest = self._make_ingest(dtype)
+        keep = self._keep_host and not (
+            ingest is not None
+            and bool(getattr(self.config, "free_host_binned", False)))
+        out = (np.zeros((n, len(self.groups)), dtype=dtype)
+               if keep or ingest is None else None)
+        step = self.CONSTRUCT_CHUNK
+        # identity feature selection: the chunk is a contiguous row
+        # slice, no (rows, F) fancy-index copy needed
+        uf_all = uf == list(range(data.shape[1]))
+
+        def _map_pack(start: int) -> np.ndarray:
+            """One chunk, feature-major end to end: (F, rows) bins ->
+            (G, rows) packed — the ingest buffer's native orientation,
+            so no stage writes a strided column."""
+            stop = min(start + step, n)
+            rows = stop - start
+            if uf:
+                sl = data[start:stop]
+                sub = sl if uf_all else sl[:, uf]
+                matT = bmap.map_chunk_T(np.asarray(sub,
+                                                   dtype=np.float64))
+                cols = {f: matT[i] for i, f in enumerate(uf)}
+            else:
+                cols = {}
+            packed = self._pack_groups_T(cols, rows, dtype)
+            if out is not None:
+                # disjoint row slices: safe (and faster) to fill from
+                # the worker that produced the chunk
+                _fill_rows_t(out, start, packed)
+            return packed
+
+        starts = [s for s in range(0, max(n, 1), step)
+                  if min(s + step, n) > s]
+        workers = _construct_workers(self.config)
+        if workers > 1 and len(starts) > 1:
+            # overlap chunk k+1's map+pack (GIL-releasing numpy:
+            # searchsorted, copies) with chunk k's ordered device push —
+            # results are consumed in submission order, so the binned
+            # matrix and the ingest stream are bit-identical to the
+            # sequential loop
+            from concurrent.futures import ThreadPoolExecutor
+            from collections import deque
+            with ThreadPoolExecutor(max_workers=workers) as ex:
+                pend: deque = deque()
+                it = iter(starts)
+                for s in itertools.islice(it, workers + 1):
+                    pend.append((s, ex.submit(_map_pack, s)))
+                while pend:
+                    start, fut = pend.popleft()
+                    packed = fut.result()
+                    nxt = next(it, None)
+                    if nxt is not None:
+                        pend.append((nxt, ex.submit(_map_pack, nxt)))
+                    if ingest is not None:
+                        ingest.push_t(packed)
+        else:
+            for start in starts:
+                packed = _map_pack(start)
+                if ingest is not None:
+                    ingest.push_t(packed)
+        self.binned = out
+        if ingest is not None:
+            ingest.finish()
+            self.device_ingest = ingest
 
     def _bin_dtype(self):
         max_bin_overall = max((grp.num_total_bin for grp in self.groups),
@@ -458,18 +725,32 @@ class BinnedDataset:
         feature is EFB-bundled (the caller checks)."""
         data = np.asarray(data)
         from .ops.binning import BIN_CATEGORICAL
-        cols = {f: self.bin_mappers[f].values_to_bins(
-                    data[:, f],
-                    oov_sentinel=(cat_oov_sentinel and
-                                  self.bin_mappers[f].bin_type
-                                  == BIN_CATEGORICAL))
-                for f in self.used_features}
-        return self._pack_groups(cols, data.shape[0]).astype(
-            self._bin_dtype())
+        if self._vec and self.used_features:
+            # one batched mapping over all features (the serving hot
+            # path binning); oov_sentinel applies to categorical
+            # columns only, like the per-feature oracle below
+            mat = self.batched_mapper().map_chunk(
+                np.asarray(data[:, self.used_features], dtype=np.float64),
+                oov_sentinel=cat_oov_sentinel)
+            cols = {f: np.asarray(mat[:, i])
+                    for i, f in enumerate(self.used_features)}
+        else:
+            cols = {f: self.bin_mappers[f].values_to_bins(
+                        data[:, f],
+                        oov_sentinel=(cat_oov_sentinel and
+                                      self.bin_mappers[f].bin_type
+                                      == BIN_CATEGORICAL))
+                    for f in self.used_features}
+        return self._pack_groups(cols, data.shape[0],
+                                 self._bin_dtype())
 
-    def _pack_groups(self, cols: Dict[int, np.ndarray], n: int) -> np.ndarray:
-        """Pack per-feature bin columns into the (n, num_groups) matrix."""
-        out = np.zeros((n, len(self.groups)), dtype=np.int32)
+    def _pack_groups(self, cols: Dict[int, np.ndarray], n: int,
+                     out_dtype=np.int32) -> np.ndarray:
+        """Pack per-feature bin columns into the (n, num_groups) matrix.
+        ``out_dtype`` lets callers pack straight into the bin dtype —
+        the column assignments C-cast exactly like the ``.astype`` the
+        callers used to do, minus one full-matrix pass."""
+        out = np.zeros((n, len(self.groups)), dtype=out_dtype)
         for g, grp in enumerate(self.groups):
             if len(grp.feature_indices) == 1:
                 out[:, g] = cols[grp.feature_indices[0]]
@@ -478,13 +759,41 @@ class BinnedDataset:
                 acc = np.zeros(n, dtype=np.int32)
                 for sub, f in enumerate(grp.feature_indices):
                     bm = self.bin_mappers[f]
-                    c = cols[f]
+                    # cols may arrive uint8 (map_chunk_T); the offset
+                    # arithmetic below needs a wide dtype
+                    c = np.asarray(cols[f], dtype=np.int32)
                     offset = grp.bin_offsets[sub]
                     nz = c != bm.most_freq_bin
                     # conflicts resolved last-writer-wins like reference push order
                     shifted = c + offset - (1 if bm.most_freq_bin == 0 else 0)
                     acc = np.where(nz, shifted, acc)
                 out[:, g] = acc
+        return out
+
+    def _pack_groups_T(self, cols: Dict[int, np.ndarray], n: int,
+                       out_dtype=np.int32) -> np.ndarray:
+        """Feature-major twin of ``_pack_groups``: (G, n) packed matrix
+        from per-feature bin ROWS — every read and write is contiguous,
+        and the result is the device ingest buffer's native orientation.
+        Same offset/last-writer-wins arithmetic, so ``out.T`` is
+        bit-identical to ``_pack_groups``'s output."""
+        out = np.zeros((len(self.groups), n), dtype=out_dtype)
+        for g, grp in enumerate(self.groups):
+            if len(grp.feature_indices) == 1:
+                out[g] = cols[grp.feature_indices[0]]
+            else:
+                acc = np.zeros(n, dtype=np.int32)
+                for sub, f in enumerate(grp.feature_indices):
+                    bm = self.bin_mappers[f]
+                    # cols may arrive uint8 (map_chunk_T); the offset
+                    # arithmetic below needs a wide dtype
+                    c = np.asarray(cols[f], dtype=np.int32)
+                    offset = grp.bin_offsets[sub]
+                    nz = c != bm.most_freq_bin
+                    shifted = c + offset - (1 if bm.most_freq_bin == 0
+                                            else 0)
+                    acc = np.where(nz, shifted, acc)
+                out[g] = acc
         return out
 
     def _bundle_sparse(self, sparse: List[int], cols: Dict[int, np.ndarray]) -> None:
@@ -494,17 +803,36 @@ class BinnedDataset:
         passes SAMPLE columns), so row indices are drawn over the columns'
         actual length."""
         n = len(next(iter(cols.values()))) if cols else 0
-        max_conflict = int(0.0 * n)  # reference default max_conflict_rate = 0.0
         # sample rows for conflict counting to bound cost
         rng = np.random.RandomState(self.config.data_random_seed)
         sample = rng.choice(
             n, size=min(n, 50000), replace=False) if n > 50000 else np.arange(n)
         nz_masks = {f: (cols[f][sample] != self.bin_mappers[f].most_freq_bin)
                     for f in sparse}
+        self._bundle_greedy(sparse, nz_masks, rng)
+
+    def _bundle_greedy(self, sparse: List[int],
+                       nz_masks: Dict[int, np.ndarray], rng) -> None:
+        """The greedy coloring over conflict counts.  With the reference
+        max_conflict_rate = 0.0 a feature may join a bundle iff it has
+        ZERO pairwise overlap with every member, so on the vectorized
+        path the per-(feature, bundle) union-mask AND loop collapses to
+        lookups in ONE (F_sparse, F_sparse) nonzero-mask matmul
+        (ops/construct.py conflict_matrix) — bit-identical bundles,
+        asserted by tests/test_construct_device.py."""
+        max_conflict = 0  # int(max_conflict_rate * n) with rate = 0.0
+        pair = None
+        fpos = {f: i for i, f in enumerate(sparse)}
+        if self._vec and sparse:
+            from .ops.construct import conflict_matrix
+            pair = conflict_matrix(np.stack([nz_masks[f] for f in sparse]))
+            counts = {f: int(pair[fpos[f], fpos[f]]) for f in sparse}
+        else:
+            counts = {f: int(nz_masks[f].sum()) for f in sparse}
         bundles: List[List[int]] = []
-        bundle_masks: List[np.ndarray] = []
+        bundle_masks: List[Optional[np.ndarray]] = []
         bundle_bins: List[int] = []
-        order = sorted(sparse, key=lambda f: -int(nz_masks[f].sum()))
+        order = sorted(sparse, key=lambda f: -counts[f])
         # reference FindGroups' random-search fallback (dataset.cpp:92):
         # with many groups, each feature probes a random subset instead
         # of every group, bounding the O(F x groups) conflict scan
@@ -524,16 +852,25 @@ class BinnedDataset:
             for bi in probe:
                 if bundle_bins[bi] + nb_add > max_group_bins:
                     continue
-                conflict = int((bundle_masks[bi] & nz_masks[f]).sum())
+                if pair is not None:
+                    # zero overlap with the union mask == zero pairwise
+                    # overlap with every member (counts are non-negative)
+                    row = pair[fpos[f]]
+                    conflict = int(max((int(row[fpos[g]])
+                                        for g in bundles[bi]), default=0))
+                else:
+                    conflict = int((bundle_masks[bi] & nz_masks[f]).sum())
                 if conflict <= max_conflict:
                     bundles[bi].append(f)
-                    bundle_masks[bi] |= nz_masks[f]
+                    if pair is None:
+                        bundle_masks[bi] |= nz_masks[f]
                     bundle_bins[bi] += nb_add
                     placed = True
                     break
             if not placed:
                 bundles.append([f])
-                bundle_masks.append(nz_masks[f].copy())
+                bundle_masks.append(None if pair is not None
+                                    else nz_masks[f].copy())
                 bundle_bins.append(1 + nb_add)
         for bundle in bundles:
             bundle.sort()
@@ -626,7 +963,8 @@ class BinnedDataset:
                         "bin_offsets": g.bin_offsets}
                        for g in self.groups],
         }
-        arrays = {"binned": self.binned if self.binned is not None
+        host = self.host_binned()
+        arrays = {"binned": host if host is not None
                   else np.zeros((self.num_data, 0), np.uint8)}
         md = self.metadata
         if md is not None:
@@ -652,6 +990,10 @@ class BinnedDataset:
                 log.fatal("Unsupported binary dataset version: %s",
                           header.get("version"))
             ds = cls(config)
+            # re-binning is skipped, but the batched mapper still serves
+            # bin_matrix (the serving path) when the config allows it
+            ds._resolve_construct_mode(is_reference=False)
+            ds._ingest_ok = False
             ds.num_data = int(header["num_data"])
             ds.num_total_features = int(header["num_total_features"])
             ds.feature_names = list(header["feature_names"])

@@ -347,12 +347,23 @@ class SerialTreeLearner:
                             and np.array_equal(grp, np.arange(self.F)))
 
         # ---- row geometry ----
+        # a dataset built through the direct-to-device construction path
+        # (ops/construct.py DeviceIngest) may carry its packed bins ONLY
+        # in the transposed (G, N_pad) device buffer; the host matrix is
+        # then optional and recoverable on demand
+        self._ingest = (getattr(dataset, "device_ingest", None)
+                        if local_num_data is None else None)
         if local_num_data is None:
-            if dataset.binned is None:
+            if dataset.binned is None and self._ingest is None:
                 raise ValueError("dataset has no binned data")
             self.N = dataset.num_data
         else:
             self.N = local_num_data
+        host_bin_dtype = np.dtype(
+            dataset.binned.dtype if dataset.binned is not None
+            else (self._ingest.dtype if self._ingest is not None
+                  else np.uint8))
+        self._host_bin_dtype = host_bin_dtype
         self.row_chunk = min(int(config.tpu_row_chunk),
                              max(_pow2ceil(self.N), 256))
         if self.row_chunk & (self.row_chunk - 1):
@@ -381,9 +392,8 @@ class SerialTreeLearner:
             # Mosaic requires lane-aligned tile shapes; probe-compile on the
             # actual geometry and fall back to the XLA kernel on failure
             try:
-                bin_dtype = (dataset.binned.dtype
-                             if dataset.binned is not None else jnp.uint8)
-                tiny = jnp.zeros((self.G, self.row_chunk * 2), bin_dtype)
+                tiny = jnp.zeros((self.G, self.row_chunk * 2),
+                                 host_bin_dtype)
                 ghi0 = jnp.zeros((3, self.row_chunk * 2), jnp.float32)
                 jax.block_until_ready(leaf_hist_pallas(
                     tiny, ghi0[0], ghi0[1], jnp.int32(0),
@@ -413,8 +423,8 @@ class SerialTreeLearner:
             and self.cegb_lazy is None
             and parallel_mode == "serial"
             and self.F > 0
-            and dataset.binned is not None
-            and dataset.binned.dtype == np.uint8)
+            and (dataset.binned is not None or self._ingest is not None)
+            and host_bin_dtype == np.uint8)
         self._compact_radix = bool(getattr(config, "tpu_compact_radix",
                                            False))
         self._pb_rows = self.G
@@ -491,12 +501,27 @@ class SerialTreeLearner:
         # bagging/GOSS zero the out-of-bag gradients instead.
         self._part0 = None
         if local_num_data is None:
-            binned = np.ascontiguousarray(dataset.binned)
-            if binned.shape[1] < self.G:   # zero usable features
-                binned = np.zeros((binned.shape[0], self.G), binned.dtype)
-            pad = np.zeros((self._pb_rows, self.N_pad), binned.dtype)
-            pad[:self.G, C:C + self.N] = binned.T
-            self._part0 = jnp.asarray(pad)
+            ing = self._ingest
+            if (ing is not None and ing.N == self.N
+                    and ing.matches(self.row_chunk, self.N_pad,
+                                    host_bin_dtype)):
+                # construction already streamed the transposed layout to
+                # the device: no host transpose, no host pad copy
+                self._part0 = ing.part0(self._pb_rows)
+            else:
+                binned = dataset.binned
+                if binned is None and ing is not None:
+                    # geometry changed between construction and train
+                    # (e.g. a different tpu_row_chunk): recover the host
+                    # matrix once and rebuild through the oracle path
+                    binned = ing.host_binned()
+                binned = np.ascontiguousarray(binned)
+                if binned.shape[1] < self.G:   # zero usable features
+                    binned = np.zeros((binned.shape[0], self.G),
+                                      binned.dtype)
+                pad = np.zeros((self._pb_rows, self.N_pad), binned.dtype)
+                pad[:self.G, C:C + self.N] = binned.T
+                self._part0 = jnp.asarray(pad)
 
         # ---- scalars ----
         self.l1 = float(config.lambda_l1)
@@ -645,8 +670,9 @@ class SerialTreeLearner:
                          and not self.has_categorical
                          and self.cegb_lazy is None
                          and self.B <= 256
-                         and dataset.binned is not None
-                         and dataset.binned.dtype == np.uint8
+                         and (dataset.binned is not None
+                              or self._ingest is not None)
+                         and self._host_bin_dtype == np.uint8
                          # the in-context doubling probe hooks the
                          # per-split _hist_leaf calls, which the mega
                          # path does not make — measuring "hist" with

@@ -38,6 +38,7 @@ host paths, which remain the oracles.
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -73,12 +74,18 @@ class ServingEngine:
     # a COLD pack stack costs a host gather + device round trip that only
     # pays for itself on big batches; once warm, any size is served
     COLD_MIN_ROWS = 4096
+    # bounded LRU of per-range sub-packs: a start/num_iteration slice
+    # traverses ONLY its trees instead of the whole forest under a mask
+    # (the PERF.md round-7 trade-off), at one extra trace per distinct
+    # slice LENGTH (jit keys on the stacked shapes) and ~4 live slices
+    RANGE_CACHE = 4
 
     def __init__(self, gbdt):
         self.gbdt = gbdt
         self.trace_counts: Dict[Any, int] = {}   # (kind, bucket) -> traces
         self.call_counts: Dict[Any, int] = {}    # (kind, bucket) -> calls
         self._packs: Dict[str, Any] = {}         # name -> (key, payload)
+        self._range_packs: "OrderedDict[Any, Any]" = OrderedDict()
         self._fns: Dict[str, Any] = {}           # kind -> jitted callable
         # pack names to re-warm LAZILY on the first predict after a
         # pickle/deepcopy restore: the restored copy bypasses the
@@ -118,6 +125,7 @@ class ServingEngine:
         depends on this — pack keys embed the model version — but
         mutation paths call it so dead forests free their HBM."""
         self._packs.clear()
+        self._range_packs.clear()
 
     def _pack(self, name: str, build):
         key = self._sig()
@@ -271,18 +279,60 @@ class ServingEngine:
         m[start:end] = 1.0
         return jnp.asarray(m)
 
+    # -- per-range sub-packs --------------------------------------------
+    def _range_sub(self, name: str, pack, start: int, end: int, slice_k):
+        """A sub-pack holding ONLY trees [start, end) of ``pack`` so a
+        ``start/num_iteration`` slice traverses its own trees instead of
+        the whole forest under a mask (a 100-of-1000-trees slice used to
+        pay the full 1000-tree traversal — the PERF.md round-7 known
+        trade-off).  Sub-packs live in a bounded LRU (``RANGE_CACHE``
+        entries, stale model versions age out); the device slices cost
+        one gather each and one extra trace per distinct slice LENGTH
+        (the jit cache keys on the stacked tree-array shapes, so two
+        different same-length ranges share a trace)."""
+        T_k = pack["T_k"]
+        start, end = max(start, 0), min(end, T_k)
+        if start == 0 and end == T_k:
+            return pack
+        key = (name, self._sig(), start, end)
+        hit = self._range_packs.get(key)
+        if hit is None:
+            hit = dict(pack)
+            hit["per_k"] = [slice_k(pk, start, end)
+                            for pk in pack["per_k"]]
+            hit["T_k"] = end - start
+            self._range_packs[key] = hit
+            while len(self._range_packs) > self.RANGE_CACHE:
+                self._range_packs.popitem(last=False)
+        else:
+            self._range_packs.move_to_end(key)
+        return hit
+
+    @staticmethod
+    def _slice_insession(pk, start: int, end: int):
+        return {"nodes": jax.tree.map(lambda a: a[start:end],
+                                      pk["nodes"]),
+                "deltas": pk["deltas"][start:end]}
+
+    @staticmethod
+    def _slice_loaded(pk, start: int, end: int):
+        node, lv = pk
+        return (jax.tree.map(lambda a: a[start:end], node),
+                lv[start:end])
+
     def _ready_insession(self, data, start_iteration: int, end_iter: int,
                          min_rows: int, warm_name: str = "insession"):
         """Shared in-session prologue: range guard, eligibility,
         cold-row gating, pack fetch, row binning.  Returns
         (n, pack, binned) or None.
 
-        Note two deliberate scope decisions (vs the pre-engine code):
-        sliced ranges traverse the FULL packed forest under a tree mask
-        (cost scales with trees trained, not the slice — the price of
-        the one-trace-per-(kind, bucket) guarantee), and eligibility is
-        whole-model, so continued-training boosters whose loaded head
-        has no device arrays always use the host paths."""
+        Note a deliberate scope decision (vs the pre-engine code):
+        eligibility is whole-model, so continued-training boosters
+        whose loaded head has no device arrays always use the host
+        paths.  Sliced ranges are served from per-range sub-packs (see
+        ``_range_sub``) so traversal cost scales with the slice; only
+        early-stop keeps full-forest masks (its per-block ranges would
+        churn the bounded cache)."""
         if end_iter <= start_iteration or not self._insession_eligible():
             return None
         n = np.asarray(data).shape[0]
@@ -307,7 +357,9 @@ class ServingEngine:
             return None
         n, pack, binned = ready
         K = pack["K"]
-        mask = self._tree_mask(pack["T_k"], start_iteration, end_iter)
+        sub = self._range_sub("insession", pack, start_iteration,
+                              end_iter, self._slice_insession)
+        mask = self._tree_mask(sub["T_k"], 0, sub["T_k"])
         fn = self._fn("raw")
 
         def run(b):
@@ -315,7 +367,7 @@ class ServingEngine:
             bd = jnp.asarray(b)
             return np.stack([np.asarray(fn(pk["nodes"], pk["deltas"],
                                            mask, bd))
-                             for pk in pack["per_k"]], axis=1)
+                             for pk in sub["per_k"]], axis=1)
 
         out = self._run_bucketed("raw", binned, run, K)
         # boost-from-average is folded into the first HOST tree only;
@@ -334,15 +386,18 @@ class ServingEngine:
             return None
         n, pack, binned = ready
         K = pack["K"]
+        sub = self._range_sub("insession", pack, start_iteration,
+                              end_iter, self._slice_insession)
+        lo = start_iteration if sub is pack else 0
         fn = self._fn("leaf")
         width = (end_iter - start_iteration) * K
 
         def run(b):
             bd = jnp.asarray(b)
             cols = np.zeros((b.shape[0], width), dtype=np.int32)
-            for k, pk in enumerate(pack["per_k"]):
-                allk = np.asarray(fn(pk["nodes"], bd)).T  # (bucket, T_k)
-                cols[:, k::K] = allk[:, start_iteration:end_iter]
+            for k, pk in enumerate(sub["per_k"]):
+                allk = np.asarray(fn(pk["nodes"], bd)).T  # (bucket, T_sub)
+                cols[:, k::K] = allk[:, lo:lo + width // K]
             return cols
 
         return self._run_bucketed("leaf", binned, run, width,
@@ -602,14 +657,16 @@ class ServingEngine:
         if pack is None:
             return None
         K = pack["K"]
-        mask = self._tree_mask(pack["T_k"], start_iteration, end_iter)
+        sub = self._range_sub("loaded", pack, start_iteration, end_iter,
+                              self._slice_loaded)
+        mask = self._tree_mask(sub["T_k"], 0, sub["T_k"])
         rows = self._pack_thridx_rows(data, pack)
         fn = self._fn("raw_loaded")
 
         def run(b):
             pv = jnp.asarray(b).T        # one device put per chunk
             return np.stack([np.asarray(fn(node, lv, mask, pv))
-                             for node, lv in pack["per_k"]], axis=1)
+                             for node, lv in sub["per_k"]], axis=1)
 
         return self._run_bucketed("raw_loaded", rows, run, K)
 
@@ -624,6 +681,9 @@ class ServingEngine:
         if pack is None:
             return None
         K = pack["K"]
+        sub = self._range_sub("loaded", pack, start_iteration, end_iter,
+                              self._slice_loaded)
+        lo = start_iteration if sub is pack else 0
         rows = self._pack_thridx_rows(data, pack)
         fn = self._fn("leaf_loaded")
         width = (end_iter - start_iteration) * K
@@ -631,9 +691,9 @@ class ServingEngine:
         def run(b):
             pv = jnp.asarray(b).T
             cols = np.zeros((b.shape[0], width), dtype=np.int32)
-            for k, (node, _) in enumerate(pack["per_k"]):
-                allk = np.asarray(fn(node, pv)).T     # (bucket, T_k)
-                cols[:, k::K] = allk[:, start_iteration:end_iter]
+            for k, (node, _) in enumerate(sub["per_k"]):
+                allk = np.asarray(fn(node, pv)).T     # (bucket, T_sub)
+                cols[:, k::K] = allk[:, lo:lo + width // K]
             return cols
 
         return self._run_bucketed("leaf_loaded", rows, run, width,

@@ -76,10 +76,14 @@ class ShardedTreeBuilder:
             return jax.device_put(arr, sharding)
         self._put = _put
 
-        if dataset.binned is None:
+        # host_binned() recovers the row-major matrix from the device
+        # ingest buffer when construct_device=on / free_host_binned
+        # dropped the host copy (the sharded builder needs its own
+        # mesh-sharded layout, not the serial learner's (G, N_pad) pad)
+        binned = dataset.host_binned()
+        if binned is None:
             raise ValueError("dataset has no binned data (construct it first)")
-        N, G = dataset.binned.shape     # local rows when multi-process
-        binned = dataset.binned
+        N, G = binned.shape             # local rows when multi-process
         sent = np.zeros((1, G), dtype=binned.dtype)
         sharding = NamedSharding(self.mesh, P(AXIS))
         if self.nproc > 1:

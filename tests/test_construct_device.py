@@ -1,0 +1,463 @@
+"""Device-vectorized dataset construction (ops/construct.py).
+
+Acceptance for the construction PR: the vectorized / device path must
+be BIT-IDENTICAL to the host oracle at every level — BinMappers
+(incl. NaN, zero-as-bin, categorical, max_bin_by_feature, forced
+bins), EFB bundles, the packed binned matrix, and the trees of a model
+trained through the new ingest.  Plus the streaming-construction
+chunk-boundary guarantee (Sequence batch sizes straddling sequence
+boundaries change nothing) and the DeviceIngest buffer contract.
+"""
+
+import json
+import os
+import pickle
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.dataset import BinnedDataset
+from lightgbm_tpu.ops.binning import BIN_CATEGORICAL, BIN_NUMERICAL, BinMapper
+from lightgbm_tpu.ops.construct import (BatchedMapper, DeviceIngest,
+                                        conflict_matrix, find_bin_sorted,
+                                        row_geometry, sorted_sample_columns)
+
+BASE = {"verbosity": -1}
+
+
+def _mapper_dicts(ds):
+    return [json.dumps(bm.to_dict(), sort_keys=True)
+            for bm in ds.bin_mappers]
+
+
+def _group_tuples(ds):
+    return [(tuple(g.feature_indices), g.num_total_bin,
+             tuple(g.bin_offsets)) for g in ds.groups]
+
+
+def _tree_part(model_str: str) -> str:
+    """The model string minus the echoed parameter block (the only part
+    that legitimately differs between construct_device settings)."""
+    head, sep, tail = model_str.partition("parameters:")
+    return head
+
+
+def _columns_matrix(rng, n):
+    """A matrix exercising every mapper branch: dense normal, heavy
+    zeros (sparse/EFB candidates), NaN, few-distinct, constant,
+    all-negative, categorical (with a negative code), integer grid."""
+    X = rng.normal(size=(n, 12))
+    X[:, 1] = np.where(rng.rand(n) < 0.9, 0.0, X[:, 1])
+    X[:, 2] = np.where(rng.rand(n) < 0.85, 0.0, X[:, 2])
+    X[rng.rand(n) < 0.07, 3] = np.nan
+    X[:, 4] = rng.randint(0, 5, size=n).astype(float)       # few distinct
+    X[:, 5] = 3.25                                          # constant
+    X[:, 6] = -np.abs(rng.normal(size=n)) - 0.5             # all negative
+    X[:, 7] = rng.randint(0, 9, size=n).astype(float)       # categorical
+    X[rng.rand(n) < 0.02, 7] = -1.0                         # negative cat
+    X[:, 8] = rng.randint(0, 3, size=n).astype(float)
+    X[:, 9] = np.where(rng.rand(n) < 0.5, 0.0,
+                       np.abs(X[:, 9]))                     # all >= 0
+    X[rng.rand(n) < 0.04, 9] = np.nan
+    return X
+
+
+# ---------------------------------------------------------------------------
+# Stage parity: sorted-columns bin finding vs BinMapper.find_bin
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("opts", [
+    {},
+    {"max_bin": 15},
+    {"zero_as_missing": True},
+    {"use_missing": False},
+    {"min_data_in_bin": 25},
+    {"pre_filter": True, "min_split_data": 40},
+])
+def test_find_bin_sorted_matches_oracle(rng, opts):
+    X = _columns_matrix(rng, 4000)
+    info = sorted_sample_columns(X)
+    sv = info["sorted"]
+    for f in range(X.shape[1]):
+        col = X[:, f]
+        nonzero = col[(np.abs(col) > 1e-35) | np.isnan(col)]
+        bt = BIN_CATEGORICAL if f == 7 else BIN_NUMERICAL
+        kw = dict(max_bin=255, min_data_in_bin=3, min_split_data=0,
+                  pre_filter=False, bin_type=bt, use_missing=True,
+                  zero_as_missing=False)
+        kw.update(opts)
+        ref = BinMapper()
+        ref.find_bin(nonzero, total_sample_cnt=len(col), **kw)
+        lo, hi, m = info["lo"][f], info["hi"][f], info["non_nan"][f]
+        nz_sorted = np.concatenate([sv[:lo, f], sv[hi:m, f]])
+        got = find_bin_sorted(nz_sorted, na_cnt=int(info["nan_cnt"][f]),
+                              total_sample_cnt=len(col), **kw)
+        assert (json.dumps(got.to_dict(), sort_keys=True)
+                == json.dumps(ref.to_dict(), sort_keys=True)), f
+
+
+def test_find_bin_sorted_forced_bounds(rng):
+    col = np.concatenate([rng.normal(size=3000),
+                          np.zeros(500), [np.nan] * 40])
+    rng.shuffle(col)
+    nonzero = col[(np.abs(col) > 1e-35) | np.isnan(col)]
+    kw = dict(total_sample_cnt=len(col), max_bin=63, min_data_in_bin=3,
+              forced_upper_bounds=[-0.5, 0.5, 1.5])
+    ref = BinMapper()
+    ref.find_bin(nonzero, **kw)
+    nz = np.sort(nonzero[~np.isnan(nonzero)])
+    got = find_bin_sorted(nz, na_cnt=int(np.isnan(nonzero).sum()), **kw)
+    assert (json.dumps(got.to_dict(), sort_keys=True)
+            == json.dumps(ref.to_dict(), sort_keys=True))
+
+
+def test_find_bin_sorted_many_distinct_no_big_bins(rng):
+    """The searchsorted cut-to-cut fast path (num_distinct > max_bin,
+    no big bins) — the dominant production shape."""
+    col = rng.normal(size=20000) * 10
+    kw = dict(total_sample_cnt=len(col), max_bin=63, min_data_in_bin=3)
+    ref = BinMapper()
+    ref.find_bin(col, **kw)
+    got = find_bin_sorted(np.sort(col), na_cnt=0, **kw)
+    assert got.bin_upper_bound == ref.bin_upper_bound
+    assert (json.dumps(got.to_dict(), sort_keys=True)
+            == json.dumps(ref.to_dict(), sort_keys=True))
+
+
+# ---------------------------------------------------------------------------
+# Stage parity: BatchedMapper vs per-feature values_to_bins
+# ---------------------------------------------------------------------------
+def test_batched_mapper_matches_values_to_bins(rng):
+    X = _columns_matrix(rng, 3000)
+    cfg = Config(dict(BASE, construct_device="off"))
+    ds = BinnedDataset.from_matrix(X, cfg, label=X[:, 0],
+                                   categorical_features=[7])
+    bmap = BatchedMapper(ds.bin_mappers, ds.used_features)
+    Q = _columns_matrix(np.random.RandomState(9), 500)
+    Q[0, 7] = 999.0                    # unseen category
+    for oov in (False, True):
+        got = bmap.map_chunk(Q[:, ds.used_features], oov_sentinel=oov)
+        for i, f in enumerate(ds.used_features):
+            bm = ds.bin_mappers[f]
+            ref = bm.values_to_bins(
+                Q[:, f], oov_sentinel=(oov and
+                                       bm.bin_type == BIN_CATEGORICAL))
+            np.testing.assert_array_equal(np.asarray(got[:, i]), ref,
+                                          err_msg=f"feature {f} oov={oov}")
+
+
+def test_batched_mapper_device_path_matches_host(rng):
+    import jax.numpy as jnp
+    X = _columns_matrix(rng, 2000)
+    cfg = Config(dict(BASE, construct_device="off"))
+    ds = BinnedDataset.from_matrix(X, cfg, label=X[:, 0],
+                                   categorical_features=[7])
+    bmap = BatchedMapper(ds.bin_mappers, ds.used_features)
+    Q = _columns_matrix(np.random.RandomState(3), 300)
+    host = bmap.map_chunk(Q[:, ds.used_features])
+    dev = np.asarray(bmap.map_chunk(jnp.asarray(Q[:, ds.used_features]),
+                                    xp=jnp))
+    np.testing.assert_array_equal(host, dev)
+
+
+def test_grid_search_tables_exact_vs_searchsorted(rng):
+    """The host uniform-grid search accelerator must reproduce
+    np.searchsorted('left') bit-exactly on adversarial inputs: values
+    exactly on bounds, one-ulp neighbours, +-inf, and bound sets
+    clustered tightly enough to force the per-feature fallback."""
+    from lightgbm_tpu.ops.construct import _GRID_MAXSPAN
+    cols = []
+    cols.append(rng.normal(size=4000))                   # dense normal
+    cols.append(rng.uniform(-1e-9, 1e-9, size=4000))     # tight cluster
+    cols.append(np.exp(rng.normal(size=4000) * 8)
+                * np.sign(rng.normal(size=4000)))        # huge dynamic range
+    X = np.column_stack(cols + [rng.normal(size=4000)])
+    cfg = Config(dict(BASE, construct_device="off"))
+    ds = BinnedDataset.from_matrix(X, cfg, label=X[:, 0])
+    bmap = BatchedMapper(ds.bin_mappers, ds.used_features)
+    spans = [t[4] for t in bmap._grid if t is not None]
+    assert spans and max(spans) <= _GRID_MAXSPAN
+    # adversarial probe rows: every feature's exact bounds, one-ulp
+    # neighbours, and infinities, padded to a rectangular matrix
+    probes = []
+    for i, f in enumerate(bmap.used_features):
+        b = bmap.bounds[i, : bmap._blen[i]]
+        b = b[np.isfinite(b)]
+        probes.append(np.concatenate(
+            [b, np.nextafter(b, np.inf), np.nextafter(b, -np.inf),
+             [np.inf, -np.inf, 0.0, -0.0]]))
+    n = max(p.size for p in probes)
+    Q = np.zeros((n, len(probes)))
+    for i, p in enumerate(probes):
+        Q[: p.size, i] = p
+    got = bmap.map_chunk_T(Q)
+    for i, f in enumerate(bmap.used_features):
+        ref = ds.bin_mappers[f].values_to_bins(Q[:, i])
+        np.testing.assert_array_equal(
+            got[i], ref, err_msg=f"feature {f} grid-search mismatch")
+
+
+# ---------------------------------------------------------------------------
+# Stage parity: conflict matmul vs pairwise mask loop; bundle identity
+# ---------------------------------------------------------------------------
+def test_conflict_matrix_matches_pairwise(rng):
+    masks = (rng.rand(17, 4000) < 0.08)
+    got = conflict_matrix(masks)
+    for i in range(17):
+        for j in range(17):
+            assert got[i, j] == int((masks[i] & masks[j]).sum()), (i, j)
+
+
+def test_efb_bundles_bit_identical(rng):
+    n = 4000
+    X = np.zeros((n, 24))
+    # mutually exclusive one-hot-ish block: bundles expected
+    hot = rng.randint(0, 20, size=n)
+    for j in range(20):
+        X[:, j] = np.where(hot == j, rng.rand(n) + 0.5, 0.0)
+    X[:, 20:] = rng.normal(size=(n, 4))
+    y = X[:, 20]
+    ds0 = BinnedDataset.from_matrix(
+        X, Config(dict(BASE, construct_device="off")), label=y)
+    ds1 = BinnedDataset.from_matrix(
+        X, Config(dict(BASE, construct_device="auto")), label=y)
+    assert _group_tuples(ds0) == _group_tuples(ds1)
+    assert any(len(g.feature_indices) > 1 for g in ds0.groups), \
+        "matrix must actually exercise bundling"
+    assert np.array_equal(ds0.binned, ds1.binned)
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: dataset-level parity + tree-identical training
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("mode", ["auto", "on"])
+def test_construct_parity_and_tree_identity(rng, mode):
+    X = _columns_matrix(rng, 4000)
+    y = (X[:, 0] + np.nan_to_num(X[:, 3]) + X[:, 1] * 2
+         + 0.1 * rng.normal(size=len(X)))
+    params = dict(BASE, objective="regression", num_leaves=15,
+                  num_iterations=8, seed=3, deterministic=True,
+                  categorical_feature=[7],
+                  max_bin_by_feature=",".join(["255"] * 6 + ["31"] * 6))
+    ds0 = BinnedDataset.from_matrix(
+        X, Config(dict(params, construct_device="off")), label=y,
+        categorical_features=[7])
+    dsm = BinnedDataset.from_matrix(
+        X, Config(dict(params, construct_device=mode)), label=y,
+        categorical_features=[7])
+    assert _mapper_dicts(ds0) == _mapper_dicts(dsm)
+    assert _group_tuples(ds0) == _group_tuples(dsm)
+    if mode == "auto":
+        assert dsm.binned is not None
+        assert np.array_equal(ds0.binned, dsm.binned)
+    else:
+        assert dsm.binned is None, "construct_device=on keeps no host copy"
+    assert dsm.device_ingest is not None
+    np.testing.assert_array_equal(dsm.device_ingest.host_binned(),
+                                  ds0.binned)
+
+    m_off = lgb.train(dict(params, construct_device="off"),
+                      lgb.Dataset(X, label=y, categorical_feature=[7]))
+    m_new = lgb.train(dict(params, construct_device=mode),
+                      lgb.Dataset(X, label=y, categorical_feature=[7]))
+    assert (_tree_part(m_off.model_to_string())
+            == _tree_part(m_new.model_to_string())), \
+        f"trees must be bit-identical through construct_device={mode}"
+
+
+def test_validation_dataset_parity(rng):
+    X = _columns_matrix(rng, 3000)
+    y = X[:, 0] + 0.1 * rng.normal(size=len(X))
+    Xv, yv = _columns_matrix(np.random.RandomState(5), 500), None
+    evals = {}
+    models = {}
+    for mode in ("off", "auto"):
+        params = dict(BASE, objective="regression", num_leaves=15,
+                      num_iterations=6, seed=3, metric="l2",
+                      construct_device=mode)
+        dtr = lgb.Dataset(X, label=y)
+        dva = lgb.Dataset(Xv, label=Xv[:, 0], reference=dtr)
+        rec = {}
+        bst = lgb.train(params, dtr, valid_sets=[dva],
+                        valid_names=["v"], callbacks=[
+                            lgb.record_evaluation(rec)])
+        evals[mode] = rec
+        models[mode] = _tree_part(bst.model_to_string())
+    assert models["off"] == models["auto"]
+    assert evals["off"] == evals["auto"]
+
+
+# ---------------------------------------------------------------------------
+# Sequence / two_round chunk-boundary construction
+# ---------------------------------------------------------------------------
+class _Seq(lgb.Sequence):
+    def __init__(self, mat, batch_size):
+        self._m = mat
+        self.batch_size = batch_size
+
+    def __getitem__(self, idx):
+        return self._m[idx]
+
+    def __len__(self):
+        return len(self._m)
+
+
+@pytest.mark.parametrize("mode", ["off", "auto", "on"])
+@pytest.mark.parametrize("batches", [(173,), (1024,), (97, 211)])
+def test_sequence_chunk_boundaries_bit_identical(rng, mode, batches):
+    """Chunk sizes that straddle sequence boundaries must produce
+    bit-identical mappers/bins vs one-shot construction — this guards
+    the streaming device ingest too (rows enter the (G, N_pad) buffer
+    in arbitrary chunk sizes)."""
+    X = _columns_matrix(rng, 2611)     # prime-ish row count: never aligned
+    y = X[:, 0]
+    cfg = Config(dict(BASE, construct_device=mode))
+    one = BinnedDataset.from_matrix(
+        X, Config(dict(BASE, construct_device=mode)), label=y)
+    # split rows across sequences at awkward places, with batch sizes
+    # that straddle both sequence boundaries and each other
+    cuts = [0, 611, 1900, len(X)]
+    for bs in batches:
+        seqs = [_Seq(X[a:b], bs) for a, b in zip(cuts[:-1], cuts[1:])]
+        ds = BinnedDataset.from_sequences(seqs, cfg, label=y)
+        assert _mapper_dicts(ds) == _mapper_dicts(one)
+        assert _group_tuples(ds) == _group_tuples(one)
+        a = ds.host_binned()
+        b = one.host_binned()
+        np.testing.assert_array_equal(a, b)
+        if mode == "on":
+            assert ds.binned is None and ds.device_ingest is not None
+
+
+def test_two_round_dataset_matches_in_memory(rng, tmp_path):
+    """two_round loading (file -> Sequence-style chunked construction)
+    agrees with in-memory construction through the vectorized path."""
+    X = _columns_matrix(rng, 1500)[:, :8]
+    y = X[:, 0]
+    data = np.column_stack([y, X])
+    path = tmp_path / "train.csv"
+    np.savetxt(path, data, delimiter=",")
+    p = dict(BASE, objective="regression", num_iterations=3, seed=1,
+             num_leaves=7)
+    m_mem = lgb.train(dict(p, construct_device="auto"),
+                      lgb.Dataset(X, label=y))
+    m_two = lgb.train(dict(p, construct_device="auto", two_round=True),
+                      lgb.Dataset(str(path)))
+    assert (_tree_part(m_mem.model_to_string())
+            == _tree_part(m_two.model_to_string()))
+
+
+# ---------------------------------------------------------------------------
+# DeviceIngest buffer contract
+# ---------------------------------------------------------------------------
+def test_device_ingest_contract(rng):
+    G, N = 5, 1000
+    c, row0, n_pad = row_geometry(4096, N)
+    ing = DeviceIngest(G, N, np.uint8, 4096)
+    assert (ing.row_chunk, ing.row0, ing.n_pad) == (c, row0, n_pad)
+    mat = rng.randint(0, 200, size=(N, G)).astype(np.uint8)
+    for start in (0, 137, 512):
+        stop = (137, 512, N)[(0, 137, 512).index(start)]
+        ing.push(mat[start:stop])
+    buf = ing.finish()
+    assert buf.shape == (G, n_pad)
+    np.testing.assert_array_equal(ing.host_binned(), mat)
+    # padding rows stay zero; part0 pads on device
+    p = np.asarray(ing.part0(G + 3))
+    assert p.shape == (G + 3, n_pad)
+    assert (p[G:] == 0).all()
+    np.testing.assert_array_equal(p[:G, row0:row0 + N], mat.T)
+    # overflow / underflow raise
+    with pytest.raises(ValueError):
+        ing.push(mat[:1])
+    ing2 = DeviceIngest(G, N, np.uint8, 4096)
+    ing2.push(mat[:10])
+    with pytest.raises(ValueError):
+        ing2.finish()
+
+
+def test_free_host_binned_and_state_round_trips(rng):
+    X = _columns_matrix(rng, 2000)
+    y = X[:, 0]
+    cfg = Config(dict(BASE, construct_device="auto",
+                      free_host_binned=True))
+    ds = BinnedDataset.from_matrix(X, cfg, label=y)
+    assert ds.binned is None and ds.device_ingest is not None
+    oracle = BinnedDataset.from_matrix(
+        X, Config(dict(BASE, construct_device="off")), label=y)
+    # pickling materializes the host matrix back (no data loss)
+    ds2 = pickle.loads(pickle.dumps(ds))
+    np.testing.assert_array_equal(ds2.binned, oracle.binned)
+    assert ds2.device_ingest is None
+    # save_binary materializes from the device buffer too
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        pth = os.path.join(td, "ds.bin")
+        ds.save_binary(pth)
+        ds3 = BinnedDataset.load_binary(pth, cfg)
+        np.testing.assert_array_equal(ds3.binned, oracle.binned)
+
+
+def test_sharded_trainer_recovers_host_binned(rng):
+    """Single-process multi-device sharded training (tree_learner=data)
+    consumes the host matrix via ``host_binned()``, so datasets built
+    with construct_device=on / free_host_binned (host copy absent,
+    recoverable from the DeviceIngest buffer) train tree-identically
+    instead of crashing on ``dataset.binned is None``."""
+    X = _columns_matrix(rng, 1500)
+    y = X[:, 0] + 0.1 * rng.normal(size=len(X))
+    p = dict(BASE, objective="regression", num_leaves=15,
+             num_iterations=5, seed=3, tree_learner="data")
+    m_off = lgb.train(dict(p, construct_device="off"),
+                      lgb.Dataset(X, label=y))
+    for mode in ({"construct_device": "on"}, {"free_host_binned": True}):
+        m = lgb.train(dict(p, **mode), lgb.Dataset(X, label=y))
+        assert (_tree_part(m_off.model_to_string())
+                == _tree_part(m.model_to_string())), mode
+
+
+def test_learner_geometry_mismatch_recovers_host(rng):
+    """Training with a different tpu_row_chunk than construction (so
+    the prebuilt device buffer's geometry no longer matches) recovers
+    the host matrix from the buffer and trains identically."""
+    X = _columns_matrix(rng, 1500)
+    y = X[:, 0] + 0.1 * rng.normal(size=len(X))
+    p = dict(BASE, objective="regression", num_leaves=15,
+             num_iterations=5, seed=3)
+    # the oracle must train on the SAME row chunk: the chunk grid sets
+    # the histogram accumulation order, so only the construct path may
+    # differ between the two models
+    m_off = lgb.train(dict(p, construct_device="off", tpu_row_chunk=512),
+                      lgb.Dataset(X, label=y))
+    ds = lgb.Dataset(X, label=y)
+    ds.construct({**p, "construct_device": "on"})
+    inner = ds._inner
+    assert inner.binned is None and inner.device_ingest is not None
+    # shrink the training row chunk: ingest geometry no longer matches
+    m_mismatch = lgb.train(dict(p, construct_device="on",
+                                tpu_row_chunk=512), ds)
+    assert (_tree_part(m_off.model_to_string())
+            == _tree_part(m_mismatch.model_to_string()))
+
+
+# ---------------------------------------------------------------------------
+# tools/profile_construct.py --smoke (tier-1 wiring)
+# ---------------------------------------------------------------------------
+def test_profile_construct_smoke():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=root)
+    out = subprocess.run(
+        [sys.executable, os.path.join(root, "tools",
+                                      "profile_construct.py"), "--smoke"],
+        capture_output=True, text=True, env=env, timeout=600)
+    assert out.returncode == 0, out.stdout + out.stderr
+    line = [ln for ln in out.stdout.strip().splitlines()
+            if ln.startswith("{")][-1]
+    rec = json.loads(line)
+    assert rec["parity_ok"] is True
+    assert rec["grid"], "smoke grid must not be empty"
+    for cell in rec["grid"]:
+        assert cell["host_loop_s"] > 0 and cell["vectorized_s"] > 0

@@ -135,14 +135,47 @@ def test_compile_count_one_trace_per_bucket(reg_model):
     bst.predict(X[:200], raw_score=True)
     bst.predict(X[:129], raw_score=True)
     assert eng.stats()["traces"][("raw", 256)] == 1
-    # same bucket, sliced iteration ranges: still no re-trace (the
-    # range rides a tree-mask argument, not the jit cache key)
+    # same bucket, sliced iteration ranges: ONE extra trace per distinct
+    # slice LENGTH (the range is served from a per-range sub-pack whose
+    # stacked shapes key the jit cache; see ServingEngine._range_sub) —
+    # repeats and equal-length ranges reuse it
     bst.predict(X[:700], raw_score=True, start_iteration=2,
                 num_iteration=3)
-    bst.predict(X[:700], pred_contrib=True, num_iteration=4)
     tr = eng.stats()["traces"]
-    assert tr[("raw", 1024)] == 1
+    assert tr[("raw", 1024)] == 2, tr
+    bst.predict(X[:700], raw_score=True, start_iteration=2,
+                num_iteration=3)          # repeat: cached sub-pack
+    bst.predict(X[:700], raw_score=True, start_iteration=1,
+                num_iteration=3)          # same length: same shapes
+    assert eng.stats()["traces"][("raw", 1024)] == 2
+    # contrib slices stay mask-driven (per depth-group masks): no
+    # re-trace for a sliced contrib
+    bst.predict(X[:700], pred_contrib=True, num_iteration=4)
     assert contrib_traces(1024) == c1024
+
+
+def test_range_subpack_parity_and_lru(reg_model):
+    """start/num_iteration slices served from the bounded per-range
+    sub-pack cache match the host oracle bit-for-bit, and the LRU stays
+    within RANGE_CACHE entries."""
+    bst, X = reg_model
+    eng = bst._gbdt.serving
+    g = bst._gbdt
+    bst.predict(X, raw_score=True)                  # warm
+    Xq = X[:300]
+    for s, m in [(0, 3), (2, 2), (1, 4), (3, 1), (0, 4), (2, 2)]:
+        dev = np.asarray(bst.predict(Xq, raw_score=True,
+                                     start_iteration=s,
+                                     num_iteration=m)).reshape(-1)
+        oracle = sum(t.predict(Xq) for t in g.models[s:s + m])
+        np.testing.assert_allclose(dev, oracle, rtol=1e-6, atol=1e-6)
+        assert len(eng._range_packs) <= eng.RANGE_CACHE
+    # leaf slices flow through the same sub-pack
+    lv_full = bst.predict(Xq, pred_leaf=True)
+    lv_sl = bst.predict(Xq, pred_leaf=True, start_iteration=1,
+                        num_iteration=3)
+    np.testing.assert_array_equal(np.asarray(lv_sl),
+                                  np.asarray(lv_full)[:, 1:4])
 
 
 def test_cache_invalidates_on_update_and_rollback():

@@ -1,0 +1,151 @@
+"""Profile dataset construction: host per-feature loop vs vectorized vs
+device (ops/construct.py).
+
+Times, for each (rows, features) grid cell:
+
+* ``host_loop_s``   — end-to-end ``BinnedDataset.from_matrix`` through
+  the original per-feature Python loops (``construct_device=off``, the
+  oracle).
+* ``vectorized_s``  — the same construction through the batched path
+  (``construct_device=auto``: one column-wise sort for bin finding, one
+  batched searchsorted for the mapping, matmul EFB conflicts, streaming
+  device ingest).
+* ``device_map_s``  — the values->bins mapping stage alone executed on
+  the default JAX backend via the SAME BatchedMapper code path
+  (``jnp`` instead of ``numpy``), including the host->device transfer;
+  null when the backend is unavailable.
+
+Parity (binned matrices bit-identical between arms) is asserted on
+every cell.  Prints ONE JSON line:
+
+  {"grid": [{rows, features, host_loop_s, vectorized_s, speedup,
+             device_map_s}...],
+   "parity_ok": true, "backend": "...", "smoke": bool}
+
+``--smoke`` runs a seconds-sized grid (tier-1 wiring:
+tests/test_construct_device.py); the full grid tops out at 1M x 100 —
+the PERF.md acceptance cell (>= 4x vectorized vs host loop on CPU).
+
+On-device A/B (run where a TPU is attached):
+  JAX_PLATFORMS=tpu python tools/profile_construct.py
+  JAX_PLATFORMS=cpu python tools/profile_construct.py
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _make_matrix(rows: int, features: int, seed: int = 0) -> np.ndarray:
+    """Mixed-shape matrix: dense normals, sparse (EFB-candidate)
+    columns, one NaN column, one few-distinct column."""
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(rows, features))
+    for j in range(0, features, 4):            # every 4th column sparse
+        X[:, j] = np.where(rng.rand(rows) < 0.9, 0.0, X[:, j])
+    if features > 2:
+        X[rng.rand(rows) < 0.05, 2] = np.nan
+    if features > 3:
+        X[:, 3] = rng.randint(0, 12, size=rows).astype(float)
+    return X
+
+
+def _construct(X, mode: str):
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.dataset import BinnedDataset
+    cfg = Config({"verbosity": -1, "construct_device": mode})
+    t0 = time.time()
+    ds = BinnedDataset.from_matrix(X, cfg, label=X[:, 0])
+    dt = time.time() - t0
+    return ds, dt
+
+
+def _device_map_time(ds, X):
+    """The batched mapping stage on the default JAX backend (jnp code
+    path of BatchedMapper.map_chunk), transfer included."""
+    try:
+        import jax
+        import jax.numpy as jnp
+    except Exception:
+        return None
+    try:
+        bmap = ds.batched_mapper()
+        sub = np.asarray(X[:, ds.used_features], dtype=np.float64)
+        out = bmap.map_chunk(jnp.asarray(sub), xp=jnp)   # compile+warm
+        jax.block_until_ready(out)
+        t0 = time.time()
+        out = bmap.map_chunk(jnp.asarray(sub), xp=jnp)
+        jax.block_until_ready(out)
+        return time.time() - t0
+    except Exception:
+        return None
+
+
+def run_cell(rows: int, features: int):
+    X = _make_matrix(rows, features)
+    ds_oracle, host_s = _construct(X, "off")
+    ds_vec, vec_s = _construct(X, "auto")
+    parity = (
+        [bm.to_dict() for bm in ds_oracle.bin_mappers]
+        == [bm.to_dict() for bm in ds_vec.bin_mappers]
+        and [(g.feature_indices, g.num_total_bin, g.bin_offsets)
+             for g in ds_oracle.groups]
+        == [(g.feature_indices, g.num_total_bin, g.bin_offsets)
+            for g in ds_vec.groups]
+        and np.array_equal(ds_oracle.binned, ds_vec.host_binned()))
+    dev_s = _device_map_time(ds_vec, X)
+    return {
+        "rows": rows, "features": features,
+        "host_loop_s": round(host_s, 3),
+        "vectorized_s": round(vec_s, 3),
+        "speedup": round(host_s / vec_s, 2) if vec_s > 0 else None,
+        "device_map_s": round(dev_s, 3) if dev_s is not None else None,
+    }, parity
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="seconds-sized grid for tier-1")
+    ap.add_argument("--rows", type=str, default="",
+                    help="comma-separated row counts (overrides grid)")
+    ap.add_argument("--features", type=str, default="",
+                    help="comma-separated feature counts")
+    args = ap.parse_args()
+
+    if args.rows or args.features:
+        rows = [int(r) for r in (args.rows or "100000").split(",")]
+        feats = [int(f) for f in (args.features or "20").split(",")]
+        grid = [(r, f) for r in rows for f in feats]
+    elif args.smoke:
+        grid = [(20000, 10), (50000, 20)]
+    else:
+        grid = [(100_000, 20), (100_000, 100),
+                (1_000_000, 20), (1_000_000, 100)]
+
+    import jax
+    cells = []
+    parity_ok = True
+    for rows, features in grid:
+        cell, parity = run_cell(rows, features)
+        parity_ok = parity_ok and parity
+        cells.append(cell)
+        print(f"# {rows}x{features}: host {cell['host_loop_s']}s "
+              f"vec {cell['vectorized_s']}s "
+              f"({cell['speedup']}x) device-map {cell['device_map_s']}",
+              file=sys.stderr)
+    rec = {"grid": cells, "parity_ok": bool(parity_ok),
+           "backend": jax.default_backend(), "smoke": bool(args.smoke)}
+    print(json.dumps(rec))
+    return 0 if parity_ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
