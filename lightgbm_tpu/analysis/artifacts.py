@@ -22,6 +22,10 @@ would otherwise catch fail tier-1 instead:
 * ``shap.kernel`` — the device TreeSHAP program keeps its unrolled
   D/q-loop structure (at most the single tree scan ``while``), runs
   f64 under the scoped x64 context, and contains no host callbacks.
+* ``continual.tick`` — steady-state continual-runtime ticks add zero
+  serving retraces (the in-place refit rides the leaf-refresh fast
+  path) and a hot swap compiles each (kind, bucket) at most once,
+  during the candidate warm-up, never on the serving path.
 
 Every metric is a ceiling checked against ``jaxlint_baseline.json``
 (see :mod:`lightgbm_tpu.analysis.baseline`).  All checks run on the
@@ -216,6 +220,46 @@ def check_shap_kernel() -> Dict[str, int]:
             "entry_copies": counts.get("copies", 0)}
 
 
+# ---------------------------------------------------------------------------
+# continual-runtime tick/swap budgets
+# ---------------------------------------------------------------------------
+def check_continual_tick() -> Dict[str, int]:
+    """Tick-loop artifact budget for the continual runtime: steady-state
+    ticks (prequential eval + in-place leaf refit) must add ZERO serving
+    retraces — the refit rides the engine's leaf-refresh fast path, so
+    only the small delta matrices re-transfer — and a hot swap must cost
+    at most ONE compile per (kind, bucket), paid while warming the
+    candidate off the serving path."""
+    from ..continual.drift import _DRILL_PARAMS, DriftStream
+    from ..continual.runtime import ContinualBooster
+
+    p = dict(_DRILL_PARAMS)
+    p.update({"num_iterations": 5, "num_leaves": 7})
+    stream = DriftStream(num_features=5, rows=128, seed=9)
+    X0, y0 = DriftStream(num_features=5, rows=512, seed=10).batch(0)
+    cb = ContinualBooster(p, X0, y0)
+    # settle: the first tick pays the per-kind compiles once
+    cb.tick(*stream.batch(0))
+    snap = cb.serving_engine.trace_snapshot()
+    for t in range(1, 4):
+        cb.tick(*stream.batch(t))
+    tick_retraces = sum(
+        cb.serving_engine.new_traces_since(snap).values())
+
+    # a forced swap: candidate warm-up may trace each (kind, bucket)
+    # once, never twice
+    import lightgbm_tpu as lgb
+    Xc, yc = DriftStream(num_features=5, rows=512, seed=12).batch(0)
+    cand = lgb.train({"objective": "regression", "verbosity": -1,
+                      "num_leaves": 7, "metric": ""},
+                     lgb.Dataset(Xc, label=yc), num_boost_round=5)
+    r = cb.force_swap(cand, gate=stream.batch(4))
+    over = sum(1 for v in r.swap_new_traces.values() if v > 1)
+    return {"tick_retraces": tick_retraces,
+            "swap_retraces_over_one": over,
+            "swap_missing_warm": 0 if r.swap_new_traces else 1}
+
+
 CHECKS = {
     "while_body.default": check_while_body_default,
     "while_body.mega": check_while_body_mega,
@@ -223,6 +267,7 @@ CHECKS = {
     "serving.transfers": check_serving_transfers,
     "train.donation": check_train_donation,
     "shap.kernel": check_shap_kernel,
+    "continual.tick": check_continual_tick,
 }
 
 

@@ -841,14 +841,32 @@ class Booster:
         return list(self._gbdt.feature_names)
 
     def refit(self, data, label, weight=None, group=None,
-              decay_rate: Optional[float] = None, **kwargs) -> "Booster":
+              decay_rate: Optional[float] = None,
+              inplace: bool = False, **kwargs) -> "Booster":
         """Refit the existing tree structures on new data: keep every split,
         recompute leaf outputs from the new gradients
         (reference: GBDT::RefitTree gbdt.cpp:252-290 and
         SerialTreeLearner::FitByExistingTree; basic.py Booster.refit).
-        """
+
+        ``inplace=True`` commits the new leaf values into THIS booster
+        (the continual-training runtime's per-tick path) instead of
+        returning a fresh one: device trees and the serving engine's
+        warm packs update eagerly through
+        ``GBDT.apply_refit_leaf_values`` — the mutation counter bumps
+        at commit, like update/rollback, never "at the next update".
+        In-place refit makes the booster serving-only (its training
+        scores no longer match the model); continue training from a
+        fresh booster instead.
+
+        ``nonfinite_policy`` (robustness/guard.py) guards the refit
+        gradients exactly like full training iterations: ``raise``
+        aborts naming the refit iteration, ``skip_iteration`` keeps
+        that iteration's old leaf values, ``clamp`` zeroes the poisoned
+        entries so those rows drop out of the leaf sums."""
         from .dataset import Metadata
         from .ops.split import leaf_output as _leaf_output
+        from .robustness import faultinject as _faultinject
+        from .robustness.guard import NonFiniteGuard
 
         g = self._gbdt
         g._flush_pending()
@@ -862,10 +880,18 @@ class Booster:
         n = mat.shape[0]
         K = g.num_tree_per_iteration
 
-        new_booster = Booster(model_str=self.model_to_string())
-        new_booster.config = cfg
-        ng = new_booster._gbdt
+        if inplace:
+            new_booster = self
+            ng = g
+        else:
+            new_booster = Booster(model_str=self.model_to_string())
+            new_booster.config = cfg
+            ng = new_booster._gbdt
         objective = create_objective(cfg)
+        nf_guard = NonFiniteGuard.from_config(cfg)
+        # observable by callers (the continual runtime reports whether a
+        # tick's refit was guard-skipped); None when no policy is active
+        new_booster._refit_guard = nf_guard
 
         meta = Metadata(n)
         meta.set_label(label)
@@ -879,14 +905,30 @@ class Booster:
         l1, l2 = float(cfg.lambda_l1), float(cfg.lambda_l2)
         mds = float(cfg.max_delta_step)
         eps = 1e-15  # kEpsilon hessian floor (serial_tree_learner.cpp:251)
+        # new leaf values accumulate OUT OF PLACE and commit at the end:
+        # the serving engine must never observe a half-refit forest
+        new_values = [np.asarray(t.leaf_value, np.float64).copy()
+                      for t in ng.models]
         for it in range(num_iters):
             grad, hess = objective.get_gradients(
                 np.asarray(scores, dtype=np.float64))
             grad = np.asarray(grad, dtype=np.float64)
             hess = np.asarray(hess, dtype=np.float64)
+            if _faultinject.is_active():
+                grad, hess = (np.asarray(a, dtype=np.float64) for a in
+                              _faultinject.maybe_corrupt_gradients(
+                                  it, grad, hess))
             if K > 1 and grad.ndim == 1:
                 grad = grad.reshape(K, n).T
                 hess = hess.reshape(K, n).T
+            skip = False
+            if nf_guard is not None:
+                # same guard rails as a full training iteration
+                # (robustness/guard.py): one finiteness verdict over the
+                # refit gradients before any leaf sum reads them
+                grad, hess, skip = nf_guard.filter(it, grad, hess)
+                grad = np.asarray(grad, dtype=np.float64)
+                hess = np.asarray(hess, dtype=np.float64)
             for k in range(K):
                 ti = it * K + k
                 tree = ng.models[ti]
@@ -894,24 +936,30 @@ class Booster:
                 hk = hess[:, k] if K > 1 else hess
                 leaves = leaf_preds[:, ti]
                 nl = tree.num_leaves
-                gsum = np.bincount(leaves, weights=gk, minlength=nl)
-                hsum = np.bincount(leaves, weights=hk, minlength=nl) + eps
-                out = np.asarray(
-                    _leaf_output(jnp.asarray(gsum), jnp.asarray(hsum),
-                                 l1, l2, mds),
-                    dtype=np.float64) * tree.shrinkage
-                tree.leaf_value = decay * np.asarray(tree.leaf_value) + \
-                    (1.0 - decay) * out
-                pred = tree.leaf_value[leaves]
+                if not skip:
+                    gsum = np.bincount(leaves, weights=gk, minlength=nl)
+                    hsum = np.bincount(leaves, weights=hk,
+                                       minlength=nl) + eps
+                    out = np.asarray(
+                        _leaf_output(jnp.asarray(gsum), jnp.asarray(hsum),
+                                     l1, l2, mds),
+                        dtype=np.float64) * tree.shrinkage
+                    new_values[ti] = decay * new_values[ti] + \
+                        (1.0 - decay) * out
+                # skipped iterations keep their old leaf values but
+                # still contribute them to the running scores, so later
+                # iterations' gradients stay consistent
+                pred = new_values[ti][leaves]
                 if K > 1:
                     scores[:, k] += pred
                 else:
                     scores += pred
-        # the in-place leaf_value rewrites are a model mutation: bump the
-        # version (and drop packs) so the serving pack warmed by the
-        # predict_leaf_index call above can never serve pre-refit values
-        ng._model_version += 1
-        ng.serving.invalidate()
+        # committing the leaf rewrites is a model mutation: the version
+        # bumps (and packs refresh/drop) EAGERLY so a serving pack warmed
+        # by the predict_leaf_index call above — or, inplace, any pack
+        # this booster was already serving — can never serve pre-refit
+        # values
+        ng.apply_refit_leaf_values(new_values)
         return new_booster
 
     def free_dataset(self) -> "Booster":

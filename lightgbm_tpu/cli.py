@@ -2,7 +2,13 @@
 
 TPU-native re-implementation of the reference CLI (src/main.cpp,
 src/application/application.{h,cpp}): `key=value` argv plus a `config=` file,
-tasks train | predict | convert_model | refit | save_binary.
+tasks train | predict | convert_model | refit | save_binary, plus the
+framework's own `continual` task — a deterministic drift drill through
+the continual-training runtime (lightgbm_tpu/continual/): drift is
+injected at a chosen tick, the regression must be detected, a
+background retrain (killed once and resumed from checkpoint) hot-swaps
+in, and a forced post-swap regression rolls back — the operator's
+rehearsal that every continual failure path works on THIS install.
 
 Usage:  python -m lightgbm_tpu task=train config=train.conf [key=value ...]
 """
@@ -80,6 +86,8 @@ class Application:
             self.refit()
         elif task == "save_binary":
             self.save_binary()
+        elif task == "continual":
+            self.continual()
         else:
             log.fatal("Unknown task: %s", task)
 
@@ -242,6 +250,57 @@ class Application:
                                     decay_rate=cfg.refit_decay_rate, **extra)
         new_booster.save_model(cfg.output_model)
         log.info("Finished refit; model saved to %s", cfg.output_model)
+
+    def continual(self) -> None:
+        """Run the deterministic continual-training drift drill (see the
+        module docstring) with this config's ``continual_*`` parameters;
+        one JSON line per scenario, non-zero exit on a broken invariant.
+        ``checkpoint_dir=`` roots the retrain checkpoints (a temp
+        directory otherwise)."""
+        import json
+        import shutil
+        import tempfile
+
+        from .continual import run_drift_drill
+
+        cfg = self.config
+        work = cfg.checkpoint_dir or tempfile.mkdtemp(prefix="continual-")
+        own_tmp = not cfg.checkpoint_dir
+        # the drill's synthetic stream is regression-shaped; IO/model
+        # params don't apply to it
+        _skip = {"task", "config", "objective", "num_class", "data",
+                 "valid", "input_model", "output_model", "metric"}
+        overrides = {k: v for k, v in self.raw_params.items()
+                     if Config.canonical_name(k) is not None
+                     and Config.canonical_name(k) not in _skip}
+        problems = []
+        try:
+            for scenario in ("swap", "degrade", "rollback"):
+                rep = run_drift_drill(
+                    scenario, params=overrides,
+                    checkpoint_dir=work if scenario == "swap" else None)
+                rep.pop("ticks", None)
+                print(json.dumps({"scenario": scenario, "report": {
+                    k: v for k, v in rep.items() if k != "history"}}))
+                if scenario == "swap" and not (
+                        rep.get("detected_within_window")
+                        and rep.get("one_trace_per_key")
+                        and rep.get("swap_tick") is not None):
+                    problems.append("swap drill failed")
+                if scenario == "degrade" and not rep.get("still_serving"):
+                    problems.append("degrade drill failed")
+                if scenario == "rollback" and not (
+                        rep.get("rollback_within")
+                        and rep.get("pre_post_identical")):
+                    problems.append("rollback drill failed")
+        finally:
+            if own_tmp:
+                shutil.rmtree(work, ignore_errors=True)
+        if problems:
+            log.fatal("continual drill: %s", "; ".join(problems))
+        log.info("continual drill passed: detection, checkpointed "
+                 "retrain, guarded swap, degradation and rollback all "
+                 "exercised")
 
     def save_binary(self) -> None:
         cfg = self.config

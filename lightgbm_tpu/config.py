@@ -149,6 +149,32 @@ _PARAMS: List[_Param] = [
     # backoff (deadline = time_out)
     _p("bootstrap_retries", 5, int, (), ">0"),
     _p("bootstrap_retry_delay", 1.0, float, (), ">0.0"),
+    # --- Continual training (lightgbm_tpu/continual/) ---
+    # windowed regression detection: mean tick metric over the last
+    # continual_window ticks vs the window before; a relative
+    # degradation beyond continual_metric_threshold triggers a
+    # background retrain, and the same threshold drives the post-swap
+    # rollback watchdog for continual_rollback_window ticks
+    _p("continual_window", 3, int, (), ">0"),
+    _p("continual_metric_threshold", 0.15, float, (), ">=0.0"),
+    _p("continual_rollback_window", 3, int, (), ">0"),
+    # how many recent tick mini-batches feed a retrain
+    _p("continual_buffer_ticks", 8, int, (), ">0"),
+    # 0 = inherit num_iterations
+    _p("continual_retrain_rounds", 0, int, (), ">=0"),
+    # retry/backoff policy around retrains (robustness/retry.py;
+    # jitter is SEEDED so fault drills replay bit-exact)
+    _p("continual_retrain_attempts", 3, int, (), ">0"),
+    _p("continual_backoff_base", 0.05, float, (), ">0.0"),
+    _p("continual_backoff_jitter", 0.1, float, (), ">=0.0"),
+    # swap gate: a candidate worse than the served model by more than
+    # this relative margin on the gate batch is rejected
+    _p("continual_swap_margin", 0.0, float, (), ">=0.0"),
+    # detection quiet period (ticks) after a swap/rollback/failure
+    _p("continual_cooldown", 3, int, (), ">=0"),
+    # tick metric: auto (from the objective) | l2 | binary_logloss |
+    # multi_logloss — lower is better, computed on the host
+    _p("continual_metric", "auto", str),
     _p("use_quantized_grad", False, bool),
     _p("num_grad_quant_bins", 4, int),
     _p("quant_train_renew_leaf", False, bool),

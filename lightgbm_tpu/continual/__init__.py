@@ -1,0 +1,17 @@
+"""Continual-training runtime: drift-aware refit, guarded atomic
+hot-swap, rollback watchdog (ROADMAP item 5).
+
+Composes the PR 1 fault-tolerance runtime (checkpoint/resume,
+non-finite guards, retry/backoff, fault injection) and the PR 3
+serving engine (mutation-counter pack invalidation) into an online
+pipeline that *keeps* a model fresh under drift, crashes, and bad
+data.  See :mod:`lightgbm_tpu.continual.runtime` for the state
+machine and :mod:`lightgbm_tpu.continual.drift` for the deterministic
+drift-injection harness.
+"""
+
+from .drift import DriftSpec, DriftStream, run_drift_drill
+from .runtime import ContinualBooster, TickReport
+
+__all__ = ["ContinualBooster", "TickReport", "DriftSpec", "DriftStream",
+           "run_drift_drill"]

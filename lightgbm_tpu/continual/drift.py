@@ -1,0 +1,246 @@
+"""Deterministic drift injection for the continual-training runtime.
+
+In the spirit of ``robustness/faultinject.py``: every failure path of
+the online pipeline must be reproducible in tier-1 without real
+traffic.  A :class:`DriftStream` emits per-tick mini-batches that are a
+PURE function of ``(seed, tick, spec)`` — no shared RNG state between
+ticks — so any scenario replays bit-exact, and a :class:`DriftSpec`
+arms the four fault classes the runtime must survive:
+
+  * **covariate shift** — feature means jump at a chosen tick (the
+    served model extrapolates off its training support and its metric
+    regresses);
+  * **label flip / concept shift** — the label relation inverts for a
+    fraction of rows (binary: Bernoulli flips; regression: sign-flipped
+    targets), the classic sudden-concept-drift injection;
+  * **NaN burst** — a block of ticks carries NaN features and labels (a
+    poisoned upstream join), exercising the refit path's
+    ``nonfinite_policy`` guard rails;
+  * **kill mid-retrain** — consumed by ``ContinualBooster`` as a
+    ``retrain_fault``: the retrain triggered by the drift dies at a
+    chosen boosting iteration via ``robustness/faultinject.py``, and
+    either resumes from its checkpoint on the next retry or (with
+    retries exhausted) degrades to the last-good model.
+
+:func:`run_drift_drill` is the end-to-end rehearsal used by
+``tools/profile_continual.py``, ``tools/ab_bench.py --drift`` and the
+tier-1 tests.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+@dataclass
+class DriftSpec:
+    """Which drifts hit the stream, and when (tick indices, 0-based)."""
+
+    covariate_shift_at: Optional[int] = None
+    covariate_shift: float = 2.5          # added to every feature mean
+    label_flip_at: Optional[int] = None
+    label_flip_fraction: float = 0.4
+    nan_burst_at: Optional[int] = None
+    nan_burst_ticks: int = 1
+    nan_fraction: float = 0.3             # of rows; features AND labels
+    # kill-mid-retrain: ContinualBooster(retrain_fault=spec.retrain_fault())
+    kill_retrain_at_iteration: Optional[int] = None
+    kill_retrain_times: int = 1
+
+    def retrain_fault(self) -> Optional[Dict[str, int]]:
+        if self.kill_retrain_at_iteration is None:
+            return None
+        return {"kill_at_iteration": int(self.kill_retrain_at_iteration),
+                "times": int(self.kill_retrain_times)}
+
+
+class DriftStream:
+    """Per-tick mini-batches; ``batch(t)`` is pure in ``(seed, t)``."""
+
+    def __init__(self, num_features: int = 6, rows: int = 256,
+                 seed: int = 0, spec: Optional[DriftSpec] = None,
+                 binary: bool = False, noise: float = 0.1):
+        self.f = int(num_features)
+        self.rows = int(rows)
+        self.seed = int(seed)
+        self.spec = spec or DriftSpec()
+        self.binary = bool(binary)
+        self.noise = float(noise)
+        self.coef = np.random.RandomState(seed).normal(size=self.f)
+
+    def batch(self, t: int):
+        """(X, y) for tick ``t`` — replayable in isolation: the RNG is
+        re-derived from (seed, t), never carried across ticks."""
+        sp = self.spec
+        rs = np.random.RandomState((self.seed * 1_000_003 + t)
+                                   % (2 ** 31 - 1))
+        X = rs.normal(size=(self.rows, self.f))
+        if (sp.covariate_shift_at is not None
+                and t >= sp.covariate_shift_at):
+            X = X + sp.covariate_shift
+        raw = X @ self.coef + self.noise * rs.normal(size=self.rows)
+        if self.binary:
+            y = (raw > np.median(raw)).astype(np.float64)
+            if sp.label_flip_at is not None and t >= sp.label_flip_at:
+                flip = rs.rand(self.rows) < sp.label_flip_fraction
+                y = np.where(flip, 1.0 - y, y)
+        else:
+            y = raw.astype(np.float64)
+            if sp.label_flip_at is not None and t >= sp.label_flip_at:
+                flip = rs.rand(self.rows) < sp.label_flip_fraction
+                y = np.where(flip, -y, y)
+        if (sp.nan_burst_at is not None and
+                sp.nan_burst_at <= t < sp.nan_burst_at
+                + sp.nan_burst_ticks):
+            bad = rs.rand(self.rows) < sp.nan_fraction
+            X = X.copy()
+            X[bad] = np.nan
+            y = y.copy()
+            y[bad] = np.nan
+        return X, y
+
+
+# ---------------------------------------------------------------------------
+# end-to-end drill scenarios
+# ---------------------------------------------------------------------------
+_DRILL_PARAMS = {
+    "objective": "regression", "num_leaves": 15, "learning_rate": 0.15,
+    "min_data_in_leaf": 5, "verbosity": -1, "metric": "",
+    "seed": 7, "num_iterations": 20,
+    "continual_window": 2, "continual_metric_threshold": 0.5,
+    "continual_rollback_window": 3, "continual_buffer_ticks": 4,
+    "continual_retrain_attempts": 3, "continual_backoff_base": 0.01,
+    "continual_cooldown": 2, "nonfinite_policy": "skip_iteration",
+}
+
+
+def run_drift_drill(scenario: str = "swap", rows: int = 256,
+                    features: int = 6, drift_at: int = 4,
+                    post_ticks: int = 6, seed: int = 11,
+                    checkpoint_dir: Optional[str] = None,
+                    params: Optional[Dict[str, Any]] = None
+                    ) -> Dict[str, Any]:
+    """One deterministic scenario end-to-end; returns a report dict.
+
+    * ``swap`` — covariate shift at ``drift_at``; a kill-mid-retrain is
+      armed once and resumes from checkpoint (when ``checkpoint_dir``
+      is given); expects detection within the window, a completed
+      retrain, a guarded swap with at most one compile per
+      (kind, bucket), and metric recovery.
+    * ``degrade`` — same drift, but every retrain attempt is killed and
+      no checkpoints exist: expects retry exhaustion and graceful
+      degradation to the last-good model (which keeps serving).
+    * ``rollback`` — no drift; a deliberately bad candidate is force-
+      swapped in; expects the watchdog to roll back within the rollback
+      window and post-rollback predictions bit-identical to pre-swap.
+    """
+    import time
+
+    from ..robustness.retry import ManualClock
+    from .runtime import ContinualBooster
+
+    p = dict(_DRILL_PARAMS)
+    p.update(params or {})
+    clk = ManualClock()
+
+    spec = DriftSpec()
+    retrain_fault = None
+    if scenario in ("swap", "degrade"):
+        spec.covariate_shift_at = drift_at
+        if scenario == "swap" and checkpoint_dir:
+            # die once past the first checkpoint; the retry resumes from
+            # it (PR 1 machinery) and completes bit-exact
+            # int(): the CLI path forwards key=value overrides as raw
+            # strings (Config parses them later; this arithmetic won't)
+            interval = max(int(p.get("continual_retrain_rounds")
+                               or p["num_iterations"]) // 4, 1)
+            spec.kill_retrain_at_iteration = interval + 1
+            spec.kill_retrain_times = 1
+        elif scenario == "degrade":
+            spec.kill_retrain_at_iteration = 1
+            spec.kill_retrain_times = 10 ** 6   # every attempt dies
+        retrain_fault = spec.retrain_fault()
+
+    stream = DriftStream(num_features=features, rows=rows, seed=seed,
+                         spec=spec)
+    warm = DriftStream(num_features=features, rows=4 * rows, seed=seed + 1)
+    X0, y0 = warm.batch(0)
+    cb = ContinualBooster(p, X0, y0, checkpoint_dir=checkpoint_dir,
+                          retrain_fault=retrain_fault,
+                          sleep=clk.sleep, clock=clk)
+
+    report: Dict[str, Any] = {"scenario": scenario, "rows": rows,
+                              "drift_at": drift_at, "ticks": []}
+    t0 = time.perf_counter()
+    detect_tick = swap_tick = degrade_tick = rollback_tick = None
+    n_ticks = drift_at + post_ticks
+
+    if scenario == "rollback":
+        # stable stream; swap in a deliberately bad candidate mid-run
+        from ..basic import Dataset
+        from ..engine import train as _train
+        for t in range(drift_at):
+            cb.tick(*stream.batch(t))
+        Xg, yg = stream.batch(drift_at)
+        pre_pred = cb.predict(Xg, raw_score=True)
+        Xb = X0[:64]
+        bad = _train({**cb._train_params(), "num_iterations": 1,
+                      "learning_rate": 1e-6},
+                     Dataset(Xb, label=-10.0 * np.ones(len(Xb))),
+                     num_boost_round=1)
+        cb.force_swap(bad, gate=(Xg, yg))
+        swap_tick = drift_at
+        for t in range(drift_at, n_ticks):
+            r = cb.tick(*stream.batch(t))
+            if r.rolled_back and rollback_tick is None:
+                rollback_tick = t
+                break
+        post_pred = cb.predict(Xg, raw_score=True)
+        report["rollback_tick"] = rollback_tick
+        report["rollback_within"] = (
+            rollback_tick is not None and
+            rollback_tick - swap_tick <= cb.cfg.continual_rollback_window)
+        report["pre_post_identical"] = bool(
+            np.array_equal(np.asarray(pre_pred), np.asarray(post_pred)))
+        report["swap_tick"] = swap_tick
+    else:
+        for t in range(n_ticks):
+            r = cb.tick(*stream.batch(t))
+            report["ticks"].append(r.to_json())
+            if r.drift_detected and detect_tick is None:
+                detect_tick = t
+            if r.swapped and swap_tick is None:
+                swap_tick = t
+                report["swap_new_traces"] = {
+                    str(k): v for k, v in r.swap_new_traces.items()}
+                report["swap_latency_s"] = r.swap_latency_s
+                report["retrain_attempts"] = r.retrain_attempts
+            if r.degraded and degrade_tick is None:
+                degrade_tick = t
+        report["detect_tick"] = detect_tick
+        report["swap_tick"] = swap_tick
+        report["degrade_tick"] = degrade_tick
+        report["detected_within_window"] = (
+            detect_tick is not None and
+            detect_tick - drift_at <= 2 * cb.cfg.continual_window)
+        if swap_tick is not None:
+            traces = list(report["swap_new_traces"].values())
+            report["one_trace_per_key"] = all(v <= 1 for v in traces)
+            post = [r["metric"] for r in report["ticks"][swap_tick + 1:]]
+            drifted = [r["metric"] for r in
+                       report["ticks"][drift_at:swap_tick + 1]]
+            report["metric_recovered"] = bool(
+                post and np.mean(post) < np.mean(drifted))
+        if scenario == "degrade":
+            # the last-good model must still be the one serving
+            report["still_serving"] = bool(
+                np.isfinite(cb.predict(stream.batch(n_ticks)[0],
+                                       raw_score=True)).all())
+            report["generation"] = cb.generation
+    report["wall_s"] = round(time.perf_counter() - t0, 3)
+    report["history"] = [round(float(m), 6) for m in cb.history]
+    report["final_generation"] = cb.generation
+    return report

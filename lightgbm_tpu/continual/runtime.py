@@ -1,0 +1,582 @@
+"""ContinualBooster: an online serving + freshness loop over one model.
+
+LLM serving stacks treat model hot-swap with automatic rollback as
+table stakes (cf. the Gemma-on-TPU serving comparison, arXiv:2605.25645);
+inference accelerators like Booster (arXiv:2011.02022) assume the
+forest being served is *current*.  This module is the loop that makes
+that true for a jax_graft forest under drift, crashes, and bad data:
+
+Each :meth:`ContinualBooster.tick` ingests one fresh mini-dataset and
+
+1. **evaluates prequentially** — predict-then-learn: the tick metric
+   scores the SERVED model on data it has not seen, the classic online
+   evaluation protocol;
+2. **refits leaf values on-device** via ``Booster.refit(decay_rate,
+   inplace=True)`` — tree structures stay, leaf outputs blend toward
+   the fresh gradients; the serving engine takes the leaf-only
+   refresh path (one small transfer, zero re-traces), and the
+   ``nonfinite_policy`` guard rails protect the refit gradients from
+   poisoned batches exactly like full training iterations;
+3. **detects regression** over a windowed eval history: mean of the
+   last ``continual_window`` tick metrics vs the window before, with a
+   configurable relative threshold;
+4. on regression, **retrains from the recent-batch buffer** through
+   ``robustness/retry.py`` (seeded jitter — replays are
+   bit-reproducible) with PR 1 checkpoint/resume inside each retry, so
+   a kill mid-retrain resumes bit-exact instead of restarting; retry
+   exhaustion degrades gracefully to the last-good model;
+5. **hot-swaps atomically with a gate**: the candidate must not be
+   worse than the served model on the gate batch; the swap warms the
+   candidate's serving pack FIRST (exactly one compile per
+   (kind, bucket)), then installs it with a single reference
+   assignment — concurrent readers see the old pack or the new one,
+   never a mix, and the ServingEngine's mutation-counter keys make a
+   stale compiled program impossible by construction;
+6. **watches for post-swap regression** for ``continual_rollback_window``
+   ticks and rolls back to the pre-swap booster — whose engine still
+   holds its own packs keyed by its own model version, so post-rollback
+   predictions are bit-identical to the pre-swap pack.
+
+Every failure path is reproducible without real traffic through the
+deterministic drift harness (:mod:`lightgbm_tpu.continual.drift`).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..config import Config
+from ..robustness import faultinject
+from ..robustness.retry import retry_with_backoff
+from ..utils import log
+from ..utils.log import LightGBMError
+
+_EPS = 1e-12
+# history/reports retention cap (entries kept: _RETAIN/2 after a trim);
+# far above any window/drill size, small enough to serve for months
+_RETAIN = 4096
+
+
+# ---------------------------------------------------------------------------
+# tick metrics (lower is better, host numpy — never a device sync)
+# ---------------------------------------------------------------------------
+def resolve_metric(name: str, objective: str) -> str:
+    name = (name or "auto").lower()
+    if name != "auto":
+        return name
+    if objective in ("binary", "cross_entropy", "cross_entropy_lambda"):
+        return "binary_logloss"
+    if objective in ("multiclass", "multiclassova"):
+        return "multi_logloss"
+    return "l2"
+
+
+def tick_metric(name: str, y: np.ndarray, raw: np.ndarray) -> float:
+    """Lower-is-better metric of RAW scores against labels, computed on
+    the host in float64 (the tick loop must not add device syncs)."""
+    y = np.asarray(y, np.float64)
+    raw = np.asarray(raw, np.float64)
+    if name == "binary_logloss":
+        p = 1.0 / (1.0 + np.exp(-raw.reshape(-1)))
+        p = np.clip(p, 1e-15, 1.0 - 1e-15)
+        return float(-np.mean(y * np.log(p) + (1.0 - y) * np.log(1.0 - p)))
+    if name == "multi_logloss":
+        z = raw - raw.max(axis=1, keepdims=True)
+        p = np.exp(z)
+        p /= p.sum(axis=1, keepdims=True)
+        rows = np.arange(len(y))
+        return float(-np.mean(np.log(
+            np.clip(p[rows, y.astype(np.int64)], 1e-15, None))))
+    if name in ("l2", "mse"):
+        return float(np.mean((raw.reshape(-1) - y) ** 2))
+    raise LightGBMError(f"unsupported continual_metric: {name}")
+
+
+# ---------------------------------------------------------------------------
+# per-tick report
+# ---------------------------------------------------------------------------
+@dataclass
+class TickReport:
+    tick: int
+    n_rows: int = 0
+    metric: float = float("nan")
+    generation: int = 0
+    refit_applied: bool = False
+    refit_skipped: bool = False          # guard skipped every iteration
+    drift_detected: bool = False
+    retrain_attempts: int = 0
+    retrain_completed: bool = False
+    retrain_failed: bool = False         # retry budget exhausted: degraded
+    swapped: bool = False
+    swap_rejected: bool = False          # candidate lost the gate
+    swap_latency_s: float = 0.0
+    swap_new_traces: Dict[Any, int] = field(default_factory=dict)
+    rolled_back: bool = False
+    degraded: bool = False               # serving last-good after failures
+    notes: List[str] = field(default_factory=list)
+
+    def to_json(self) -> Dict[str, Any]:
+        d = dict(self.__dict__)
+        d["swap_new_traces"] = {str(k): v
+                                for k, v in self.swap_new_traces.items()}
+        return d
+
+
+# ---------------------------------------------------------------------------
+# the runtime
+# ---------------------------------------------------------------------------
+class ContinualBooster:
+    """Serve one forest and keep it fresh (see module docstring).
+
+    ``params`` are ordinary training params plus the ``continual_*``
+    family (config.py); ``data``/``label`` train the initial model.
+
+    ``checkpoint_dir`` (optional) roots per-generation retrain
+    checkpoints so a killed retrain RESUMES bit-exact on the next retry
+    instead of restarting; without it, retries restart from scratch.
+
+    ``retrain_fault`` (drills only) arms a deterministic
+    ``kill_at_iteration`` fault for the first ``times`` retrain
+    attempts — the kill-mid-retrain scenario of the drift harness.
+    Incompatible with ``background=True`` (fault-injection state is
+    process-global; kill drills run synchronous).
+
+    ``sleep``/``clock`` thread through to the retry/backoff policy so
+    tier-1 drills replay instantly and bit-reproducibly
+    (robustness/retry.py ManualClock).
+    """
+
+    def __init__(self, params: Dict[str, Any], data, label, weight=None,
+                 *, checkpoint_dir: Optional[str] = None,
+                 background: bool = False,
+                 retrain_fault: Optional[Dict[str, int]] = None,
+                 sleep: Optional[Callable[[float], None]] = None,
+                 clock: Optional[Callable[[], float]] = None,
+                 initial_rounds: Optional[int] = None):
+        from ..basic import Dataset
+        from ..engine import train as _train
+        self.params = dict(params)
+        self.cfg = Config(self.params)
+        self.metric_name = resolve_metric(self.cfg.continual_metric,
+                                          self.cfg.objective)
+        self.checkpoint_dir = checkpoint_dir
+        self.background = bool(background)
+        self._sleep = sleep if sleep is not None else time.sleep
+        self._clock = clock if clock is not None else time.monotonic
+        if retrain_fault and background:
+            # faultinject state is process-GLOBAL: arming it from the
+            # background worker would kill concurrent foreground
+            # training and its clear() would disarm other injections —
+            # drills that need the kill fault run synchronous
+            raise LightGBMError(
+                "retrain_fault cannot be combined with background=True "
+                "(fault injection is process-global, not thread-local)")
+        self._retrain_fault = dict(retrain_fault) if retrain_fault else None
+        self._fault_remaining = int(
+            (retrain_fault or {}).get("times", 1)) if retrain_fault else 0
+
+        rounds = initial_rounds or self.cfg.num_iterations
+        self.booster = _train(self._train_params(),
+                              Dataset(np.asarray(data), label=label,
+                                      weight=weight),
+                              num_boost_round=rounds)
+        self._warm(self.booster)
+
+        self.tick_no = 0
+        self.generation = 0
+        self.history: List[float] = []
+        self.buffer: deque = deque(maxlen=max(
+            int(self.cfg.continual_buffer_ticks), 1))
+        self.reports: List[TickReport] = []
+        self.last_good: Optional[Any] = None
+        self._watch_left = 0
+        self._pre_swap_baseline: Optional[float] = None
+        self._cooldown = 0
+        self._bg: Optional[Dict[str, Any]] = None
+        self._gate: Optional[Tuple[np.ndarray, np.ndarray]] = None
+
+    # -- plumbing -------------------------------------------------------
+    def _train_params(self) -> Dict[str, Any]:
+        p = dict(self.params)
+        # retrain checkpointing is managed per generation below; the
+        # caller's checkpoint params must not leak into the initial fit
+        for k in ("checkpoint_dir", "checkpoint_interval",
+                  "checkpoint_resume"):
+            p.pop(k, None)
+        return p
+
+    def _warm(self, bst) -> None:
+        """Serving-shaped traffic: small tick batches must serve from
+        the device pack, so the engine's cold-row gate lifts.  Both
+        pack families warm: a kill+resumed retrain restores its head
+        trees host-side (no bin-space device arrays), and such a
+        candidate serves through the loaded (threshold-index) pack."""
+        g = bst._gbdt
+        g._flush_pending()
+        g.serving.mark_rewarm(("insession", "loaded"))
+
+    def _raw(self, X: np.ndarray) -> np.ndarray:
+        return np.asarray(self.booster.predict(np.asarray(X),
+                                               raw_score=True))
+
+    def predict(self, X, **kw):
+        """Serve from the current model (atomic against swaps: the
+        booster reference flips in one assignment)."""
+        return self.booster.predict(np.asarray(X), **kw)
+
+    @property
+    def serving_engine(self):
+        return self.booster._gbdt.serving
+
+    # -- the tick -------------------------------------------------------
+    def tick(self, X, y, weight=None) -> TickReport:
+        """Ingest one fresh mini-dataset; returns what happened."""
+        X = np.asarray(X, np.float64)
+        y = np.asarray(y, np.float64)
+        r = TickReport(tick=self.tick_no, n_rows=len(X),
+                       generation=self.generation)
+
+        # background retrain landed? gate + swap before anything reads
+        # the new batch, so this tick already serves the fresher model
+        self._poll_background(r)
+
+        # 1. prequential eval of the SERVED model.  A non-finite metric
+        # (NaN-burst labels) carries no evidence either way: appending
+        # it would poison every window mean — blinding detection for
+        # 2*W ticks and permanently disarming a watchdog whose baseline
+        # captured the NaN — so it is reported but never enters history
+        raw = self._raw(X)
+        r.metric = tick_metric(self.metric_name, y, raw)
+        if np.isfinite(r.metric):
+            self.history.append(r.metric)
+            # the swap gate keeps the last batch whose metric was
+            # JUDGEABLE: a NaN gate batch would make both gate metrics
+            # NaN and the rejection comparison vacuously False —
+            # silently installing an ungated candidate
+            self._gate = (X, y)
+        else:
+            r.notes.append("non-finite tick metric excluded from the "
+                           "detection history and the swap gate")
+        self.buffer.append((X, y, weight))
+
+        # 2. rollback watchdog (runs BEFORE drift detection: a bad swap
+        # must roll back, not trigger another retrain of the bad model)
+        if self._watch_left > 0:
+            self._watchdog(r)
+
+        # 3. drift / regression detection -> retrain
+        elif self._should_detect() and self._regressed():
+            r.drift_detected = True
+            log.warning("continual: metric regression detected at tick "
+                        "%d (window=%d, threshold=%.3f)", self.tick_no,
+                        self.cfg.continual_window,
+                        self.cfg.continual_metric_threshold)
+            self._start_retrain(r)
+
+        # 4. leaf refit on the fresh batch (after eval: predict-then-
+        # learn).  A tick that just rolled back serves the last-good
+        # pack VERBATIM — that bit-identity is what makes rollbacks
+        # auditable — so refit resumes on the next tick
+        if not r.rolled_back:
+            self._refit(X, y, weight, r)
+
+        if self._cooldown > 0:
+            self._cooldown -= 1
+        r.generation = self.generation
+        self.reports.append(r)
+        # a forever-runtime must not grow without bound: detection only
+        # reads the last 2*W history entries and reports are drill/ops
+        # telemetry — keep a generous tail, drop the ancient head
+        if len(self.history) > _RETAIN:
+            del self.history[:-_RETAIN // 2]
+        if len(self.reports) > _RETAIN:
+            del self.reports[:-_RETAIN // 2]
+        self.tick_no += 1
+        return r
+
+    # -- refit ----------------------------------------------------------
+    def _refit(self, X, y, weight, r: TickReport) -> None:
+        try:
+            self.booster.refit(
+                X, y, weight=weight,
+                decay_rate=self.cfg.refit_decay_rate, inplace=True)
+            r.refit_applied = True
+            guard = getattr(self.booster, "_refit_guard", None)
+            r.refit_skipped = bool(guard is not None
+                                   and guard.skipped_iterations)
+        except LightGBMError as exc:
+            # nonfinite_policy=raise aborts the refit loudly; the
+            # runtime keeps serving the pre-refit model (the refit
+            # commits out of place, so nothing was half-applied)
+            r.notes.append(f"refit aborted: {exc}")
+            log.warning("continual: refit aborted at tick %d: %s",
+                        self.tick_no, exc)
+
+    # -- drift detection -------------------------------------------------
+    def _should_detect(self) -> bool:
+        return (self._cooldown == 0 and self._watch_left == 0
+                and self._bg is None
+                and len(self.history) >= 2 * self.cfg.continual_window)
+
+    def _regressed(self) -> bool:
+        W = self.cfg.continual_window
+        recent = float(np.mean(self.history[-W:]))
+        base = float(np.mean(self.history[-2 * W:-W]))
+        thr = self.cfg.continual_metric_threshold
+        return recent > base * (1.0 + thr) + _EPS
+
+    # -- retrain ---------------------------------------------------------
+    def _retrain_dataset(self, batches):
+        """``batches`` is a snapshot taken on the TICK thread: the live
+        deque keeps growing while a background retrain reads, and
+        iterating it concurrently would crash — or worse, pair one
+        snapshot's features with another's labels."""
+        from ..basic import Dataset
+        Xs = np.concatenate([b[0] for b in batches], axis=0)
+        ys = np.concatenate([np.asarray(b[1]) for b in batches], axis=0)
+        ws = None
+        if any(b[2] is not None for b in batches):
+            ws = np.concatenate(
+                [np.asarray(b[2]) if b[2] is not None
+                 else np.ones(len(b[0])) for b in batches], axis=0)
+        # NaN-burst labels would poison the retrain from the start;
+        # drop unlabeled rows (features may keep NaN — trees route them)
+        keep = np.isfinite(ys) if ys.ndim == 1 else np.isfinite(
+            ys).all(axis=1)
+        if not keep.all():
+            Xs, ys = Xs[keep], ys[keep]
+            ws = ws[keep] if ws is not None else None
+        return Dataset(Xs, label=ys, weight=ws)
+
+    def _retrain_once(self, tag: str, attempt_state: Dict[str, int],
+                      batches):
+        """One retrain attempt: full training over the buffer, with PR 1
+        checkpoint/resume riding inside so a kill resumes bit-exact.
+        ``tag`` is unique per retrain CYCLE (generation + starting
+        tick): attempts within a cycle share the directory (that is
+        what resume needs), but a later cycle at the same generation —
+        after a degrade — must never resume a stale checkpoint trained
+        on an older buffer snapshot (checkpoint.py: one training run
+        per checkpoint_dir)."""
+        from ..engine import train as _train
+        attempt_state["n"] += 1
+        p = self._train_params()
+        rounds = self.cfg.continual_retrain_rounds or self.cfg.num_iterations
+        ckpt = None
+        if self.checkpoint_dir:
+            import os
+            ckpt = os.path.join(self.checkpoint_dir, f"retrain_{tag}")
+            p["checkpoint_dir"] = ckpt
+            p["checkpoint_interval"] = (self.cfg.checkpoint_interval
+                                        or max(rounds // 4, 1))
+        resume = attempt_state["n"] > 1 and ckpt is not None
+        ds = self._retrain_dataset(batches)
+        armed = None
+        if self._retrain_fault is not None and self._fault_remaining > 0:
+            self._fault_remaining -= 1
+            armed = int(self._retrain_fault["kill_at_iteration"])
+        try:
+            if armed is not None:
+                with faultinject.injected(kill_at_iteration=armed):
+                    return _train(p, ds, num_boost_round=rounds,
+                                  resume=resume)
+            return _train(p, ds, num_boost_round=rounds, resume=resume)
+        finally:
+            del ds
+
+    def _start_retrain(self, r: TickReport) -> None:
+        gen = self.generation
+        tag = f"g{gen}_t{self.tick_no}"
+        attempt_state = {"n": 0}
+        batches = list(self.buffer)   # snapshot ON the tick thread
+
+        def cleanup():
+            # the cycle is over (candidate built, or retries exhausted):
+            # its checkpoints have served their purpose — a later cycle
+            # uses its own tag — so a long-running loop must not leak a
+            # directory per retrain
+            if self.checkpoint_dir:
+                import os
+                import shutil
+                shutil.rmtree(os.path.join(self.checkpoint_dir,
+                                           f"retrain_{tag}"),
+                              ignore_errors=True)
+
+        def run():
+            try:
+                return retry_with_backoff(
+                    lambda: self._retrain_once(tag, attempt_state,
+                                               batches),
+                    attempts=self.cfg.continual_retrain_attempts,
+                    base_delay=self.cfg.continual_backoff_base,
+                    jitter=self.cfg.continual_backoff_jitter,
+                    seed=self.cfg.seed + gen,
+                    describe=f"continual retrain (generation {gen})",
+                    sleep=self._sleep, clock=self._clock)
+            finally:
+                cleanup()
+
+        if self.background:
+            holder: Dict[str, Any] = {"done": False}
+
+            def worker():
+                try:
+                    holder["result"] = run()
+                except BaseException as exc:   # surfaced at the poll
+                    holder["error"] = exc
+                # "done" flips LAST: the poll reads attempts/result/
+                # error only after observing it
+                holder["attempts"] = attempt_state["n"]
+                holder["done"] = True
+
+            t = threading.Thread(target=worker, daemon=True,
+                                 name=f"continual-retrain-g{gen}")
+            holder["thread"] = t
+            self._bg = holder
+            t.start()
+            r.notes.append("retrain started in background")
+            return
+
+        try:
+            cand = run()
+            r.retrain_attempts = attempt_state["n"]
+            r.retrain_completed = True
+            self._gate_and_swap(cand, r)
+        except LightGBMError as exc:
+            # retry budget exhausted: graceful degradation — the served
+            # model stays up (it IS the last-good pack) and detection
+            # cools down instead of hammering the failing retrain
+            r.retrain_attempts = attempt_state["n"]
+            r.retrain_failed = True
+            r.degraded = True
+            self._cooldown = self.cfg.continual_cooldown
+            r.notes.append(f"retrain failed, serving last-good: {exc}")
+            log.warning("continual: retrain failed after %d attempt(s); "
+                        "degrading to the last-good model: %s",
+                        attempt_state["n"], exc)
+
+    def _poll_background(self, r: TickReport) -> None:
+        if self._bg is None or not self._bg.get("done"):
+            return
+        holder, self._bg = self._bg, None
+        r.retrain_attempts = int(holder.get("attempts", 0))
+        err = holder.get("error")
+        if err is not None:
+            r.retrain_failed = True
+            r.degraded = True
+            self._cooldown = self.cfg.continual_cooldown
+            r.notes.append(f"background retrain failed: {err}")
+            return
+        r.retrain_completed = True
+        self._gate_and_swap(holder["result"], r)
+
+    # -- guarded atomic swap ---------------------------------------------
+    def _gate_and_swap(self, cand, r: TickReport) -> None:
+        """Candidate gate: it must not be WORSE than the served model on
+        the gate batch (beyond ``continual_swap_margin``) — a retrain
+        over a poisoned buffer must not replace a healthy model.  The
+        gate prediction doubles as the candidate's pack warm-up, so a
+        whole swap costs exactly one compile per (kind, bucket)."""
+        t0 = time.perf_counter()
+        self._warm(cand)
+        snap = cand._gbdt.serving.trace_snapshot()
+        if self._gate is not None:
+            Xg, yg = self._gate
+            cur_m = tick_metric(self.metric_name, yg, self._raw(Xg))
+            cand_m = tick_metric(self.metric_name, yg, np.asarray(
+                cand.predict(Xg, raw_score=True)))
+            margin = self.cfg.continual_swap_margin
+            if cand_m > cur_m * (1.0 + margin) + _EPS:
+                r.swap_rejected = True
+                self._cooldown = self.cfg.continual_cooldown
+                r.notes.append(f"swap rejected: candidate {cand_m:.6g} "
+                               f"vs served {cur_m:.6g}")
+                log.warning("continual: swap rejected (candidate %.6g "
+                            "worse than served %.6g on the gate batch)",
+                            cand_m, cur_m)
+                return
+        self._swap(cand, r, snap, t0)
+
+    def _swap(self, cand, r: TickReport,
+              snap: Optional[Dict[Any, int]] = None,
+              t0: Optional[float] = None) -> None:
+        if t0 is None:
+            t0 = time.perf_counter()
+        if snap is None:
+            # direct path (force_swap): warm-probe BEFORE the candidate
+            # serves — pack build plus at most one compile per (kind,
+            # bucket) happens here, off the serving path, so the first
+            # post-swap predict is hot.  The gated path already paid
+            # exactly this during the gate comparison (snap was taken
+            # there); re-running it would double the gate inference and
+            # inflate the reported swap latency.
+            self._warm(cand)
+            snap = cand._gbdt.serving.trace_snapshot()
+            if self._gate is not None:
+                cand.predict(self._gate[0], raw_score=True)
+        r.swap_new_traces = cand._gbdt.serving.new_traces_since(snap)
+        W = self.cfg.continual_window
+        self._pre_swap_baseline = (float(np.mean(self.history[-W:]))
+                                   if self.history else None)
+        self.last_good = self.booster
+        self.booster = cand          # the atomic step: one reference flip
+        r.swapped = True
+        r.swap_latency_s = time.perf_counter() - t0
+        self.generation += 1
+        self._watch_left = self.cfg.continual_rollback_window
+        self._cooldown = self.cfg.continual_cooldown
+        log.info("continual: swapped in generation %d (%.1f ms, traces "
+                 "%s)", self.generation, 1e3 * r.swap_latency_s,
+                 r.swap_new_traces)
+
+    def force_swap(self, cand, gate: Optional[Tuple] = None) -> TickReport:
+        """Install an externally built model (drills / operator push),
+        skipping the gate but keeping the rollback watchdog armed."""
+        r = TickReport(tick=self.tick_no, generation=self.generation)
+        if gate is not None:
+            self._gate = (np.asarray(gate[0], np.float64),
+                          np.asarray(gate[1], np.float64))
+        self._swap(cand, r)
+        self.reports.append(r)
+        return r
+
+    # -- rollback watchdog -----------------------------------------------
+    def _watchdog(self, r: TickReport) -> None:
+        if not np.isfinite(r.metric):
+            return                  # no evidence: the tick doesn't count
+        base = self._pre_swap_baseline
+        thr = self.cfg.continual_metric_threshold
+        if base is not None and r.metric > base * (1.0 + thr) + _EPS:
+            self.rollback(r)
+        else:
+            self._watch_left -= 1
+            if self._watch_left == 0:
+                # swap confirmed healthy; the pre-swap model stays
+                # available for a manual rollback but stops being watched
+                self._pre_swap_baseline = None
+
+    def rollback(self, r: Optional[TickReport] = None) -> bool:
+        """Restore the pre-swap booster.  Its serving engine still holds
+        its own packs keyed by its own (length, mutation-counter)
+        signature — the rolled-back model can never serve the swapped
+        model's compiled state, and its predictions are bit-identical
+        to the pre-swap pack."""
+        if self.last_good is None:
+            return False
+        self.booster, self.last_good = self.last_good, None
+        self.generation += 1
+        self._watch_left = 0
+        self._pre_swap_baseline = None
+        self._cooldown = self.cfg.continual_cooldown
+        if r is not None:
+            r.rolled_back = True
+            r.generation = self.generation
+        log.warning("continual: rolled back to the pre-swap model "
+                    "(generation %d)", self.generation)
+        return True

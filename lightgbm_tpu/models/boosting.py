@@ -2027,6 +2027,49 @@ class GBDT:
             return dev.reshape(n, K * (nf + 1))
         return host_contrib(self, data, start_iteration, num_iteration)
 
+    def apply_refit_leaf_values(self, new_values) -> None:
+        """Commit refit leaf values IN PLACE (Booster.refit(inplace=True)
+        and the continual-training runtime's per-tick refit): rewrite
+        every host tree's leaf values, mirror them into the device-tree
+        delta arrays, and bump the serving mutation counter EAGERLY —
+        like update/rollback already do — so a pack warmed before the
+        refit can never serve pre-refit values.  The warm in-session
+        pack takes the leaf-only fast path (serving.refit_leaf_values):
+        its stacked node arrays survive and only the small delta rows
+        re-transfer, so a refit tick never re-packs or re-traces.
+
+        ``new_values`` holds one array per tree, already shrunk and
+        (for the first iteration's trees) already carrying the
+        boost-from-average fold — the refit accumulation is
+        self-contained, so ``init_scores`` zeroes like continue_from.
+
+        In-place refit is a SERVING mutation: the training-side scores
+        and physical fused state are no longer consistent with the
+        model, so continued ``train_one_iter`` after it is unsupported
+        (train via a fresh booster / init_model instead)."""
+        self._flush_pending()
+        if len(new_values) != len(self.models):
+            raise ValueError(
+                f"refit produced {len(new_values)} leaf arrays for "
+                f"{len(self.models)} trees")
+        for ti, vals in enumerate(new_values):
+            vals = np.asarray(vals, dtype=np.float64)
+            tree = self.models[ti]
+            tree.leaf_value = vals.copy()
+            if ti < len(self.device_trees):
+                dt = self.device_trees[ti]
+                if dt is not None:
+                    slot = np.zeros(dt["leaf_value"].shape, np.float32)
+                    n = min(len(vals), slot.shape[0])
+                    slot[:n] = vals[:n]
+                    dt["leaf_value"] = jnp.asarray(slot)
+        self.init_scores = [0.0] * self.num_tree_per_iteration
+        # training-side state is stale from here on (see docstring)
+        self._phys = None
+        self._model_version += 1
+        self.serving.refit_leaf_values(
+            [np.asarray(v, np.float64) for v in new_values])
+
     def rollback_one_iter(self) -> None:
         """reference: gbdt.cpp RollbackOneIter:443."""
         self._flush_pending()

@@ -161,6 +161,79 @@ class ServingEngine:
                 "calls": dict(self.call_counts),
                 "packs": sorted(self._packs)}
 
+    def trace_snapshot(self) -> Dict[Any, int]:
+        """Copy of the (kind, bucket) -> trace-count map, for callers
+        (the continual runtime's drift drill, the jaxlint tier-B tick
+        budget) that assert how many NEW compiles an operation cost."""
+        return dict(self.trace_counts)
+
+    def new_traces_since(self, snapshot: Dict[Any, int]) -> Dict[Any, int]:
+        """Traces added since ``snapshot`` (positive deltas only)."""
+        out = {}
+        for k, v in self.trace_counts.items():
+            d = v - snapshot.get(k, 0)
+            if d > 0:
+                out[k] = d
+        return out
+
+    def refit_leaf_values(self, new_values) -> None:
+        """Leaf-only mutation fast path.  ``GBDT.apply_refit_leaf_values``
+        commits through here AFTER bumping the model version: a refit
+        changes every tree's leaf values but NO structure, so the warm
+        in-session raw pack keeps its stacked node arrays and only the
+        small per-class delta matrices re-transfer — a refit tick in
+        the continual runtime costs one (T_k, L) device put instead of
+        a full forest re-pack, and zero re-traces (shapes unchanged).
+        The refreshed packs are re-keyed to the CURRENT signature, so
+        the mutation counter still gates staleness exactly as for a
+        full re-pack.  The same refresh applies to the loaded
+        (threshold-index) pack — its per-tree leaf-value matrix is the
+        only thing a refit changes.  Everything else (contrib path
+        matrices carry leaf values; range sub-packs hold stale slices)
+        drops and rebuilds lazily."""
+        self._range_packs.clear()
+        self._packs.pop("contrib", None)
+        g = self.gbdt
+        # the pack must be EXACTLY one version behind (the caller just
+        # bumped it): a length-only check would resurrect a pack some
+        # earlier mutation left version-stale under a fresh signature
+        prev_sig = (len(g.models), g._model_version - 1)
+
+        def stack(vals, W):
+            mat = np.zeros((len(vals), W), np.float32)
+            for i, v in enumerate(vals):
+                n = min(len(v), W)
+                mat[i, :n] = np.asarray(v)[:n]
+            return jnp.asarray(mat)
+
+        for name in ("insession", "loaded"):
+            hit = self._packs.get(name)
+            if hit is None:
+                continue
+            key, pack = hit
+            if key != prev_sig or len(new_values) != len(g.models):
+                # stale or structurally changed: no fast path
+                self._packs.pop(name, None)
+                continue
+            # refresh OUT OF PLACE and install with one reference
+            # assignment: a concurrent predict grabs the pack once per
+            # call, so it sees all-old or all-new leaf values — never
+            # class 0 post-refit paired with class 1 pre-refit
+            K = pack["K"]
+            fresh = dict(pack)
+            fresh["per_k"] = list(pack["per_k"])
+            for k in range(K):
+                vals = new_values[k::K]
+                if name == "insession":
+                    pk = dict(pack["per_k"][k])
+                    pk["deltas"] = stack(vals, int(pk["deltas"].shape[1]))
+                    fresh["per_k"][k] = pk
+                else:
+                    node, lv = pack["per_k"][k]
+                    fresh["per_k"][k] = (node, stack(vals,
+                                                     int(lv.shape[1])))
+            self._packs[name] = (self._sig(), fresh)
+
     # -- jitted predictors (one per kind; jit caches per shape) ---------
     def _fn(self, kind: str):
         if kind in self._fns:

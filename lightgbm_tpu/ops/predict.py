@@ -69,6 +69,12 @@ def predict_leaf_thridx(packed_vals: jnp.ndarray, node: dict) -> jnp.ndarray:
                                 (mtype == 1) & zeroish)
             goes_left = jnp.where(missing, dleft != 0, b_eff <= kidx)
             nxt = jnp.where(goes_left, left, right)
+            # see predict_leaf_binned: vmapped cond runs this branch for
+            # empty trees too; terminate them on leaf 0 (the loaded
+            # pack's -1-initialized children already do, but a zero-
+            # node tree whose arrays were stacked differently must not
+            # hang the whole forest's while loop)
+            nxt = jnp.where(node["num_nodes"] > 0, nxt, jnp.int32(-1))
             return jnp.where(active, nxt, c)
 
         final = jax.lax.while_loop(cond, body, cur)
@@ -154,6 +160,13 @@ def predict_leaf_binned(binned: jnp.ndarray, node: dict,
                 member = member & (fb <= nb - 1)
                 goes_left = jnp.where(rows[10] == 1, member, goes_left)
             nxt = jnp.where(goes_left, left, right)
+            # empty tree: land on leaf 0 immediately.  The num_nodes>0
+            # cond below short-circuits the plain call, but under vmap
+            # (the serving engine's stacked forests) cond lowers to a
+            # select that RUNS this branch for every tree — an empty
+            # tree's slot-0 children point back at node 0 and the while
+            # loop would never terminate for the whole batch.
+            nxt = jnp.where(num_nodes > 0, nxt, jnp.int32(-1))
             return jnp.where(active, nxt, c)
 
         final = jax.lax.while_loop(cond, body, cur)

@@ -1,19 +1,68 @@
-"""Exponential backoff with a deadline.
+"""Exponential backoff with a deadline — deterministically replayable.
 
-The distributed bootstrap (``jax.distributed.initialize``) and anything
-else that talks to a flaky coordinator retries through here; the policy
-is the standard large-TPU one (cf. PAPERS.md, Gemma-on-TPU ops
-practice): capped exponential backoff, a wall-clock deadline, and a
+The distributed bootstrap (``jax.distributed.initialize``), the
+continual-training runtime's background retrains, and anything else
+that talks to a flaky dependency retries through here; the policy is
+the standard large-TPU one (cf. PAPERS.md, Gemma-on-TPU ops practice):
+capped exponential backoff with optional jitter, a deadline, and a
 clear terminal error instead of a hang.
+
+Every source of nondeterminism is threaded explicitly so fault-
+injection replays (kill + resume drills) are bit-reproducible:
+
+* the delay sequence is a PURE function of the policy arguments —
+  :func:`backoff_schedule` — with jitter drawn from a SEEDED stream,
+  never from process-global randomness;
+* elapsed time for the deadline check comes from an injectable
+  ``clock`` (default ``time.monotonic``), so tests that stub ``sleep``
+  pair it with a :class:`ManualClock` and the out-of-budget decision
+  depends only on the scheduled delays, not on how long the attempt
+  bodies really took on the wall clock.
 """
 
 from __future__ import annotations
 
+import random
 import time
-from typing import Callable, Optional, Tuple, Type
+from typing import Callable, List, Optional, Tuple, Type
 
 from ..utils import log
 from ..utils.log import LightGBMError
+
+
+class ManualClock:
+    """A virtual clock for deterministic retry replays: ``clock()``
+    returns the accumulated virtual time and ``sleep(d)`` advances it —
+    pass both to :func:`retry_with_backoff` and the whole retry
+    schedule (including the deadline cut-off) replays identically on
+    every run, however long the attempts themselves take."""
+
+    def __init__(self, start: float = 0.0):
+        self.now = float(start)
+
+    def __call__(self) -> float:
+        return self.now
+
+    def sleep(self, seconds: float) -> None:
+        self.now += float(seconds)
+
+
+def backoff_schedule(attempts: int, base_delay: float = 1.0,
+                     max_delay: float = 30.0, jitter: float = 0.0,
+                     seed: int = 0) -> List[float]:
+    """The exact delay sequence a :func:`retry_with_backoff` call will
+    use: capped exponential, times ``1 + jitter * u_i`` with ``u_i``
+    drawn from ``random.Random(seed)``.  A pure function of its
+    arguments — two calls with the same arguments return the same
+    floats, which is what makes kill+resume fault drills replayable."""
+    rnd = random.Random(int(seed))
+    out = []
+    for attempt in range(1, max(int(attempts), 1) + 1):
+        d = min(base_delay * (2.0 ** (attempt - 1)), max_delay)
+        if jitter > 0.0:
+            d *= 1.0 + float(jitter) * rnd.random()
+        out.append(d)
+    return out
 
 
 def retry_with_backoff(fn: Callable,
@@ -27,14 +76,23 @@ def retry_with_backoff(fn: Callable,
                        fatal_if: Optional[Callable[[BaseException], bool]]
                        = None,
                        describe: str = "operation",
-                       sleep: Callable[[float], None] = time.sleep):
+                       sleep: Callable[[float], None] = time.sleep,
+                       jitter: float = 0.0,
+                       seed: int = 0,
+                       clock: Callable[[], float] = time.monotonic):
     """Call ``fn`` until it succeeds, a non-retriable error escapes, the
     attempt budget runs out, or the next delay would cross ``deadline``
-    seconds of total elapsed time.  ``fatal_if(exc)`` short-circuits
-    retrying for errors that can never heal (e.g. "already initialized").
-    Returns ``fn()``'s result; raises ``LightGBMError`` on exhaustion
-    with the last underlying error chained."""
-    start = time.monotonic()
+    seconds of total elapsed time (as measured by ``clock``).
+    ``fatal_if(exc)`` short-circuits retrying for errors that can never
+    heal (e.g. "already initialized").  Delays come from
+    :func:`backoff_schedule` — jitter is seeded, never wall-clock, so a
+    replay with the same (attempts, base_delay, max_delay, jitter,
+    seed) sleeps the identical sequence.  Returns ``fn()``'s result;
+    raises ``LightGBMError`` on exhaustion with the last underlying
+    error chained."""
+    delays = backoff_schedule(attempts, base_delay, max_delay,
+                              jitter=jitter, seed=seed)
+    start = clock()
     last: Optional[BaseException] = None
     attempt = 0
     for attempt in range(1, max(int(attempts), 1) + 1):
@@ -44,8 +102,8 @@ def retry_with_backoff(fn: Callable,
             if fatal_if is not None and fatal_if(exc):
                 raise
             last = exc
-            elapsed = time.monotonic() - start
-            delay = min(base_delay * (2.0 ** (attempt - 1)), max_delay)
+            elapsed = clock() - start
+            delay = delays[attempt - 1]
             out_of_budget = attempt >= attempts or (
                 deadline is not None and elapsed + delay > deadline)
             if out_of_budget:
@@ -54,7 +112,7 @@ def retry_with_backoff(fn: Callable,
                         "retrying in %.1fs", describe, attempt, attempts,
                         elapsed, exc, delay)
             sleep(delay)
-    elapsed = time.monotonic() - start
+    elapsed = clock() - start
     raise LightGBMError(
         f"{describe} failed after {attempt} attempt(s) over "
         f"{elapsed:.1f}s: {last}") from last

@@ -1,0 +1,273 @@
+"""Continual-training runtime (lightgbm_tpu/continual/).
+
+Covers the ISSUE-6 acceptance surface end-to-end through the
+deterministic drift harness: inject drift at tick T -> regression
+detected within the window -> retrain kicked off with retry/backoff
+(killed once mid-retrain, resumed from checkpoint) -> guarded atomic
+swap with at most one compile per (kind, bucket) -> kill-every-attempt
+degrades gracefully to the last-good pack -> forced post-swap
+regression rolls back with predictions bit-identical to the pre-swap
+pack.  Plus the unit surface: seeded backoff replay, windowed
+regression detection, swap gating, NaN-burst refit guarding, and
+zero-retrace steady-state ticks.
+"""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.continual import (ContinualBooster, DriftSpec,
+                                    DriftStream, run_drift_drill)
+from lightgbm_tpu.continual.drift import _DRILL_PARAMS
+from lightgbm_tpu.continual.runtime import TickReport, tick_metric
+from lightgbm_tpu.robustness.retry import (ManualClock, backoff_schedule,
+                                           retry_with_backoff)
+from lightgbm_tpu.utils.log import LightGBMError
+
+
+def _tiny_cb(**overrides):
+    """A small ContinualBooster on a stable synthetic stream."""
+    p = dict(_DRILL_PARAMS)
+    p.update({"num_iterations": 8, "num_leaves": 7}, **overrides)
+    warm = DriftStream(num_features=5, rows=512, seed=21)
+    X0, y0 = warm.batch(0)
+    return ContinualBooster(p, X0, y0), DriftStream(
+        num_features=5, rows=128, seed=22)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end drift drills (the acceptance-criteria scenarios)
+# ---------------------------------------------------------------------------
+def test_swap_drill_end_to_end(tmp_path):
+    """Covariate shift at tick 4: detection within the window, the
+    retrain killed once mid-flight and RESUMED from its checkpoint on
+    the retry, a guarded hot-swap costing at most one compile per
+    (kind, bucket), and metric recovery on the post-swap ticks."""
+    rep = run_drift_drill("swap", rows=192, drift_at=4, post_ticks=5,
+                          checkpoint_dir=str(tmp_path))
+    assert rep["detected_within_window"], rep
+    assert rep["swap_tick"] is not None
+    # killed once -> exactly 2 attempts, the second resuming bit-exact
+    assert rep["retrain_attempts"] == 2
+    assert rep["one_trace_per_key"], rep["swap_new_traces"]
+    assert rep["swap_new_traces"], "swap must warm the candidate's pack"
+    assert rep["metric_recovered"]
+    assert rep["final_generation"] == 1
+
+
+def test_degrade_drill_serves_last_good():
+    """Every retrain attempt dies (no checkpoints): retry exhaustion
+    must degrade gracefully — the last-good model keeps serving and no
+    swap ever happens."""
+    rep = run_drift_drill("degrade", rows=192, drift_at=4, post_ticks=5)
+    assert rep["detected_within_window"]
+    assert rep["degrade_tick"] is not None
+    assert rep["swap_tick"] is None
+    assert rep["still_serving"]
+    assert rep["generation"] == 0
+
+
+def test_rollback_drill_bit_identical():
+    """A deliberately bad candidate force-swapped in: the watchdog must
+    roll back within the rollback window, and post-rollback predictions
+    must be BIT-identical to the pre-swap pack (the restored booster's
+    engine kept its own packs under its own mutation-counter keys)."""
+    rep = run_drift_drill("rollback", rows=192, drift_at=3, post_ticks=5)
+    assert rep["rollback_within"], rep
+    assert rep["pre_post_identical"], \
+        "post-rollback serving differs from the pre-swap pack"
+
+
+# ---------------------------------------------------------------------------
+# seeded retry/backoff (satellite: deterministic replays)
+# ---------------------------------------------------------------------------
+def test_backoff_schedule_is_pure():
+    a = backoff_schedule(5, base_delay=0.5, max_delay=4.0, jitter=0.3,
+                         seed=11)
+    b = backoff_schedule(5, base_delay=0.5, max_delay=4.0, jitter=0.3,
+                         seed=11)
+    assert a == b, "same arguments must replay the same delays"
+    c = backoff_schedule(5, base_delay=0.5, max_delay=4.0, jitter=0.3,
+                         seed=12)
+    assert a != c, "jitter must depend on the seed"
+    plain = backoff_schedule(5, base_delay=0.5, max_delay=4.0)
+    assert plain == [0.5, 1.0, 2.0, 4.0, 4.0]
+    assert all(x >= y for x, y in zip(a, plain)), \
+        "jitter only ever lengthens the capped exponential delay"
+
+
+def test_retry_replays_identical_sleeps():
+    """Two failing runs with the same policy sleep the identical
+    sequence — the property kill+resume fault drills rely on."""
+    def run_once():
+        clk = ManualClock()
+        slept = []
+
+        def sleep(d):
+            slept.append(d)
+            clk.sleep(d)
+
+        def boom():
+            raise RuntimeError("transient")
+
+        with pytest.raises(LightGBMError):
+            retry_with_backoff(boom, attempts=4, base_delay=0.1,
+                               jitter=0.5, seed=7, sleep=sleep, clock=clk,
+                               describe="replay probe")
+        return slept, clk.now
+
+    s1, t1 = run_once()
+    s2, t2 = run_once()
+    assert s1 == s2 and t1 == t2
+    assert len(s1) == 3                       # no sleep after the last
+
+
+def test_retry_deadline_uses_injected_clock():
+    """The out-of-budget decision reads the injected clock, so a stubbed
+    sleep plus ManualClock makes the deadline cut-off deterministic."""
+    clk = ManualClock()
+    calls = []
+
+    def fail():
+        calls.append(1)
+        raise RuntimeError("nope")
+
+    with pytest.raises(LightGBMError, match="deadline|attempt"):
+        retry_with_backoff(fail, attempts=10, base_delay=1.0,
+                           max_delay=1.0, deadline=2.5, seed=0,
+                           sleep=clk.sleep, clock=clk,
+                           describe="deadline probe")
+    # delays of 1s each: attempts at t=0,1,2; the next delay would end
+    # at 3.0 > 2.5, so exactly 3 attempts run — every time
+    assert len(calls) == 3
+
+
+# ---------------------------------------------------------------------------
+# windowed regression detection + swap gate (unit surface)
+# ---------------------------------------------------------------------------
+def test_windowed_regression_detection():
+    cb, _ = _tiny_cb(continual_window=3, continual_metric_threshold=0.2)
+    cb.history = [1.0] * 6
+    assert not cb._regressed()
+    cb.history = [1.0] * 3 + [1.15] * 3       # within threshold
+    assert not cb._regressed()
+    cb.history = [1.0] * 3 + [1.3] * 3        # beyond threshold
+    assert cb._regressed()
+
+
+def test_swap_gate_rejects_worse_candidate():
+    """A retrain over a poisoned buffer must not replace a healthy
+    model: the gate compares candidate vs served on the gate batch."""
+    cb, stream = _tiny_cb()
+    for t in range(2):
+        cb.tick(*stream.batch(t))
+    served = cb.booster
+    Xb = np.random.RandomState(5).normal(size=(64, 5))
+    bad = lgb.train({"objective": "regression", "verbosity": -1,
+                     "num_leaves": 3, "metric": ""},
+                    lgb.Dataset(Xb, label=-50.0 * np.ones(64)),
+                    num_boost_round=1)
+    r = TickReport(tick=cb.tick_no)
+    cb._gate_and_swap(bad, r)
+    assert r.swap_rejected and not r.swapped
+    assert cb.booster is served, "a rejected candidate must not install"
+    assert cb.generation == 0
+
+
+def test_nan_burst_tick_guard_skips_refit():
+    """A NaN-burst tick (poisoned upstream join) must not poison the
+    served model: with nonfinite_policy=skip_iteration the refit drops
+    every iteration and serving stays finite and unchanged."""
+    spec = DriftSpec(nan_burst_at=1, nan_burst_ticks=1, nan_fraction=0.5)
+    cb, _ = _tiny_cb(nonfinite_policy="skip_iteration")
+    stream = DriftStream(num_features=5, rows=128, seed=23, spec=spec)
+    cb.tick(*stream.batch(0))
+    Xp = stream.batch(2)[0]
+    before = cb.predict(Xp, raw_score=True)
+    r = cb.tick(*stream.batch(1))             # the burst tick
+    assert r.refit_applied and r.refit_skipped
+    after = cb.predict(Xp, raw_score=True)
+    np.testing.assert_array_equal(np.asarray(before), np.asarray(after))
+    assert np.isfinite(np.asarray(after)).all()
+    # the NaN tick metric must not enter the detection history: one
+    # NaN would blind the windowed mean for 2*W ticks and disarm a
+    # watchdog whose baseline captured it
+    assert np.isfinite(cb.history).all()
+    assert len(cb.history) == 1               # tick 0 only
+
+
+def test_steady_state_ticks_add_no_retraces():
+    """After the first tick settles the per-kind compiles, further
+    ticks must add ZERO serving retraces: the in-place refit rides the
+    engine's leaf-refresh fast path (delta re-transfer, no re-pack)."""
+    cb, stream = _tiny_cb()
+    cb.tick(*stream.batch(0))
+    snap = cb.serving_engine.trace_snapshot()
+    before_pred = cb.predict(stream.batch(9)[0], raw_score=True)
+    for t in range(1, 4):
+        r = cb.tick(*stream.batch(t))
+        assert r.refit_applied
+    assert cb.serving_engine.new_traces_since(snap) == {}
+    # and the refits really changed the served model (same shapes,
+    # fresh leaf values through the fast path)
+    after_pred = cb.predict(stream.batch(9)[0], raw_score=True)
+    assert not np.array_equal(np.asarray(before_pred),
+                              np.asarray(after_pred))
+
+
+def test_background_retrain_lands_at_a_later_tick():
+    """background=True: the retrain runs off the tick thread over a
+    buffer SNAPSHOT (the live deque keeps growing underneath it), and
+    a later tick polls the finished candidate and swaps it in."""
+    spec = DriftSpec(covariate_shift_at=2)
+    p = dict(_DRILL_PARAMS)
+    p.update({"num_iterations": 8, "num_leaves": 7,
+              "continual_window": 2, "continual_retrain_rounds": 8})
+    warm = DriftStream(num_features=5, rows=512, seed=41)
+    X0, y0 = warm.batch(0)
+    cb = ContinualBooster(p, X0, y0, background=True)
+    stream = DriftStream(num_features=5, rows=128, seed=42, spec=spec)
+    started = swapped = None
+    for t in range(14):
+        r = cb.tick(*stream.batch(t))
+        if r.drift_detected and started is None:
+            started = t
+        if r.swapped and swapped is None:
+            swapped = t
+            assert r.retrain_attempts >= 1    # published before "done"
+            break
+        if cb._bg is not None:                # retrain still in flight
+            cb._bg["thread"].join(timeout=60)
+    assert started is not None, "drift never detected"
+    assert swapped is not None and swapped > started, \
+        "background retrain must land at a LATER tick than detection"
+    assert cb.generation == 1
+
+
+def test_drift_stream_batches_are_pure():
+    """batch(t) is a pure function of (seed, t): replaying any tick in
+    isolation reproduces it bit-exact, out of order."""
+    spec = DriftSpec(covariate_shift_at=3, nan_burst_at=5)
+    s1 = DriftStream(num_features=4, rows=64, seed=31, spec=spec)
+    s2 = DriftStream(num_features=4, rows=64, seed=31, spec=spec)
+    for t in (6, 0, 5, 3):
+        X1, y1 = s1.batch(t)
+        X2, y2 = s2.batch(t)
+        np.testing.assert_array_equal(X1, X2)
+        np.testing.assert_array_equal(y1, y2)
+    # the shift applies exactly from covariate_shift_at onward
+    np.testing.assert_array_equal(
+        s1.batch(4)[0], DriftStream(num_features=4, rows=64, seed=31,
+                                    spec=DriftSpec()).batch(4)[0] + 2.5)
+
+
+def test_tick_metric_matches_objective():
+    y = np.array([0.0, 1.0, 1.0, 0.0])
+    raw = np.array([-2.0, 1.5, 0.5, -0.1])
+    p = 1.0 / (1.0 + np.exp(-raw))
+    want = -np.mean(y * np.log(p) + (1 - y) * np.log(1 - p))
+    assert tick_metric("binary_logloss", y, raw) == pytest.approx(want)
+    assert tick_metric("l2", y, raw) == pytest.approx(
+        np.mean((raw - y) ** 2))
+    with pytest.raises(LightGBMError, match="continual_metric"):
+        tick_metric("bogus", y, raw)

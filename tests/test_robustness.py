@@ -455,3 +455,102 @@ def test_unknown_param_warns_per_train_call(rng):
         _log.register_callback(None)
     warns = [m for m in msgs if "Unknown parameter: num_leafs" in m]
     assert len(warns) == 2
+
+
+# ---------------------------------------------------------------------------
+# non-finite guard rails through the REFIT path (ISSUE-6 satellite):
+# the continual runtime's per-tick refit must be guarded exactly like
+# full training iterations, with per-iteration fault targeting
+# ---------------------------------------------------------------------------
+def _refit_base(rng, rounds=5):
+    X, y = _data(rng, binary=False)
+    bst = lgb.train(dict(objective="regression", num_leaves=7,
+                         verbosity=-1, metric=""),
+                    lgb.Dataset(X, label=y), num_boost_round=rounds)
+    bst._gbdt._flush_pending()   # host tree list must exist to snapshot
+    X2 = rng.normal(size=X.shape)
+    y2 = X2[:, 0] * 2.0 + rng.normal(size=len(X2))
+    return bst, X2, y2
+
+
+def test_refit_nonfinite_raise_names_iteration(rng):
+    bst, X2, y2 = _refit_base(rng)
+    with faultinject.injected(corrupt_gradients_at=2):
+        with pytest.raises(LightGBMError, match="iteration 2"):
+            bst.refit(X2, y2, nonfinite_policy="raise")
+    # the aborted refit must not have half-committed: predictions of
+    # the original booster are untouched
+    assert np.isfinite(bst.predict(X2)).all()
+
+
+def test_refit_nonfinite_skip_keeps_old_leaves(rng):
+    """Corrupt refit iteration 1 only: that iteration's trees keep
+    their OLD leaf values while every other iteration refits."""
+    bst, X2, y2 = _refit_base(rng)
+    old = [np.asarray(t.leaf_value).copy() for t in bst._gbdt.models]
+    with faultinject.injected(corrupt_gradients_at=1):
+        refitted = bst.refit(X2, y2, decay_rate=0.0,
+                             nonfinite_policy="skip_iteration")
+    assert refitted._refit_guard.skipped_iterations == [1]
+    new = [np.asarray(t.leaf_value) for t in refitted._gbdt.models]
+    np.testing.assert_array_equal(new[1], old[1])   # skipped: unchanged
+    assert not np.allclose(new[0], old[0])          # refit applied
+    assert not np.allclose(new[2], old[2])
+    assert np.isfinite(refitted.predict(X2)).all()
+
+
+def test_refit_nonfinite_clamp_drops_poisoned_rows(rng):
+    bst, X2, y2 = _refit_base(rng)
+    with faultinject.injected(corrupt_gradients_at=2):
+        refitted = bst.refit(X2, y2, nonfinite_policy="clamp")
+    assert refitted._refit_guard.clamped_iterations == [2]
+    assert refitted._refit_guard.skipped_iterations == []
+    assert np.isfinite(refitted.predict(X2)).all()
+    # clamped rows drop out of iteration 2's leaf sums, so its trees
+    # still moved (unlike skip_iteration)
+    assert not np.allclose(np.asarray(refitted._gbdt.models[2].leaf_value),
+                           np.asarray(bst._gbdt.models[2].leaf_value))
+
+
+def test_refit_nan_labels_guarded_every_iteration(rng):
+    """NaN labels (a poisoned upstream join, no injection) poison the
+    gradients of EVERY refit iteration; skip_iteration must keep the
+    whole model unchanged rather than commit garbage."""
+    bst, X2, y2 = _refit_base(rng)
+    y_bad = y2.copy()
+    y_bad[::3] = np.nan
+    before = bst.predict(X2)
+    refitted = bst.refit(X2, y_bad, decay_rate=0.0,
+                         nonfinite_policy="skip_iteration")
+    assert len(refitted._refit_guard.skipped_iterations) == 5
+    np.testing.assert_array_equal(refitted.predict(X2), before)
+
+
+def test_refit_inplace_invalidates_serving_eagerly(rng):
+    """In-place refit must bump the serving mutation counter AT COMMIT
+    (like update/rollback) — a pack warmed before the refit serving
+    pre-refit leaf values afterwards would be a stale-read bug.  The
+    warm pack takes the leaf-refresh fast path: values change, zero new
+    traces."""
+    rng_big = np.random.RandomState(7)
+    X = rng_big.normal(size=(4096, 6))
+    y = X @ rng_big.normal(size=6) + rng_big.normal(size=4096)
+    bst = lgb.train(dict(objective="regression", num_leaves=15,
+                         verbosity=-1, metric=""),
+                    lgb.Dataset(X, label=y), num_boost_round=5)
+    before = bst.predict(X)                   # warms the device pack
+    bst.predict(X, pred_leaf=True)            # refit reuses this program
+    eng = bst._gbdt.serving
+    ver0 = bst._gbdt._model_version
+    snap = eng.trace_snapshot()
+    out = bst.refit(X, -y, decay_rate=0.0, inplace=True)
+    assert out is bst
+    assert bst._gbdt._model_version > ver0
+    after = bst.predict(X)                    # same warm bucket
+    assert not np.allclose(after, before), \
+        "warm pack served pre-refit leaf values after in-place refit"
+    assert eng.new_traces_since(snap) == {}, \
+        "refit must ride the leaf-refresh fast path, not re-trace"
+    # the refreshed pack serves exactly what a cold rebuild would
+    clean = lgb.Booster(model_str=bst.model_to_string()).predict(X)
+    np.testing.assert_allclose(after, clean, rtol=1e-6, atol=1e-6)

@@ -1,0 +1,61 @@
+"""Tier-1 lanes for the continual-runtime tooling (ISSUE-6 satellite):
+`tools/ab_bench.py --drift` must assert rollback-within-N + last-good
+serving parity end-to-end, and `tools/profile_continual.py --smoke`
+must emit its JSON report with every drill invariant green.  The
+profiler runs in-process to share the session's jit caches (the
+profile_predict lane's trick); ab_bench runs as a real subprocess —
+it is the operator-facing CI entry point and its exit code is the
+contract.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(HERE, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_profile_continual_smoke(capsys):
+    tool = _load_tool("profile_continual")
+    rc = tool.main(["--smoke", "--rows", "256", "--ticks", "4"])
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    assert rc == 0
+    payload = json.loads(out)
+    assert payload["metric"] == "continual"
+    detail = payload["detail"]
+    # steady-state ticks never retrace: kinds compile exactly once
+    assert all(v == 1 for v in detail["tick"]["trace_counts"].values())
+    assert detail["tick"]["tick_ms"] > 0
+    d = detail["drills"]
+    assert d["swap"]["detected_within_window"]
+    assert d["swap"]["one_trace_per_key"]
+    assert d["degrade"]["still_serving"]
+    assert d["rollback"]["pre_post_identical"]
+
+
+def test_ab_bench_drift_lane():
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=HERE)
+    out = subprocess.run(
+        [sys.executable, os.path.join(HERE, "tools", "ab_bench.py"),
+         "--drift", "--drift-rows", "192", "--rollback-within", "3"],
+        capture_output=True, text=True, env=env, timeout=600)
+    assert out.returncode == 0, out.stdout + out.stderr
+    line = [ln for ln in out.stdout.strip().splitlines()
+            if ln.startswith("{")][-1]
+    rec = json.loads(line)
+    assert rec["detected_within_window"] is True
+    assert rec["one_trace_per_key"] is True
+    assert rec["rollback_ok"] is True, \
+        f"rollback fired after {rec['rollback_delay_ticks']} ticks"
+    assert rec["post_rollback_parity"] is True
+    assert rec["swap_latency_s"] > 0

@@ -126,6 +126,65 @@ def _fault_smoke(args):
         shutil.rmtree(work, ignore_errors=True)
 
 
+def _drift_smoke(args):
+    """Continual-runtime smoke (`--drift`): inject a covariate shift,
+    assert the rollback watchdog fires within `--rollback-within` ticks
+    of a forced post-swap regression AND that the restored model serves
+    bit-identically to the last-good pack; plus the full swap drill
+    (detection within the window, kill-mid-retrain resumed from
+    checkpoint, at most one compile per (kind, bucket) per swap)."""
+    import shutil
+    import tempfile
+
+    from lightgbm_tpu.continual import run_drift_drill
+
+    work = tempfile.mkdtemp(prefix="ab-drift-")
+    try:
+        swap = run_drift_drill("swap", rows=args.drift_rows, drift_at=4,
+                               post_ticks=5, checkpoint_dir=work)
+        roll = run_drift_drill("rollback", rows=args.drift_rows,
+                               drift_at=3, post_ticks=5)
+        rollback_delay = (None if roll.get("rollback_tick") is None else
+                          roll["rollback_tick"] - roll["swap_tick"])
+        report = {
+            "drift_mode": True, "rows_per_tick": args.drift_rows,
+            "detect_tick": swap.get("detect_tick"),
+            "drift_at": swap.get("drift_at"),
+            "detected_within_window": swap.get("detected_within_window"),
+            "retrain_attempts": swap.get("retrain_attempts"),
+            "swap_new_traces": swap.get("swap_new_traces"),
+            "one_trace_per_key": swap.get("one_trace_per_key"),
+            "swap_latency_s": round(
+                float(swap.get("swap_latency_s") or 0.0), 4),
+            "metric_recovered": swap.get("metric_recovered"),
+            "rollback_delay_ticks": rollback_delay,
+            "rollback_within": args.rollback_within,
+            "rollback_ok": (rollback_delay is not None
+                            and rollback_delay <= args.rollback_within),
+            "post_rollback_parity": roll.get("pre_post_identical"),
+        }
+        print(json.dumps(report))
+        problems = []
+        if not report["detected_within_window"]:
+            problems.append("regression not detected within the window")
+        if swap.get("swap_tick") is None:
+            problems.append("no hot-swap happened")
+        if not report["one_trace_per_key"]:
+            problems.append("swap cost more than one compile per "
+                            "(kind, bucket)")
+        if not report["rollback_ok"]:
+            problems.append(
+                f"rollback fired after {rollback_delay} tick(s), budget "
+                f"{args.rollback_within}")
+        if not report["post_rollback_parity"]:
+            problems.append("post-rollback serving is not bit-identical "
+                            "to the last-good pack")
+        if problems:
+            raise SystemExit("--drift: " + "; ".join(problems))
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--rows", type=int, default=1_000_000)
@@ -149,10 +208,22 @@ def main():
                     help="--fault: interleaved trainings per arm")
     ap.add_argument("--max-overhead-pct", type=float, default=3.0,
                     help="--fault: checkpoint overhead budget to assert")
+    ap.add_argument("--drift", action="store_true",
+                    help="continual-runtime smoke: drift detection, "
+                    "swap compile counts, rollback-within-N + last-good "
+                    "serving parity (asserts all of them)")
+    ap.add_argument("--drift-rows", type=int, default=256,
+                    help="--drift: rows per tick")
+    ap.add_argument("--rollback-within", type=int, default=3,
+                    help="--drift: ticks within which rollback must "
+                    "fire after an injected post-swap regression")
     args = ap.parse_args()
 
     if args.fault:
         _fault_smoke(args)
+        return
+    if args.drift:
+        _drift_smoke(args)
         return
 
     import jax.numpy as jnp

@@ -1,0 +1,169 @@
+"""Continual-training runtime harness: refit-tick overhead, swap and
+rollback latency, end-to-end drift drills.
+
+Measures the tick loop of ``lightgbm_tpu/continual``:
+
+* **refit tick overhead** — median wall-clock of a full tick (prequential
+  eval + in-place leaf refit through the serving engine's leaf-refresh
+  fast path) vs a predict-only tick over the same batches;
+* **swap latency** — candidate warm-up (pack build + one compile per
+  (kind, bucket)) through the atomic install, from the swap drill;
+* **rollback latency** — watchdog-triggered restore of the pre-swap
+  booster (no pack rebuild: its engine kept its own packs);
+* **drift drills** — the three deterministic scenarios of
+  ``continual/drift.py`` (swap with kill+resume, retry-exhaustion
+  degradation, forced-regression rollback), asserted when ``--smoke``.
+
+Prints ONE JSON line (like bench.py):
+
+  {"metric": "continual", "detail": {...}}
+
+Usage:
+  python tools/profile_continual.py [--rows 4096] [--features 10]
+      [--ticks 20] [--smoke]
+
+``--smoke`` shrinks everything to seconds for the tier-1 lane and exits
+non-zero when a drill invariant breaks (detection within window, one
+compile per (kind, bucket) per swap, rollback within the window with
+bit-identical pre-swap predictions, graceful degradation).
+"""
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def tick_overhead(rows, features, ticks, params=None):
+    """Median tick wall-clock with refit vs predict-only, same batches."""
+    from lightgbm_tpu.continual.drift import _DRILL_PARAMS, DriftStream
+    from lightgbm_tpu.continual.runtime import ContinualBooster, tick_metric
+
+    p = dict(_DRILL_PARAMS)
+    p.update(params or {})
+    stream = DriftStream(num_features=features, rows=rows, seed=3)
+    warm = DriftStream(num_features=features, rows=4 * rows, seed=4)
+    X0, y0 = warm.batch(0)
+    cb = ContinualBooster(p, X0, y0)
+    batches = [stream.batch(t) for t in range(ticks)]
+    # settle compiles
+    cb.tick(*batches[0])
+    cb.predict(batches[0][0], raw_score=True)
+
+    pred_t, tick_t = [], []
+    for X, y in batches:
+        t0 = time.perf_counter()
+        raw = cb.predict(X, raw_score=True)
+        tick_metric(cb.metric_name, y, np.asarray(raw))
+        pred_t.append(time.perf_counter() - t0)
+    for X, y in batches:
+        t0 = time.perf_counter()
+        cb.tick(X, y)
+        tick_t.append(time.perf_counter() - t0)
+    eng = cb.serving_engine
+    snap_before = len(batches)
+    return {
+        "rows_per_tick": rows,
+        "predict_only_ms": round(1e3 * float(np.median(pred_t)), 3),
+        "tick_ms": round(1e3 * float(np.median(tick_t)), 3),
+        "refit_overhead_ms": round(
+            1e3 * (float(np.median(tick_t)) - float(np.median(pred_t))),
+            3),
+        "trace_counts": {str(k): v for k, v in eng.trace_counts.items()},
+        "ticks": snap_before,
+    }
+
+
+def run(rows, features, ticks, smoke):
+    import jax
+
+    from lightgbm_tpu.continual import run_drift_drill
+
+    detail = {"device": jax.devices()[0].platform,
+              "smoke": bool(smoke)}
+    detail["tick"] = tick_overhead(rows, features, ticks)
+
+    work = tempfile.mkdtemp(prefix="continual-profile-")
+    drill_rows = min(rows, 256) if smoke else rows
+    drills = {}
+    try:
+        drills["swap"] = run_drift_drill(
+            "swap", rows=drill_rows, features=features, drift_at=4,
+            post_ticks=5, checkpoint_dir=work)
+        drills["degrade"] = run_drift_drill(
+            "degrade", rows=drill_rows, features=features, drift_at=4,
+            post_ticks=5)
+        drills["rollback"] = run_drift_drill(
+            "rollback", rows=drill_rows, features=features, drift_at=3,
+            post_ticks=5)
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+    for name, rep in drills.items():
+        rep.pop("ticks", None)
+        rep.pop("history", None)
+    detail["drills"] = drills
+    detail["swap_latency_ms"] = round(
+        1e3 * float(drills["swap"].get("swap_latency_s") or 0.0), 3)
+    return detail
+
+
+def check(detail):
+    """Smoke-lane invariants; returns a list of failures."""
+    bad = []
+    d = detail["drills"]
+    if not d["swap"].get("detected_within_window"):
+        bad.append("swap: regression not detected within the window")
+    if d["swap"].get("swap_tick") is None:
+        bad.append("swap: no hot-swap happened")
+    if not d["swap"].get("one_trace_per_key"):
+        bad.append("swap: more than one compile per (kind, bucket)")
+    if not d["swap"].get("metric_recovered"):
+        bad.append("swap: metric did not recover after the swap")
+    if d["degrade"].get("degrade_tick") is None:
+        bad.append("degrade: retry exhaustion did not degrade")
+    if not d["degrade"].get("still_serving"):
+        bad.append("degrade: last-good model stopped serving")
+    if d["degrade"].get("generation") != 0:
+        bad.append("degrade: a failed retrain must not swap")
+    if not d["rollback"].get("rollback_within"):
+        bad.append("rollback: watchdog did not fire within the window")
+    if not d["rollback"].get("pre_post_identical"):
+        bad.append("rollback: post-rollback predictions differ from the "
+                   "pre-swap pack")
+    return bad
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=4096,
+                    help="rows per tick for the overhead measurement")
+    ap.add_argument("--features", type=int, default=10)
+    ap.add_argument("--ticks", type=int, default=20)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny sizes + assert the drill invariants "
+                    "(tier-1 lane)")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        args.rows = min(args.rows, 512)
+        args.features = min(args.features, 6)
+        args.ticks = min(args.ticks, 6)
+    detail = run(args.rows, args.features, args.ticks, args.smoke)
+    print(json.dumps({"metric": "continual", "detail": detail}))
+    if args.smoke:
+        bad = check(detail)
+        if bad:
+            print("continual smoke failed:\n  " + "\n  ".join(bad),
+                  file=sys.stderr)
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
