@@ -322,6 +322,12 @@ def main():
         import jax
         jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
     import lightgbm_tpu as lgb
+    from lightgbm_tpu import obs
+
+    # telemetry at counters for the whole bench: the BENCH_obs.json
+    # artifact below records compile events and memory peaks alongside
+    # the headline (zero-HLO; span cost is noise at these block sizes)
+    obs.get().enable("counters")
 
     # kernel self-check FIRST, in a subprocess, before this process
     # touches the backend (single-host TPUs enforce single-process
@@ -434,6 +440,18 @@ def main():
         "vs_baseline": round(vs_baseline, 4),
         "detail": detail,
     }))
+
+    # machine-readable perf artifact (schema: lightgbm-tpu/bench-obs/v1;
+    # path overridable via BENCH_OBS_PATH) — the PERF.md round gets a
+    # diffable companion with compile counts and memory peaks
+    from lightgbm_tpu.obs import benchio
+    path = benchio.write_bench_obs(
+        "bench",
+        {"rows": ROWS, "features": FEATURES, "leaves": NUM_LEAVES,
+         "iters": ITERS, "repeats": REPEATS},
+        {"per_iter_s": round(per_iter, 4),
+         "vs_baseline": round(vs_baseline, 4), "detail": detail})
+    print(f"wrote {path}", file=sys.stderr)
 
 
 if __name__ == "__main__":

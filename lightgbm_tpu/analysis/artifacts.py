@@ -26,6 +26,10 @@ would otherwise catch fail tier-1 instead:
   serving retraces (the in-place refit rides the leaf-refresh fast
   path) and a hot swap compiles each (kind, bucket) at most once,
   during the candidate warm-up, never on the serving path.
+* ``telemetry.off`` — the obs layer stages ZERO device ops: the fused
+  train step's lowered while-body is op-for-op identical with
+  telemetry off and at full trace mode (spans/counters/compile
+  detection are host-side bookkeeping by construction).
 
 Every metric is a ceiling checked against ``jaxlint_baseline.json``
 (see :mod:`lightgbm_tpu.analysis.baseline`).  All checks run on the
@@ -221,6 +225,59 @@ def check_shap_kernel() -> Dict[str, int]:
 
 
 # ---------------------------------------------------------------------------
+# telemetry zero-HLO invariant
+# ---------------------------------------------------------------------------
+def check_telemetry_off() -> Dict[str, int]:
+    """The obs layer must never stage device ops: the fused train
+    step's lowered while-body is OP-FOR-OP identical whether the
+    telemetry session is off or at full trace mode.  (The off-mode
+    lowering equals the pre-obs program by the same argument — spans
+    and the compile detector are host-side bookkeeping — and the
+    separate ``while_body.default`` budget pins the absolute counts.)
+    Every delta metric is an invariant budgeted at 0."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+    from ..obs import telemetry as obs
+    from .hlo import body_counts
+
+    def lower_step():
+        rng = np.random.RandomState(11)
+        X = rng.normal(size=(512, 6))
+        y = X[:, 0] - 0.5 * X[:, 2] + 0.1 * rng.normal(size=len(X))
+        bst = lgb.Booster(params={"objective": "regression",
+                                  "verbosity": -1, "num_leaves": 15,
+                                  "min_data_in_leaf": 5, "metric": ""},
+                          train_set=lgb.Dataset(X, label=y))
+        g = bst._gbdt
+        assert g._fused_phys is not None, \
+            "telemetry.off budget needs the fused physical step"
+        pb, ghi = g._init_phys(g.learner._part0, g.scores)
+        fmask = jnp.ones((g.learner.F,), dtype=bool)
+        feat_used = jnp.zeros((g.learner.F,), dtype=bool)
+        lowered = g._fused_phys.lower(pb, ghi, fmask, jnp.int32(1),
+                                      feat_used)
+        return lowered.compile().as_text()
+
+    sess = obs.get()
+    prev = sess.mode
+    try:
+        sess.set_mode("off")
+        off = body_counts(lower_step())
+        sess.set_mode("trace")
+        on = body_counts(lower_step())
+    finally:
+        sess.set_mode(prev)
+    keys = set(off["ops"]) | set(on["ops"])
+    hist_delta = sum(abs(off["ops"].get(k, 0) - on["ops"].get(k, 0))
+                     for k in keys)
+    return {"body_op_histogram_delta": hist_delta,
+            "total_ops_delta": abs(off["total_ops"] - on["total_ops"]),
+            "copies_delta": abs(off["copies"] - on["copies"])}
+
+
+# ---------------------------------------------------------------------------
 # continual-runtime tick/swap budgets
 # ---------------------------------------------------------------------------
 def check_continual_tick() -> Dict[str, int]:
@@ -268,6 +325,7 @@ CHECKS = {
     "train.donation": check_train_donation,
     "shap.kernel": check_shap_kernel,
     "continual.tick": check_continual_tick,
+    "telemetry.off": check_telemetry_off,
 }
 
 

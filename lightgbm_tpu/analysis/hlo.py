@@ -94,6 +94,7 @@ def body_counts(hlo_text: str, body_name: str = None) -> Dict[str, Any]:
         "fusions": ops.get("fusion", 0),
         "copies": ops.get("copy", 0),
         "whiles": ops.get("while", 0),
+        "ops": dict(sorted(ops.items())),
         "copies_by_shape": dict(sorted(copies_by_shape.items(),
                                        key=lambda kv: -kv[1])),
     }

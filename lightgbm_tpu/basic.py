@@ -399,6 +399,8 @@ class Booster:
         params = params or {}
         self.params = dict(params)
         self.config = Config(params)
+        from .obs import telemetry as _obs
+        _obs.configure_from_config(self.config)
         self._gbdt: Optional[GBDT] = None
         self.train_set = train_set
         self.best_iteration = -1
@@ -532,11 +534,13 @@ class Booster:
     def update(self, train_set: Optional[Dataset] = None, fobj=None) -> bool:
         """One boosting iteration; returns True if no further splits were possible
         (reference: basic.py Booster.update:4073)."""
-        if fobj is not None:
-            score = self._gbdt.scores
-            grad, hess = fobj(np.asarray(score), self.train_set)
-            return self.__boost(grad, hess)
-        return self._gbdt.train_one_iter()
+        from .obs import telemetry as _obs
+        with _obs.span("train.iteration", i=self._gbdt.iter):
+            if fobj is not None:
+                score = self._gbdt.scores
+                grad, hess = fobj(np.asarray(score), self.train_set)
+                return self.__boost(grad, hess)
+            return self._gbdt.train_one_iter()
 
     def __boost(self, grad, hess) -> bool:
         return self._gbdt.train_one_iter(np.asarray(grad, dtype=np.float32),
@@ -561,6 +565,30 @@ class Booster:
 
     def num_feature(self) -> int:
         return self._gbdt.max_feature_idx + 1
+
+    def telemetry_report(self, include_memory: bool = True) -> Dict[str, Any]:
+        """Aggregate runtime telemetry (lightgbm_tpu/obs/): span
+        latency histograms, counters, compile events attributed to
+        spans, and (``include_memory``) device-memory attribution by
+        owner.  The session is process-wide — training, serving and
+        the continual runtime all write to it — plus this booster's
+        own serving-engine trace/call counters, whose per-(kind,
+        bucket) compile counts the session's ``serving.*`` compile
+        events reproduce exactly when ``telemetry != off``."""
+        from . import obs
+        rep = obs.get().report()
+        if self._gbdt is not None:
+            eng = self._gbdt.serving
+            rep["serving"] = {
+                "traces": {f"{k[0]}@{k[1]}": v
+                           for k, v in eng.trace_counts.items()},
+                "calls": {f"{k[0]}@{k[1]}": v
+                          for k, v in eng.call_counts.items()},
+                "packs": sorted(eng._packs),
+            }
+        if include_memory:
+            rep["memory"] = obs.memory_snapshot()
+        return rep
 
     # ------------------------------------------------------------------
     def eval_train(self, feval=None):
@@ -782,6 +810,11 @@ class Booster:
             "objective", saved_params.get("objective", "regression")).split(" ")[0]
         saved_params["num_class"] = int(header.get("num_class", 1))
         self.config = Config(saved_params)
+        # a model trained with telemetry on re-enables the session on
+        # restore (the pickle round-trip keeps counting, like the
+        # serving engine keeps its warm-name debt)
+        from .obs import telemetry as _obs_tel
+        _obs_tel.configure_from_config(self.config)
         self.params = dict(saved_params)
         objective = create_objective(self.config)
         self._gbdt = GBDT(self.config, None, objective)

@@ -70,26 +70,47 @@ class Application:
 
     def run(self) -> None:
         task = self.config.task
+        # runtime telemetry (lightgbm_tpu/obs/): telemetry=counters|trace
+        # arms the session before any device work; telemetry_out=DIR
+        # exports JSONL + Chrome trace + Prometheus text when the task
+        # finishes (even when it fails — the trace of a failed run is
+        # the artifact an operator wants most)
+        from . import obs
+        obs.configure_from_config(self.config)
         # multi-host bootstrap before any device work (reference:
         # application.cpp:171 Network::Init ahead of LoadData/Train)
         from .parallel.network import init_from_config
         init_from_config(self.config)
         from .parallel.distributed import sync_config_params
         sync_config_params(self.config)
-        if task == "train":
-            self.train()
-        elif task in ("predict", "prediction", "test"):
-            self.predict()
-        elif task == "convert_model":
-            self.convert_model()
-        elif task == "refit":
-            self.refit()
-        elif task == "save_binary":
-            self.save_binary()
-        elif task == "continual":
-            self.continual()
-        else:
-            log.fatal("Unknown task: %s", task)
+        try:
+            if task == "train":
+                self.train()
+            elif task in ("predict", "prediction", "test"):
+                self.predict()
+            elif task == "convert_model":
+                self.convert_model()
+            elif task == "refit":
+                self.refit()
+            elif task == "save_binary":
+                self.save_binary()
+            elif task == "continual":
+                self.continual()
+            else:
+                log.fatal("Unknown task: %s", task)
+        finally:
+            if self.config.telemetry_out and obs.enabled():
+                # never let a failed export mask the task's own error
+                # (e.g. an unwritable telemetry_out during a training
+                # failure must not replace the training exception)
+                try:
+                    obs.memory_snapshot()
+                    paths = obs.export_session(self.config.telemetry_out)
+                    log.info("telemetry exported: %s",
+                             ", ".join(sorted(paths.values())))
+                except OSError as exc:
+                    log.warning("telemetry export to %s failed: %s",
+                                self.config.telemetry_out, exc)
 
     # ------------------------------------------------------------------
     @staticmethod

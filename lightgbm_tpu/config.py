@@ -149,6 +149,18 @@ _PARAMS: List[_Param] = [
     # backoff (deadline = time_out)
     _p("bootstrap_retries", 5, int, (), ">0"),
     _p("bootstrap_retry_delay", 1.0, float, (), ">0.0"),
+    # --- Observability (lightgbm_tpu/obs/) ---
+    # runtime telemetry: "off" (default; zero host bookkeeping and —
+    # pinned by the jaxlint telemetry.off budget — zero ops in any
+    # lowered program), "counters" (host-side spans/counters/compile
+    # detectors + per-(kind,bucket) serving latency histograms),
+    # "trace" (counters plus a bounded event log exportable as Chrome
+    # trace / JSONL / Prometheus, with jax.profiler span bridging).
+    # Session-wide and upgrade-only; see Booster.telemetry_report()
+    _p("telemetry", "off", str, ("telemetry_mode",)),
+    # directory where the CLI writes telemetry.jsonl / trace.json /
+    # metrics.prom when the task finishes ("" = no export)
+    _p("telemetry_out", "", str, ("telemetry_dir",)),
     # --- Continual training (lightgbm_tpu/continual/) ---
     # windowed regression detection: mean tick metric over the last
     # continual_window ticks vs the window before; a relative

@@ -52,6 +52,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..config import Config
+from ..obs import memory as obs_memory
+from ..obs import telemetry as obs
 from ..robustness import faultinject
 from ..robustness.retry import retry_with_backoff
 from ..utils import log
@@ -128,6 +130,14 @@ class TickReport:
         return d
 
 
+def _buffer_arrays(cb):
+    """Telemetry memory provider: the host-side recent-batch buffer."""
+    out = []
+    for X, y, w in list(cb.buffer):
+        out.extend(a for a in (X, y, w) if a is not None)
+    return out
+
+
 # ---------------------------------------------------------------------------
 # the runtime
 # ---------------------------------------------------------------------------
@@ -163,6 +173,7 @@ class ContinualBooster:
         from ..engine import train as _train
         self.params = dict(params)
         self.cfg = Config(self.params)
+        obs.configure_from_config(self.cfg)
         self.metric_name = resolve_metric(self.cfg.continual_metric,
                                           self.cfg.objective)
         self.checkpoint_dir = checkpoint_dir
@@ -200,6 +211,8 @@ class ContinualBooster:
         self._cooldown = 0
         self._bg: Optional[Dict[str, Any]] = None
         self._gate: Optional[Tuple[np.ndarray, np.ndarray]] = None
+        # telemetry HBM attribution: the recent-batch retrain buffer
+        obs_memory.register("continual.buffers", self, _buffer_arrays)
 
     # -- plumbing -------------------------------------------------------
     def _train_params(self) -> Dict[str, Any]:
@@ -241,7 +254,20 @@ class ContinualBooster:
         y = np.asarray(y, np.float64)
         r = TickReport(tick=self.tick_no, n_rows=len(X),
                        generation=self.generation)
+        with obs.span("continual.tick", tick=self.tick_no,
+                      generation=self.generation):
+            self._tick_body(X, y, weight, r)
+        # span boundary = the one place the tick is already host-
+        # synchronized, so HBM attribution here is race-free; full
+        # snapshots only in trace mode (a live_arrays walk per tick is
+        # too much for bare counters)
+        if obs.get().mode == "trace":
+            from .. import obs as obs_pkg
+            obs_pkg.memory_snapshot()
+        self.tick_no += 1
+        return r
 
+    def _tick_body(self, X, y, weight, r: TickReport) -> None:
         # background retrain landed? gate + swap before anything reads
         # the new batch, so this tick already serves the fresher model
         self._poll_background(r)
@@ -297,15 +323,14 @@ class ContinualBooster:
             del self.history[:-_RETAIN // 2]
         if len(self.reports) > _RETAIN:
             del self.reports[:-_RETAIN // 2]
-        self.tick_no += 1
-        return r
 
     # -- refit ----------------------------------------------------------
     def _refit(self, X, y, weight, r: TickReport) -> None:
         try:
-            self.booster.refit(
-                X, y, weight=weight,
-                decay_rate=self.cfg.refit_decay_rate, inplace=True)
+            with obs.span("continual.refit", tick=self.tick_no):
+                self.booster.refit(
+                    X, y, weight=weight,
+                    decay_rate=self.cfg.refit_decay_rate, inplace=True)
             r.refit_applied = True
             guard = getattr(self.booster, "_refit_guard", None)
             r.refit_skipped = bool(guard is not None
@@ -382,11 +407,13 @@ class ContinualBooster:
             self._fault_remaining -= 1
             armed = int(self._retrain_fault["kill_at_iteration"])
         try:
-            if armed is not None:
-                with faultinject.injected(kill_at_iteration=armed):
-                    return _train(p, ds, num_boost_round=rounds,
-                                  resume=resume)
-            return _train(p, ds, num_boost_round=rounds, resume=resume)
+            with obs.span("continual.retrain", tag=tag,
+                          attempt=attempt_state["n"]):
+                if armed is not None:
+                    with faultinject.injected(kill_at_iteration=armed):
+                        return _train(p, ds, num_boost_round=rounds,
+                                      resume=resume)
+                return _train(p, ds, num_boost_round=rounds, resume=resume)
         finally:
             del ds
 
@@ -423,7 +450,10 @@ class ContinualBooster:
                 cleanup()
 
         if self.background:
-            holder: Dict[str, Any] = {"done": False}
+            # attempt_state rides the holder so status() reads the LIVE
+            # attempt count while the worker runs, not a post-hoc copy
+            holder: Dict[str, Any] = {"done": False,
+                                      "attempt_state": attempt_state}
 
             def worker():
                 try:
@@ -460,6 +490,28 @@ class ContinualBooster:
             log.warning("continual: retrain failed after %d attempt(s); "
                         "degrading to the last-good model: %s",
                         attempt_state["n"], exc)
+
+    def status(self) -> Dict[str, Any]:
+        """Retrain-in-flight status, observable BETWEEN ticks (before
+        this, a background retrain was only visible once the next tick
+        polled it):
+
+        * ``idle`` — no retrain in flight;
+        * ``retraining`` — the background worker is still running (the
+          live attempt count includes retries in progress);
+        * ``awaiting-gate`` — the worker finished and its candidate
+          (or failure) is waiting for the next tick's gate + swap.
+
+        Synchronous retrains run inside ``tick`` itself, so between
+        ticks they always read ``idle``."""
+        bg = self._bg
+        if bg is None:
+            return {"state": "idle", "attempts": 0,
+                    "generation": self.generation}
+        attempts = int(bg["attempt_state"]["n"])
+        state = "awaiting-gate" if bg.get("done") else "retraining"
+        return {"state": state, "attempts": attempts,
+                "generation": self.generation}
 
     def _poll_background(self, r: TickReport) -> None:
         if self._bg is None or not self._bg.get("done"):
@@ -506,6 +558,12 @@ class ContinualBooster:
     def _swap(self, cand, r: TickReport,
               snap: Optional[Dict[Any, int]] = None,
               t0: Optional[float] = None) -> None:
+        with obs.span("continual.swap", generation=self.generation + 1):
+            self._swap_impl(cand, r, snap, t0)
+
+    def _swap_impl(self, cand, r: TickReport,
+                   snap: Optional[Dict[Any, int]] = None,
+                   t0: Optional[float] = None) -> None:
         if t0 is None:
             t0 = time.perf_counter()
         if snap is None:
@@ -569,14 +627,15 @@ class ContinualBooster:
         to the pre-swap pack."""
         if self.last_good is None:
             return False
-        self.booster, self.last_good = self.last_good, None
-        self.generation += 1
-        self._watch_left = 0
-        self._pre_swap_baseline = None
-        self._cooldown = self.cfg.continual_cooldown
-        if r is not None:
-            r.rolled_back = True
-            r.generation = self.generation
+        with obs.span("continual.rollback", generation=self.generation + 1):
+            self.booster, self.last_good = self.last_good, None
+            self.generation += 1
+            self._watch_left = 0
+            self._pre_swap_baseline = None
+            self._cooldown = self.cfg.continual_cooldown
+            if r is not None:
+                r.rolled_back = True
+                r.generation = self.generation
         log.warning("continual: rolled back to the pre-swap model "
                     "(generation %d)", self.generation)
         return True

@@ -22,9 +22,22 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from .config import Config
+from .obs import memory as obs_memory
+from .obs import telemetry as obs
 from .ops.binning import (BIN_CATEGORICAL, BIN_NUMERICAL, MISSING_NAN,
                           MISSING_NONE, MISSING_ZERO, BinMapper)
 from .utils import log
+
+
+def _dataset_memory_arrays(ds):
+    """Telemetry memory provider: the packed binned matrix (host) and
+    the direct-to-device ingest buffers, when present."""
+    out = [ds.binned, getattr(ds, "raw_data", None)]
+    di = getattr(ds, "device_ingest", None)
+    if di is not None:
+        out.extend(v for v in vars(di).values()
+                   if getattr(v, "nbytes", None) is not None)
+    return [a for a in out if a is not None]
 
 
 def _fill_rows_t(dst: np.ndarray, start: int, packed_cols: np.ndarray
@@ -207,7 +220,19 @@ class BinnedDataset:
         data = np.asarray(data)
         if data.ndim != 2:
             log.fatal("Data must be 2-dimensional")
+        obs.configure_from_config(config)
+        with obs.span("dataset.construct", rows=int(data.shape[0]),
+                      features=int(data.shape[1])):
+            return BinnedDataset._from_matrix_impl(
+                data, config, label, weight, group, init_score,
+                feature_names, categorical_features, reference, position)
+
+    @staticmethod
+    def _from_matrix_impl(data, config, label, weight, group, init_score,
+                          feature_names, categorical_features, reference,
+                          position) -> "BinnedDataset":
         ds = BinnedDataset(config)
+        obs_memory.register("dataset.binned", ds, _dataset_memory_arrays)
         ds._resolve_construct_mode(is_reference=reference is not None)
         ds.num_data, ds.num_total_features = data.shape
         ds.feature_names = feature_names or [
@@ -263,6 +288,8 @@ class BinnedDataset:
         probe = np.asarray(first_nonempty[0:1], dtype=np.float64)
         F = probe.reshape(1, -1).shape[1]
         ds = BinnedDataset(config)
+        obs.configure_from_config(config)
+        obs_memory.register("dataset.binned", ds, _dataset_memory_arrays)
         ds._resolve_construct_mode(is_reference=reference is not None)
         ds.num_data = total
         ds.num_total_features = F

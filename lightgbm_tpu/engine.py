@@ -101,6 +101,9 @@ def train(params: Dict[str, Any], train_set: Dataset,
     callbacks_before.sort(key=lambda cb: getattr(cb, "order", 0))
     callbacks_after.sort(key=lambda cb: getattr(cb, "order", 0))
 
+    from . import obs
+    from .obs import telemetry as obs_tel
+
     booster.best_iteration = -1
     begin_iteration = 0
     if resume is None:
@@ -119,35 +122,42 @@ def train(params: Dict[str, Any], train_set: Dataset,
             ckpt_cb.seed_history(state.eval_history)
             log.info("resumed training from checkpoint at iteration %d "
                      "(%s)", begin_iteration, ckpt_cb.manager.dir)
-    for i in range(begin_iteration, num_boost_round):
-        if faultinject.is_active():
-            faultinject.maybe_kill(i)
-        for cb in callbacks_before:
-            cb(callback_mod.CallbackEnv(
-                model=booster, params=params, iteration=i,
-                begin_iteration=begin_iteration,
-                end_iteration=num_boost_round,
-                evaluation_result_list=None))
-        should_stop = booster.update(fobj=fobj)
-        evaluation_result_list = []
-        if valid_contain_train:
-            evaluation_result_list.extend(booster.eval_train(feval))
-        if booster._valid_names:
-            evaluation_result_list.extend(booster.eval_valid(feval))
-        try:
-            for cb in callbacks_after:
+    with obs_tel.span("train.total", rounds=num_boost_round,
+                      begin=begin_iteration):
+        for i in range(begin_iteration, num_boost_round):
+            if faultinject.is_active():
+                faultinject.maybe_kill(i)
+            for cb in callbacks_before:
                 cb(callback_mod.CallbackEnv(
                     model=booster, params=params, iteration=i,
                     begin_iteration=begin_iteration,
                     end_iteration=num_boost_round,
-                    evaluation_result_list=evaluation_result_list))
-        except EarlyStopException as es:
-            booster.best_iteration = es.best_iteration + 1
-            for item in es.best_score:
-                booster.best_score.setdefault(item[0], {})[item[1]] = item[2]
-            break
-        if should_stop:
-            break
+                    evaluation_result_list=None))
+            should_stop = booster.update(fobj=fobj)
+            evaluation_result_list = []
+            if valid_contain_train:
+                evaluation_result_list.extend(booster.eval_train(feval))
+            if booster._valid_names:
+                evaluation_result_list.extend(booster.eval_valid(feval))
+            try:
+                for cb in callbacks_after:
+                    cb(callback_mod.CallbackEnv(
+                        model=booster, params=params, iteration=i,
+                        begin_iteration=begin_iteration,
+                        end_iteration=num_boost_round,
+                        evaluation_result_list=evaluation_result_list))
+            except EarlyStopException as es:
+                booster.best_iteration = es.best_iteration + 1
+                for item in es.best_score:
+                    booster.best_score.setdefault(
+                        item[0], {})[item[1]] = item[2]
+                break
+            if should_stop:
+                break
+    # the train boundary is already host-synchronized (trees fetched),
+    # so attribute HBM to owners here in trace mode
+    if obs_tel.get().mode == "trace":
+        obs.memory_snapshot()
     return booster
 
 

@@ -19,10 +19,13 @@ import numpy as np
 
 from ..config import Config
 from ..dataset import BinnedDataset
+from ..obs import memory as obs_memory
+from ..obs import telemetry as obs
 from ..ops.predict import predict_leaf_binned
 from ..robustness import faultinject
 from ..robustness.guard import NonFiniteGuard
 from ..utils import log
+from ..utils.log import LightGBMError
 from .learner import SerialTreeLearner
 from .metric import Metric, create_metrics
 from .objective import ObjectiveFunction
@@ -250,6 +253,27 @@ def _phys_leaf_delta(rec, Npad: int):
     return (within + carry[:, None]).reshape(Npad)
 
 
+def _learner_memory_arrays(lr):
+    """Telemetry memory provider: the learner's resident device
+    buffers (master binned partition buffer + helper tables)."""
+    return [v for v in vars(lr).values()
+            if getattr(v, "nbytes", None) is not None]
+
+
+def _gbdt_memory_arrays(g):
+    """Telemetry memory provider: training-side score/physical state
+    plus the per-tree device arrays."""
+    out = [g._scores_arr, getattr(g, "train_binned", None)]
+    phys = getattr(g, "_phys", None)
+    if phys is not None:
+        out.extend(phys)
+    for dt in g.device_trees:
+        if dt is not None:
+            out.append(dt["nodes"])
+            out.append(dt["leaf_value"])
+    return out
+
+
 class GBDT:
     """Gradient Boosting Decision Tree engine (reference: src/boosting/gbdt.cpp)."""
 
@@ -416,6 +440,12 @@ class GBDT:
         self.bag_rng = jax.random.PRNGKey(cfg.bagging_seed)
         self.feat_rng = jax.random.PRNGKey(cfg.feature_fraction_seed)
         self.goss = cfg.data_sample_strategy == "goss"
+        # HBM attribution for telemetry (obs/memory.py): the learner's
+        # master binned buffer and the training-side score state are
+        # the two big per-booster residents besides the serving packs
+        obs_memory.register("train.binned", self.learner,
+                            _learner_memory_arrays)
+        obs_memory.register("train.state", self, _gbdt_memory_arrays)
         # balanced (per-class) bagging engages whenever either class
         # fraction is below 1 (reference: bagging.hpp:88)
         self.balanced_bagging = (
@@ -558,6 +588,9 @@ class GBDT:
             return
 
         def step(part_bins, scores, feature_mask, seed, feat_used):
+            # trace-time-only host hook: one call == one XLA compile of
+            # this program (obs retrace detector; zero HLO)
+            obs.compile_event("train.fused_step")
             grad, hess = obj.get_gradients(scores)
             rec = lr_._build_impl(part_bins, grad, hess, jnp.int32(N),
                                   feature_mask, seed, feat_used)
@@ -659,6 +692,7 @@ class GBDT:
                       else None)
 
         def step(part_bins, ghi, feature_mask, seed, feat_used):
+            obs.compile_event("train.fused_step")   # trace-time only
             rowid = jax.lax.bitcast_convert_type(ghi[2], jnp.int32)
             vf = (rowid != N).astype(jnp.float32)   # pad rows: grad/hess 0
             payload = {n: ghi[4 + i] for i, n in enumerate(names)}
@@ -877,6 +911,7 @@ class GBDT:
         needs_snap = self._mc_fused_kind() == "snapshot"
 
         def step(part_bins, ghi, feature_mask, seed, feat_used):
+            obs.compile_event("train.fused_step")   # trace-time only
             smalls = []
             P = None
             if needs_snap:
@@ -1060,6 +1095,7 @@ class GBDT:
         F = lr_.F
 
         def step_shard(pb, ghi, feature_mask, seed, feat_used):
+            obs.compile_event("train.fused_step")   # trace-time only
             rowid = jax.lax.bitcast_convert_type(ghi[2], jnp.int32)
             vf = (rowid != SENT).astype(jnp.float32)
             payload = {n: ghi[4 + i] for i, n in enumerate(names)}
@@ -1597,12 +1633,26 @@ class GBDT:
                 self.valid_scores[vi] = self.valid_scores[vi].at[:, k].add(dv)
 
     # ------------------------------------------------------------------
+    def _assert_trainable(self) -> None:
+        if getattr(self, "_serving_only", False):
+            # refit(inplace=True) rewrote the leaf values: the training
+            # scores (and any physical fused state) no longer match the
+            # model, so another update would silently train on stale
+            # state (the PR 6 known hazard — now a loud error)
+            raise LightGBMError(
+                "cannot update() a serving-only booster: "
+                "refit(inplace=True) rewrote its leaf values, so the "
+                "training-side scores no longer match the model; "
+                "continue training from a fresh booster "
+                "(train(init_model=...)) instead")
+
     def train_one_iter(self, grad=None, hess=None) -> bool:
         """One boosting iteration (reference: gbdt.cpp TrainOneIter:338).
 
         Returns True when training should stop (no further splits possible).
         """
         from ..utils.timer import global_timer
+        self._assert_trainable()
         if grad is None and hess is None and self._fused is not None:
             return self._train_one_iter_fused()
         # the eager path appends trees directly: any lagged fused records
@@ -1797,8 +1847,9 @@ class GBDT:
     def eval_metrics(self) -> Dict[str, List[Tuple[str, float, bool]]]:
         """Evaluate all metrics; returns {dataset_name: [(metric, value, is_max_better)]}."""
         from ..utils.timer import global_timer
-        with global_timer.section("Metric::Eval"):
-            return self._eval_metrics_impl()
+        with obs.span("train.eval"):
+            with global_timer.section("Metric::Eval"):
+                return self._eval_metrics_impl()
 
     def _eval_metrics_impl(self):
         out: Dict[str, List[Tuple[str, float, bool]]] = {}
@@ -2064,8 +2115,10 @@ class GBDT:
                     slot[:n] = vals[:n]
                     dt["leaf_value"] = jnp.asarray(slot)
         self.init_scores = [0.0] * self.num_tree_per_iteration
-        # training-side state is stale from here on (see docstring)
+        # training-side state is stale from here on (see docstring);
+        # train_one_iter refuses serving-only boosters loudly
         self._phys = None
+        self._serving_only = True
         self._model_version += 1
         self.serving.refit_leaf_values(
             [np.asarray(v, np.float64) for v in new_values])
@@ -2142,6 +2195,8 @@ class DART(GBDT):
         # select trees to drop (reference: dart.hpp DroppingTrees:97 —
         # per-tree Bernoulli draws; non-uniform mode weights each tree by
         # its stored weight relative to the average, capped by max_drop)
+        # (serving-only guard BEFORE the drop bookkeeping mutates scores)
+        self._assert_trainable()
         self._flush_pending()
         cfg = self.config
         K = self.num_tree_per_iteration

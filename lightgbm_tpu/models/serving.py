@@ -46,12 +46,22 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..obs import memory as obs_memory
+from ..obs import telemetry as obs
 from ..ops.predict import predict_leaf_binned, predict_leaf_thridx
 from ..ops.shap import leggauss_01, tree_shap_stacked
 from .shap import _expected_value, tree_path_arrays
 from .tree import K_CATEGORICAL_MASK
 
 K_EPSILON = 1e-15
+
+
+def _pack_memory_arrays(eng):
+    """Telemetry memory provider: every pack payload (full forests and
+    range sub-packs) this engine keeps resident."""
+    out = [payload for _, payload in eng._packs.values()]
+    out.extend(eng._range_packs.values())
+    return out
 
 
 def bucket_rows(n: int, min_bucket: int = 128,
@@ -93,6 +103,8 @@ class ServingEngine:
         # them, so the copy is serving-shaped traffic too) instead of
         # silently answering small batches from the host paths
         self._rewarm: set = set()
+        # telemetry HBM attribution: whatever packs this engine holds
+        obs_memory.register("serving.packs", self, _pack_memory_arrays)
 
     # jitted callables and device packs are neither picklable nor worth
     # copying (sklearn deepcopy / dask shipping): a copy re-packs and
@@ -151,6 +163,10 @@ class ServingEngine:
     def _count_trace(self, kind: str, bucket: int) -> None:
         k = (kind, bucket)
         self.trace_counts[k] = self.trace_counts.get(k, 0) + 1
+        # runtime retrace detector (obs/): the same per-(kind, bucket)
+        # compile counts the tests pin, now visible while serving —
+        # attributed to whichever span (tick, swap, predict) traced it
+        obs.compile_event(f"serving.{kind}@{bucket}")
 
     def _count_call(self, kind: str, bucket: int) -> None:
         k = (kind, bucket)
@@ -301,7 +317,13 @@ class ServingEngine:
                                dtype=chunk.dtype)
                 chunk = np.concatenate([chunk, pad], axis=0)
             self._count_call(kind, bucket)
-            out[start:stop] = run(chunk)[:stop - start]
+            # per-(kind, bucket) latency histogram: run() materializes
+            # its result to the host, so the span measures the real
+            # round trip — no extra sync is added (off mode skips even
+            # the name formatting)
+            with (obs.span(f"serve.{kind}@{bucket}")
+                  if obs.enabled() else obs.NULL):
+                out[start:stop] = run(chunk)[:stop - start]
         return out
 
     # ------------------------------------------------------------------

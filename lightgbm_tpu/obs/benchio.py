@@ -1,0 +1,87 @@
+"""Machine-readable benchmark artifacts: ``BENCH_obs.json``.
+
+The perf trajectory to date lives in PERF.md prose; every bench/ab_bench
+run now also drops one structured artifact so rounds can be diffed,
+plotted and regression-checked by tooling.  One file per run (atomic
+write), schema::
+
+    {"schema": "lightgbm-tpu/bench-obs/v1",
+     "tool": "bench" | "ab_bench" | ...,
+     "unix_time": ..., "backend": "cpu"|"tpu"|...,
+     "config": {...},            # the knobs that shaped the run
+     "timings": {...},           # the tool's own timing report
+     "compile_counts": {...},    # telemetry compile events (key -> n)
+     "memory_peaks": {...}}      # ledger owners + backend allocator stats
+
+Path: ``--obs-out``/caller argument, else ``$BENCH_OBS_PATH``, else
+``BENCH_obs.json`` in the working directory.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+from . import memory as obs_memory
+from . import telemetry as obs_telemetry
+from .exporters import _atomic_write
+
+SCHEMA = "lightgbm-tpu/bench-obs/v1"
+
+__all__ = ["SCHEMA", "default_path", "collect_compile_counts",
+           "collect_memory_peaks", "write_bench_obs"]
+
+
+def default_path() -> str:
+    return os.environ.get("BENCH_OBS_PATH", "BENCH_obs.json")
+
+
+def collect_compile_counts() -> Dict[str, int]:
+    return dict(obs_telemetry.get().report()["compiles"])
+
+
+def collect_memory_peaks() -> Dict[str, Any]:
+    snap = obs_memory.snapshot()
+    out: Dict[str, Any] = {
+        "owners": snap["owners"],
+        "live_device_bytes": snap["live_device_bytes"],
+    }
+    if snap["device_memory_stats"]:
+        out["backend"] = snap["device_memory_stats"]
+    return out
+
+
+def write_bench_obs(tool: str, config: Dict[str, Any],
+                    timings: Dict[str, Any],
+                    compile_counts: Optional[Dict[str, int]] = None,
+                    memory_peaks: Optional[Dict[str, Any]] = None,
+                    path: Optional[str] = None) -> str:
+    """Write the artifact; never raises past a warning (a failed
+    artifact write must not sink a finished benchmark)."""
+    try:
+        import jax
+        backend = jax.default_backend()
+    except Exception:
+        backend = "unknown"
+    doc = {
+        "schema": SCHEMA,
+        "tool": tool,
+        "unix_time": round(time.time(), 3),
+        "backend": backend,
+        "config": config,
+        "timings": timings,
+        "compile_counts": (collect_compile_counts()
+                           if compile_counts is None else compile_counts),
+        "memory_peaks": (collect_memory_peaks()
+                         if memory_peaks is None else memory_peaks),
+    }
+    out = path or default_path()
+    try:
+        return _atomic_write(out, json.dumps(doc, sort_keys=True,
+                                             default=str) + "\n")
+    except OSError as exc:
+        from ..utils import log
+        log.warning("could not write %s: %s", out, exc)
+        return out

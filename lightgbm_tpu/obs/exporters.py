@@ -1,0 +1,120 @@
+"""Telemetry exporters: JSONL event log, Chrome-trace/Perfetto
+``trace.json``, and a Prometheus-style text dump.
+
+All three read one :class:`~lightgbm_tpu.obs.telemetry.Telemetry`
+session and write atomically (temp + rename) so a crash mid-export
+never leaves a truncated artifact.  The Chrome trace loads directly in
+``chrome://tracing`` / Perfetto; spans are complete ("X") events,
+memory gauges are counter ("C") tracks and compile events are instant
+("i") marks — ``tools/trace_report.py`` validates and summarizes the
+same format.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import tempfile
+from typing import Any, Dict
+
+__all__ = ["export_chrome_trace", "export_jsonl", "export_prometheus",
+           "prometheus_text", "export_all"]
+
+
+def _atomic_write(path: str, text: str) -> str:
+    path = os.path.abspath(path)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path),
+                               prefix=os.path.basename(path) + ".tmp")
+    try:
+        with os.fdopen(fd, "w") as fh:
+            fh.write(text)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return path
+
+
+def export_chrome_trace(tel, path: str) -> str:
+    """Write ``path`` as a Chrome-trace JSON object (the
+    ``traceEvents`` array format Perfetto also loads)."""
+    events = tel.snapshot_events()
+    meta = [{"ph": "M", "name": "process_name", "pid": os.getpid(),
+             "ts": 0, "args": {"name": "lightgbm_tpu"}}]
+    doc = {
+        "traceEvents": meta + events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "exporter": "lightgbm_tpu.obs",
+            "mode": tel.mode,
+            "epoch_unix": tel.epoch_unix,
+            "events_dropped": tel.events_dropped,
+        },
+    }
+    return _atomic_write(path, json.dumps(doc))
+
+
+def export_jsonl(tel, path: str) -> str:
+    """One JSON object per line: a ``report`` header (the aggregate
+    counters/spans/compiles) followed by every recorded event."""
+    lines = [json.dumps({"type": "report", **tel.report()},
+                        sort_keys=True)]
+    for ev in tel.snapshot_events():
+        lines.append(json.dumps({"type": "event", **ev}))
+    return _atomic_write(path, "\n".join(lines) + "\n")
+
+
+_LABEL_BAD = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _esc(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"')
+
+
+def prometheus_text(tel) -> str:
+    """Prometheus exposition-format dump of the aggregate state (a
+    text snapshot, not a live scrape endpoint — pipe it wherever the
+    fleet's node exporter picks up textfiles)."""
+    rep = tel.report()
+    out = []
+    out.append("# TYPE lightgbm_tpu_span_count counter")
+    out.append("# TYPE lightgbm_tpu_span_seconds_sum counter")
+    out.append("# TYPE lightgbm_tpu_span_seconds summary")
+    for name, h in sorted(rep["spans"].items()):
+        lbl = f'{{name="{_esc(name)}"}}'
+        out.append(f"lightgbm_tpu_span_count{lbl} {h['count']}")
+        out.append(f"lightgbm_tpu_span_seconds_sum{lbl} {h['total_s']}")
+        for q, qv in (("p50_s", "0.5"), ("p99_s", "0.99")):
+            out.append('lightgbm_tpu_span_seconds{name="%s",quantile="%s"}'
+                       ' %s' % (_esc(name), qv, h[q]))
+    out.append("# TYPE lightgbm_tpu_counter_total counter")
+    for name, v in sorted(rep["counters"].items()):
+        out.append(f'lightgbm_tpu_counter_total{{name="{_esc(name)}"}} {v}')
+    out.append("# TYPE lightgbm_tpu_compiles_total counter")
+    for key, v in sorted(rep["compiles"].items()):
+        out.append(f'lightgbm_tpu_compiles_total{{key="{_esc(key)}"}} {v}')
+    out.append("# TYPE lightgbm_tpu_gauge gauge")
+    for name, v in sorted(rep["gauges"].items()):
+        out.append(f'lightgbm_tpu_gauge{{name="{_esc(name)}"}} {float(v)}')
+    out.append(f"lightgbm_tpu_events_dropped {rep['events_dropped']}")
+    return "\n".join(out) + "\n"
+
+
+def export_prometheus(tel, path: str) -> str:
+    return _atomic_write(path, prometheus_text(tel))
+
+
+def export_all(tel, out_dir: str) -> Dict[str, str]:
+    """Write all three artifacts under ``out_dir``; returns their
+    paths (the CLI's ``telemetry_out=`` entry point)."""
+    os.makedirs(out_dir, exist_ok=True)
+    return {
+        "jsonl": export_jsonl(tel, os.path.join(out_dir,
+                                                "telemetry.jsonl")),
+        "trace": export_chrome_trace(tel, os.path.join(out_dir,
+                                                       "trace.json")),
+        "prometheus": export_prometheus(tel, os.path.join(out_dir,
+                                                          "metrics.prom")),
+    }

@@ -1,0 +1,164 @@
+"""Device-memory accounting: attribute HBM (and pinned host buffers)
+to named owners.
+
+``jax.live_arrays()`` answers "how much is alive" but not "who holds
+it"; the question an operator actually asks — is it the binned
+dataset, the training state, the serving packs, or the continual
+buffers? — needs the holders themselves to say what they own.  Each
+subsystem registers a named provider (a function over a weakly-held
+owner object returning its arrays); :func:`snapshot` walks the
+registry, sums bytes per owner split device/host, and pairs that with
+the backend totals (``live_arrays`` + ``Device.memory_stats`` where the
+backend exposes them — TPU/GPU do, CPU usually returns nothing).
+
+Registration is unconditional and ~free (a weakref in a dict); the
+walk only happens when something asks — a span boundary in trace mode,
+``Booster.telemetry_report()``, or the benchmark artifact writer.
+Providers must never *materialize* device data: they return array
+references whose ``nbytes`` is host metadata, so a snapshot is
+sync-free like every other telemetry path.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = ["MemoryLedger", "LEDGER", "register", "snapshot",
+           "snapshot_to"]
+
+
+def _leaves(tree) -> List[Any]:
+    try:
+        import jax
+        return jax.tree_util.tree_leaves(tree)
+    except Exception:
+        return tree if isinstance(tree, (list, tuple)) else [tree]
+
+
+def _is_device_array(x) -> bool:
+    try:
+        import jax
+        return isinstance(x, jax.Array)
+    except Exception:
+        return False
+
+
+def live_device_bytes() -> Optional[int]:
+    """Total bytes of every live ``jax.Array`` in the process, or None
+    when the runtime can't enumerate them."""
+    try:
+        import jax
+        return int(sum(getattr(a, "nbytes", 0) or 0
+                       for a in jax.live_arrays()))
+    except Exception:
+        return None
+
+
+def backend_memory_stats() -> Optional[Dict[str, int]]:
+    """Allocator stats of device 0 (``bytes_in_use`` /
+    ``peak_bytes_in_use`` / ``bytes_limit`` where present); None when
+    the backend doesn't report them (CPU typically doesn't)."""
+    try:
+        import jax
+        stats = jax.devices()[0].memory_stats()
+        if not stats:
+            return None
+        keep = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+                "largest_alloc_size")
+        out = {k: int(stats[k]) for k in keep if k in stats}
+        return out or None
+    except Exception:
+        return None
+
+
+class MemoryLedger:
+    """Registry of named owners -> array providers (weakly held)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # (owner name, id(obj)) -> (weakref, provider)
+        self._providers: Dict[Any, Any] = {}
+
+    def register(self, owner: str, obj: Any,
+                 provider: Callable[[Any], Any]) -> None:
+        """Attribute ``provider(obj)``'s arrays to ``owner``.  ``obj``
+        is held weakly — a dead owner leaves the ledger via its weakref
+        callback, so registration is leak-free even when telemetry is
+        off and snapshot() (the other pruning point) never runs — and
+        several instances may share one owner name (their bytes sum)."""
+        key = (owner, id(obj))
+        # dict.pop is GIL-atomic; no lock in the GC callback (taking
+        # self._lock there could deadlock against a holder that
+        # triggers collection)
+        try:
+            ref = weakref.ref(
+                obj, lambda _r, _k=key: self._providers.pop(_k, None))
+        except TypeError:
+            return                      # unweakrefable: skip, never crash
+        with self._lock:
+            self._providers[key] = (ref, provider)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            items = list(self._providers.items())
+        owners: Dict[str, Dict[str, int]] = {}
+        dead = []
+        for key, (ref, provider) in items:
+            obj = ref()
+            if obj is None:
+                dead.append(key)
+                continue
+            try:
+                leaves = _leaves(provider(obj))
+            except Exception:
+                continue                # a provider must never sink a report
+            dev = host = 0
+            for leaf in leaves:
+                nb = getattr(leaf, "nbytes", None)
+                if nb is None:
+                    continue
+                if _is_device_array(leaf):
+                    dev += int(nb)
+                else:
+                    host += int(nb)
+            slot = owners.setdefault(key[0], {"device_bytes": 0,
+                                              "host_bytes": 0})
+            slot["device_bytes"] += dev
+            slot["host_bytes"] += host
+        if dead:
+            with self._lock:
+                for key in dead:
+                    self._providers.pop(key, None)
+        return {"owners": owners,
+                "live_device_bytes": live_device_bytes(),
+                "device_memory_stats": backend_memory_stats()}
+
+
+LEDGER = MemoryLedger()
+
+
+def register(owner: str, obj: Any, provider: Callable[[Any], Any]) -> None:
+    LEDGER.register(owner, obj, provider)
+
+
+def snapshot() -> Dict[str, Any]:
+    return LEDGER.snapshot()
+
+
+def snapshot_to(tel) -> Dict[str, Any]:
+    """Take a snapshot and record it as gauges on telemetry session
+    ``tel`` (``mem.<owner>.device_bytes`` etc.), so span-boundary
+    snapshots land in the exported trace as counter tracks."""
+    snap = snapshot()
+    for owner, b in snap["owners"].items():
+        tel.gauge(f"mem.{owner}.device_bytes", b["device_bytes"])
+        tel.gauge(f"mem.{owner}.host_bytes", b["host_bytes"])
+    if snap["live_device_bytes"] is not None:
+        tel.gauge("mem.live_device_bytes", snap["live_device_bytes"])
+    stats = snap["device_memory_stats"]
+    if stats:
+        for k, v in stats.items():
+            tel.gauge(f"mem.backend.{k}", v)
+    return snap

@@ -1,0 +1,378 @@
+"""Runtime telemetry: spans, counters, gauges, and a retrace/compile
+detector for a *running* trainer or server.
+
+Until this module, every performance claim lived in hand-run PERF.md
+rounds and test-time guards (jaxlint tier-B budgets, compile-count
+pins): there was no way to observe which iteration re-traced, how long
+a continual tick really took, or what HBM the packed forests hold.
+This is the runtime counterpart of those static guards — the same
+signals the serving/continual comparison baselines report at runtime
+(per-bucket latency percentiles, compile events, device-memory
+residency; cf. the Gemma-on-TPU serving notes and the Booster GBDT
+inference accelerator in PAPERS.md).
+
+The contract (pinned by the jaxlint tier-B ``telemetry.off`` budget and
+``tests/test_telemetry.py``):
+
+* **Zero-HLO** — nothing here ever stages a device op.  Spans and
+  counters are host-side `time.perf_counter` bookkeeping; the compile
+  detector is a Python side effect that only runs while `jax.jit`
+  traces.  The lowered train while-body is op-for-op identical with
+  telemetry off or at full trace mode.
+* **Zero-sync** — spans never call ``block_until_ready``: they time
+  dispatch as issued and rely on boundaries the caller already syncs
+  (eval ticks, the bucketed serving path's host materialization).
+  ``telemetry=off`` is therefore bit-identical *and* timing-neutral
+  end-to-end.
+* **Off is (almost) free** — with the session off, every module-level
+  entry point is one attribute load and one string compare; no
+  objects allocate, no locks take.
+
+Modes: ``off`` (default) < ``counters`` (aggregate spans/counters/
+compile events on the host) < ``trace`` (counters plus a bounded
+event ring exportable as Chrome trace / JSONL / Prometheus — see
+:mod:`lightgbm_tpu.obs.exporters` — with ``jax.profiler``
+``TraceAnnotation`` bridging so device profiles carry our span names).
+
+One process-wide session: training, serving and the continual runtime
+all write to it, so one exported trace shows the whole pipeline.
+``Booster.telemetry_report()`` reads it; the ``telemetry=`` config
+parameter enables it (upgrade-only: a second booster asking for
+``counters`` never downgrades a session already at ``trace``).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = [
+    "MODES", "Telemetry", "get", "enabled", "configure_from_config",
+    "span", "counter", "gauge", "compile_event", "NULL",
+]
+
+MODES = ("off", "counters", "trace")
+_MODE_RANK = {m: i for i, m in enumerate(MODES)}
+
+# bounded trace-event ring: a forever-running continual loop must not
+# grow without bound.  A true ring — the OLDEST events evict first, so
+# the exported trace always holds the most recent window (the one an
+# operator wants after an incident); evictions are counted, never
+# silent.
+MAX_EVENTS = 200_000
+
+
+class _NullSpan:
+    """Shared no-op context manager — the disabled fast path allocates
+    nothing per call."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+NULL = _NullSpan()
+
+
+class Histogram:
+    """Log2-bucketed duration histogram (microsecond buckets).
+
+    Fixed memory per metric, O(1) observe, and quantiles good to a
+    factor-of-two bucket width — the right fidelity for p50/p99 serving
+    latency without keeping raw samples."""
+
+    NBUCKETS = 40            # bucket i holds durations < 2^i us (~13 days)
+    __slots__ = ("count", "total_s", "min_s", "max_s", "buckets")
+
+    def __init__(self):
+        self.count = 0
+        self.total_s = 0.0
+        self.min_s = float("inf")
+        self.max_s = 0.0
+        self.buckets = [0] * self.NBUCKETS
+
+    def observe(self, seconds: float) -> None:
+        self.count += 1
+        self.total_s += seconds
+        if seconds < self.min_s:
+            self.min_s = seconds
+        if seconds > self.max_s:
+            self.max_s = seconds
+        b = int(seconds * 1e6).bit_length()      # 0us -> bucket 0
+        self.buckets[min(b, self.NBUCKETS - 1)] += 1
+
+    def quantile(self, q: float) -> float:
+        """Bucket-upper-bound estimate of the q-quantile, in seconds."""
+        if self.count == 0:
+            return 0.0
+        target = q * self.count
+        seen = 0
+        for i, n in enumerate(self.buckets):
+            seen += n
+            if seen >= target:
+                return min((1 << i) * 1e-6, self.max_s)
+        return self.max_s
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"count": self.count,
+                "total_s": round(self.total_s, 6),
+                "min_s": round(self.min_s, 6) if self.count else 0.0,
+                "max_s": round(self.max_s, 6),
+                "mean_s": round(self.total_s / self.count, 6)
+                if self.count else 0.0,
+                "p50_s": round(self.quantile(0.50), 6),
+                "p99_s": round(self.quantile(0.99), 6)}
+
+
+class _Span:
+    """One timed section.  Never syncs the device; in trace mode it
+    also enters a ``jax.profiler.TraceAnnotation`` so device profiles
+    (TensorBoard/Perfetto) carry the same name."""
+
+    __slots__ = ("tel", "name", "args", "t0", "ann")
+
+    def __init__(self, tel: "Telemetry", name: str, args: Dict[str, Any]):
+        self.tel = tel
+        self.name = name
+        self.args = args
+        self.ann = None
+
+    def __enter__(self):
+        tel = self.tel
+        tel._stack().append(self.name)
+        if tel.mode == "trace" and tel.profiler_bridge:
+            try:
+                import jax
+                self.ann = jax.profiler.TraceAnnotation(self.name)
+                self.ann.__enter__()
+            except Exception:
+                self.ann = None
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter()
+        tel = self.tel
+        if self.ann is not None:
+            try:
+                self.ann.__exit__(*exc)
+            except Exception:
+                pass
+        stack = tel._stack()
+        if stack and stack[-1] == self.name:
+            stack.pop()
+        tel._record_span(self.name, self.t0, t1 - self.t0, self.args)
+        return False
+
+
+class Telemetry:
+    """One telemetry session (see module docstring).  Thread-safe: the
+    continual runtime's background retrain and concurrent serving calls
+    write from their own threads."""
+
+    def __init__(self, mode: str = "off", max_events: int = MAX_EVENTS):
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        self.max_events = int(max_events)
+        # jax.profiler TraceAnnotation bridging in trace mode (cheap —
+        # a TraceMe — but switchable for pure-host unit tests)
+        self.profiler_bridge = True
+        self.mode = "off"
+        self.reset(mode=mode)
+
+    # -- lifecycle ------------------------------------------------------
+    def set_mode(self, mode: str) -> None:
+        if mode not in MODES:
+            raise ValueError(f"telemetry mode must be one of {MODES}, "
+                             f"got {mode!r}")
+        self.mode = mode
+
+    def enable(self, mode: str) -> None:
+        """Upgrade-only mode switch: off -> counters -> trace.  A
+        booster asking for less never silences a session another
+        component already raised."""
+        if mode not in MODES:
+            raise ValueError(f"telemetry mode must be one of {MODES}, "
+                             f"got {mode!r}")
+        if _MODE_RANK[mode] > _MODE_RANK[self.mode]:
+            self.mode = mode
+
+    def reset(self, mode: Optional[str] = None) -> None:
+        """Clear every counter, histogram and event (the clean-slate
+        the pickle/deepcopy round-trip test asserts); optionally set
+        the mode."""
+        import collections
+        with self._lock:
+            self.counters: Dict[str, int] = {}
+            self.gauges: Dict[str, float] = {}
+            self.spans: Dict[str, Histogram] = {}
+            self.compiles: Dict[str, int] = {}
+            self.compile_spans: Dict[str, Optional[str]] = {}
+            self.events = collections.deque(maxlen=self.max_events)
+            self.events_dropped = 0
+            self.epoch = time.perf_counter()
+            self.epoch_unix = time.time()
+        if mode is not None:
+            self.set_mode(mode)
+
+    @property
+    def enabled(self) -> bool:
+        return self.mode != "off"
+
+    # -- span plumbing --------------------------------------------------
+    def _stack(self) -> List[str]:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    def current_span(self) -> Optional[str]:
+        st = self._stack()
+        return st[-1] if st else None
+
+    def span(self, name: str, **args):
+        """Context manager timing a section under ``name``; ``args``
+        ride trace events only (aggregation is keyed by the name, so
+        bake low-cardinality dimensions — e.g. the serving bucket —
+        into the name itself)."""
+        if self.mode == "off":
+            return NULL
+        return _Span(self, name, args)
+
+    def _record_span(self, name: str, t0: float, dur: float,
+                     args: Dict[str, Any]) -> None:
+        with self._lock:
+            h = self.spans.get(name)
+            if h is None:
+                h = self.spans[name] = Histogram()
+            h.observe(dur)
+            if self.mode == "trace":
+                self._event({"ph": "X", "name": name,
+                             "ts": int((t0 - self.epoch) * 1e6),
+                             "dur": max(int(dur * 1e6), 1),
+                             "args": args or {}})
+
+    def _event(self, ev: Dict[str, Any]) -> None:
+        # lock held by the caller; the deque's maxlen evicts the OLDEST
+        # event so the ring always keeps the most recent window
+        if len(self.events) >= self.max_events:
+            self.events_dropped += 1
+        ev.setdefault("pid", os.getpid())
+        ev.setdefault("tid", threading.get_ident() % 0x7fffffff)
+        self.events.append(ev)
+
+    # -- counters / gauges ----------------------------------------------
+    def counter(self, name: str, inc: int = 1) -> None:
+        if self.mode == "off":
+            return
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + inc
+
+    def gauge(self, name: str, value: float) -> None:
+        if self.mode == "off":
+            return
+        with self._lock:
+            self.gauges[name] = value
+            if self.mode == "trace":
+                self._event({"ph": "C", "name": name,
+                             "ts": int((time.perf_counter() - self.epoch)
+                                       * 1e6),
+                             "args": {"value": value}})
+
+    # -- retrace/compile detector ---------------------------------------
+    def compile_event(self, key: str) -> None:
+        """Call this from INSIDE a function handed to ``jax.jit``: the
+        Python body only executes while XLA traces, so one call == one
+        compile of that entry point — the runtime retrace detector,
+        attributed to the innermost active span.  Zero HLO (a host side
+        effect), zero work when the session is off."""
+        if self.mode == "off":
+            return
+        owner = self.current_span()
+        with self._lock:
+            self.compiles[key] = self.compiles.get(key, 0) + 1
+            if owner is not None or key not in self.compile_spans:
+                self.compile_spans[key] = owner
+            if self.mode == "trace":
+                self._event({"ph": "i", "s": "t", "name": f"compile:{key}",
+                             "ts": int((time.perf_counter() - self.epoch)
+                                       * 1e6),
+                             "args": {"span": owner}})
+
+    # -- reporting ------------------------------------------------------
+    def report(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "mode": self.mode,
+                "counters": dict(self.counters),
+                "gauges": dict(self.gauges),
+                "spans": {n: h.to_json()
+                          for n, h in sorted(self.spans.items())},
+                "compiles": dict(self.compiles),
+                "compile_spans": dict(self.compile_spans),
+                "events_recorded": len(self.events),
+                "events_dropped": self.events_dropped,
+            }
+
+    def snapshot_events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self.events)
+
+
+# ---------------------------------------------------------------------------
+# the process-wide session + allocation-free module entry points
+# ---------------------------------------------------------------------------
+_ENV_MODE = os.environ.get("LIGHTGBM_TPU_TELEMETRY", "off")
+_SESSION = Telemetry(_ENV_MODE if _ENV_MODE in MODES else "off")
+
+
+def get() -> Telemetry:
+    return _SESSION
+
+
+def enabled() -> bool:
+    return _SESSION.mode != "off"
+
+
+def configure_from_config(cfg) -> Telemetry:
+    """Enable the session from a Config's ``telemetry`` parameter
+    (upgrade-only; invalid values fail loudly like any other bad
+    parameter)."""
+    mode = str(getattr(cfg, "telemetry", "off") or "off").strip().lower()
+    if mode not in MODES:
+        from ..utils import log
+        log.fatal("telemetry must be one of %s, got %r",
+                  "|".join(MODES), mode)
+    if mode != "off":
+        _SESSION.enable(mode)
+    return _SESSION
+
+
+def span(name: str, **args):
+    if _SESSION.mode == "off":
+        return NULL
+    return _SESSION.span(name, **args)
+
+
+def counter(name: str, inc: int = 1) -> None:
+    if _SESSION.mode == "off":
+        return
+    _SESSION.counter(name, inc)
+
+
+def gauge(name: str, value: float) -> None:
+    if _SESSION.mode == "off":
+        return
+    _SESSION.gauge(name, value)
+
+
+def compile_event(key: str) -> None:
+    if _SESSION.mode == "off":
+        return
+    _SESSION.compile_event(key)

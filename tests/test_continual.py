@@ -271,3 +271,89 @@ def test_tick_metric_matches_objective():
         np.mean((raw - y) ** 2))
     with pytest.raises(LightGBMError, match="continual_metric"):
         tick_metric("bogus", y, raw)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE-8 satellites: serving-only guard + retrain-in-flight status
+# ---------------------------------------------------------------------------
+def test_update_after_inplace_refit_raises():
+    """PR-6 known hazard, now a loud error: refit(inplace=True) makes a
+    booster serving-only (its training scores no longer match the
+    model), so update() must refuse instead of silently training on
+    stale state."""
+    rng = np.random.RandomState(13)
+    X = rng.normal(size=(512, 5))
+    y = X @ rng.normal(size=5) + 0.1 * rng.normal(size=512)
+    bst = lgb.train({"objective": "regression", "verbosity": -1,
+                     "num_leaves": 7, "metric": ""},
+                    lgb.Dataset(X, label=y), num_boost_round=3)
+    bst.update()                              # trainable before the refit
+    out = bst.refit(X, -y, decay_rate=0.0, inplace=True)
+    assert out is bst
+    with pytest.raises(LightGBMError, match="serving-only"):
+        bst.update()
+    # serving still works; only training is closed
+    assert np.isfinite(bst.predict(X[:8])).all()
+    # the OUT-OF-PLACE refit leaves the original booster trainable
+    bst2 = lgb.train({"objective": "regression", "verbosity": -1,
+                      "num_leaves": 7, "metric": ""},
+                     lgb.Dataset(X, label=y), num_boost_round=3)
+    bst2.refit(X, -y, decay_rate=0.0)
+    bst2.update()
+
+
+def test_background_retrain_status_transitions():
+    """ContinualBooster(background=True).status() exposes the retrain
+    in flight BETWEEN ticks — idle -> retraining (live attempt count,
+    here including one killed attempt) -> awaiting-gate -> idle after
+    the swap lands — instead of being observable only at the next
+    tick's poll."""
+    import threading
+
+    p = dict(_DRILL_PARAMS)
+    p.update({"num_iterations": 6, "num_leaves": 7,
+              "continual_retrain_attempts": 3,
+              "continual_backoff_base": 0.001})
+    warm = DriftStream(num_features=5, rows=512, seed=51)
+    X0, y0 = warm.batch(0)
+    cb = ContinualBooster(p, X0, y0, background=True,
+                          sleep=lambda d: None)
+    stream = DriftStream(num_features=5, rows=128, seed=52)
+    assert cb.status() == {"state": "idle", "attempts": 0,
+                           "generation": 0}
+
+    cb.tick(*stream.batch(0))                 # arms the gate batch
+    started = threading.Event()
+    release = threading.Event()
+
+    def fake_retrain(tag, attempt_state, batches):
+        # attempt 1 dies (the kill-mid-retrain drill shape); attempt 2
+        # blocks until the test has observed the live status, then
+        # builds a real candidate
+        attempt_state["n"] += 1
+        if attempt_state["n"] == 1:
+            started.set()
+            raise RuntimeError("killed mid-retrain (drill)")
+        release.wait(60)
+        Xs = np.concatenate([b[0] for b in batches], axis=0)
+        ys = np.concatenate([np.asarray(b[1]) for b in batches], axis=0)
+        return lgb.train({"objective": "regression", "verbosity": -1,
+                          "num_leaves": 7, "metric": ""},
+                         lgb.Dataset(Xs, label=ys), num_boost_round=4)
+
+    cb._retrain_once = fake_retrain
+    r = TickReport(tick=cb.tick_no)
+    cb._start_retrain(r)
+    assert started.wait(60), "background retrain never started"
+    st = cb.status()
+    assert st["state"] == "retraining" and st["attempts"] >= 1
+    release.set()
+    cb._bg["thread"].join(60)
+    st = cb.status()
+    assert st == {"state": "awaiting-gate", "attempts": 2,
+                  "generation": 0}
+    r2 = cb.tick(*stream.batch(1))            # polls + gates + swaps
+    assert r2.retrain_completed and r2.retrain_attempts == 2
+    assert cb.status() == {"state": "idle", "attempts": 0,
+                           "generation": cb.generation}
+    assert cb.generation == 1 or r2.swap_rejected

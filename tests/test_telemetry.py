@@ -1,0 +1,304 @@
+"""Runtime telemetry layer (lightgbm_tpu/obs/) — ISSUE-8 surface.
+
+The load-bearing invariants:
+
+* ``telemetry=off`` is bit-identical end-to-end — same trained trees,
+  same predictions — and so are ``counters`` and ``trace`` (the whole
+  layer is host-side bookkeeping; the jaxlint tier-B ``telemetry.off``
+  budget separately pins that the lowered train while-body is
+  op-for-op unchanged);
+* with ``telemetry=counters`` the session's runtime ``serving.*``
+  compile events reproduce EXACTLY the per-(kind, bucket) trace
+  counts the serving engine pins in tests/test_predict_engine.py;
+* a warmed booster with ``telemetry=counters`` survives
+  pickle/deepcopy (mirrors the PR-4 jitted-closure fix) and the
+  session resets cleanly;
+* exporters emit a loadable Chrome trace, JSONL, and Prometheus text;
+* memory accounting attributes HBM to the named owners.
+"""
+
+import copy
+import json
+import pickle
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import obs
+from lightgbm_tpu.obs.telemetry import Histogram, Telemetry
+
+
+@pytest.fixture(autouse=True)
+def _fresh_session():
+    """Every test starts and ends with a clean, disabled session (the
+    session is process-wide; leaking trace mode into other test files
+    would silently slow them)."""
+    obs.get().reset(mode="off")
+    yield
+    obs.get().reset(mode="off")
+
+
+def _data(n=4000, f=6, seed=3):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, f))
+    y = X[:, 0] * 2.0 + np.sin(X[:, 1]) + 0.1 * rng.normal(size=n)
+    return X, y
+
+
+def _train(X, y, telemetry=None, rounds=5):
+    p = {"objective": "regression", "verbosity": -1, "num_leaves": 15,
+         "min_data_in_leaf": 10, "metric": ""}
+    if telemetry is not None:
+        p["telemetry"] = telemetry
+    bst = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=rounds)
+    bst._gbdt._flush_pending()
+    return bst
+
+
+# ---------------------------------------------------------------------------
+# mode semantics
+# ---------------------------------------------------------------------------
+def test_off_mode_records_nothing():
+    X, y = _data()
+    bst = _train(X, y)                      # default: telemetry=off
+    bst.predict(X, raw_score=True)
+    rep = obs.get().report()
+    assert rep["mode"] == "off"
+    assert rep["spans"] == {} and rep["compiles"] == {}
+    assert rep["counters"] == {} and rep["events_recorded"] == 0
+
+
+def test_modes_are_bit_identical():
+    """off / counters / trace train the SAME model and serve the SAME
+    predictions — telemetry never touches the device computation."""
+    X, y = _data()
+    models, preds = [], []
+    for mode in ("off", "counters", "trace"):
+        obs.get().reset(mode="off")
+        bst = _train(X, y, telemetry=mode)
+        # trees + importances; the parameters section legitimately
+        # differs in its [telemetry: ...] line
+        models.append(bst.model_to_string().split("\nparameters:")[0])
+        preds.append(np.asarray(bst.predict(X, raw_score=True)))
+    assert models[0] == models[1] == models[2]
+    np.testing.assert_array_equal(preds[0], preds[1])
+    np.testing.assert_array_equal(preds[0], preds[2])
+
+
+def test_upgrade_only_mode_switch():
+    s = obs.get()
+    s.enable("trace")
+    s.enable("counters")                    # must not downgrade
+    assert s.mode == "trace"
+    with pytest.raises(ValueError):
+        s.enable("bogus")
+    with pytest.raises(lgb.LightGBMError):
+        _train(*_data(n=300), telemetry="loud")
+
+
+def test_spans_counters_and_train_compile_detector():
+    X, y = _data()
+    bst = _train(X, y, telemetry="counters", rounds=5)
+    rep = bst.telemetry_report()
+    assert rep["mode"] == "counters"
+    assert rep["spans"]["train.iteration"]["count"] == 5
+    assert rep["spans"]["train.total"]["count"] == 1
+    assert rep["spans"]["dataset.construct"]["count"] == 1
+    # the fused step traced exactly once over 5 iterations — the
+    # runtime analog of the train.donation / retrace pins
+    assert rep["compiles"]["train.fused_step"] == 1
+    # counters mode records no trace events
+    assert rep["events_recorded"] == 0
+
+
+# ---------------------------------------------------------------------------
+# serving: runtime compile counters == the engine's pinned trace counts
+# ---------------------------------------------------------------------------
+def test_serving_compile_counters_match_engine_pins():
+    """Replicates the call pattern of
+    test_predict_engine.test_compile_count_one_trace_per_bucket and
+    asserts the telemetry session saw EXACTLY the engine's
+    per-(kind, bucket) compile counts."""
+    X, y = _data(n=4500)
+    bst = _train(X, y, telemetry="counters")
+    eng = bst._gbdt.serving
+    eng.trace_counts.clear()
+    eng.call_counts.clear()
+    obs.get().reset(mode="counters")
+
+    bst.predict(X, raw_score=True)          # >= COLD_MIN_ROWS: warms
+    for n in (700, 700, 600, 900):          # all pad to bucket 1024
+        bst.predict(X[:n], raw_score=True)
+        bst.predict(X[:n], pred_leaf=True)
+        bst.predict(X[:n], pred_contrib=True)
+
+    want = {f"serving.{k}@{b}": v
+            for (k, b), v in eng.trace_counts.items()}
+    got = {k: v for k, v in obs.get().report()["compiles"].items()
+           if k.startswith("serving.")}
+    assert got == want and want, (got, want)
+    assert all(v == 1 for v in want.values()), want
+    # per-(kind, bucket) latency histograms exist for the served calls
+    spans = obs.get().report()["spans"]
+    for (k, b), calls in eng.call_counts.items():
+        assert spans[f"serve.{k}@{b}"]["count"] == calls
+
+
+# ---------------------------------------------------------------------------
+# pickle / deepcopy round trip (mirrors the PR-4 jitted-closure fix)
+# ---------------------------------------------------------------------------
+def test_pickle_deepcopy_round_trip_with_counters():
+    X, y = _data(n=4500)
+    bst = _train(X, y, telemetry="counters")
+    before = np.asarray(bst.predict(X, raw_score=True))  # warms the pack
+    assert bst.telemetry_report(include_memory=False)["mode"] == "counters"
+
+    restored = pickle.loads(pickle.dumps(bst))
+    cloned = copy.deepcopy(bst)
+    for other in (restored, cloned):
+        out = np.asarray(other.predict(X[:700], raw_score=True))
+        np.testing.assert_allclose(out, before[:700], rtol=1e-6, atol=1e-6)
+        rep = other.telemetry_report(include_memory=False)
+        assert rep["mode"] == "counters"     # model params re-enabled it
+
+    # counters reset cleanly: a fresh slate, and the restored booster
+    # keeps counting into it
+    obs.get().reset(mode="counters")
+    assert obs.get().report()["compiles"] == {}
+    restored.predict(X[:700], raw_score=True)
+    rep = restored.telemetry_report(include_memory=False)
+    # a restored booster serves through the loaded (threshold-index)
+    # pack — its bucket latency histogram restarts from the clean slate
+    assert rep["spans"]["serve.raw_loaded@1024"]["count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# exporters
+# ---------------------------------------------------------------------------
+def test_exporters_emit_valid_artifacts(tmp_path):
+    X, y = _data(n=4500)
+    bst = _train(X, y, telemetry="trace", rounds=3)
+    bst.predict(X, raw_score=True)
+    obs.memory_snapshot()
+    paths = obs.export_session(str(tmp_path))
+
+    doc = json.loads(open(paths["trace"]).read())
+    evs = doc["traceEvents"]
+    assert any(e.get("ph") == "X" and e["name"] == "train.iteration"
+               for e in evs)
+    assert any(e.get("ph") == "i" and
+               e["name"].startswith("compile:") for e in evs)
+    assert any(e.get("ph") == "C" and e["name"].startswith("mem.")
+               for e in evs)
+    for e in evs:
+        if e.get("ph") == "X":
+            assert isinstance(e["dur"], int) and e["dur"] >= 0
+            assert "ts" in e
+
+    lines = open(paths["jsonl"]).read().splitlines()
+    header = json.loads(lines[0])
+    assert header["type"] == "report" and header["mode"] == "trace"
+    assert all(json.loads(ln)["type"] == "event" for ln in lines[1:])
+
+    prom = open(paths["prometheus"]).read()
+    assert 'lightgbm_tpu_span_count{name="train.iteration"} 3' in prom
+    assert "lightgbm_tpu_compiles_total" in prom
+    assert "lightgbm_tpu_gauge" in prom
+
+
+def test_event_ring_keeps_newest():
+    t = Telemetry(mode="trace", max_events=10)
+    for i in range(50):
+        with t.span("s", i=i):
+            pass
+    rep = t.report()
+    assert rep["events_recorded"] == 10
+    assert rep["events_dropped"] == 40
+    # a true ring: the OLDEST events evict, so an incident at the end
+    # of a long run is always in the exported window
+    kept = [ev["args"]["i"] for ev in t.snapshot_events()]
+    assert kept == list(range(40, 50))
+    # aggregation never drops even when the ring is full
+    assert rep["spans"]["s"]["count"] == 50
+
+
+def test_histogram_quantiles():
+    h = Histogram()
+    for us in (100, 200, 400, 800, 100_000):
+        h.observe(us * 1e-6)
+    j = h.to_json()
+    assert j["count"] == 5
+    assert j["min_s"] == pytest.approx(1e-4)
+    assert j["max_s"] == pytest.approx(0.1)
+    assert j["p50_s"] <= j["p99_s"] <= j["max_s"]
+    assert j["p50_s"] >= j["min_s"]
+
+
+# ---------------------------------------------------------------------------
+# memory accounting
+# ---------------------------------------------------------------------------
+def test_memory_owners_attributed():
+    X, y = _data(n=4500)
+    bst = _train(X, y, telemetry="counters")
+    bst.predict(X, raw_score=True)          # builds the serving pack
+    snap = obs.memory_snapshot()
+    owners = snap["owners"]
+    assert owners["serving.packs"]["device_bytes"] > 0
+    assert owners["train.binned"]["device_bytes"] > 0
+    assert owners["dataset.binned"]["host_bytes"] > 0 \
+        or owners["dataset.binned"]["device_bytes"] > 0
+    # the backend total (when enumerable) is at least what we attribute
+    if snap["live_device_bytes"] is not None:
+        attributed = sum(o["device_bytes"] for o in owners.values())
+        assert snap["live_device_bytes"] >= owners[
+            "serving.packs"]["device_bytes"]
+        assert attributed > 0
+    # owner gauges landed in the session
+    gauges = obs.get().report()["gauges"]
+    assert gauges["mem.serving.packs.device_bytes"] == \
+        owners["serving.packs"]["device_bytes"]
+
+
+def test_memory_ledger_drops_dead_owners():
+    from lightgbm_tpu.obs import memory as obs_mem
+
+    class Holder:
+        pass
+
+    h = Holder()
+    h.arr = np.zeros(1024, np.float64)
+    obs_mem.register("test.owner", h, lambda o: [o.arr])
+    assert obs_mem.snapshot()["owners"]["test.owner"]["host_bytes"] == 8192
+    del h
+    assert "test.owner" not in obs_mem.snapshot()["owners"]
+    # the weakref callback pruned the registry entry itself — no
+    # snapshot needed, so an off-mode forever-process never leaks
+    assert all(k[0] != "test.owner" for k in obs_mem.LEDGER._providers)
+
+
+# ---------------------------------------------------------------------------
+# continual runtime: lifecycle spans + swap compile attribution
+# ---------------------------------------------------------------------------
+def test_continual_tick_spans_and_zero_steady_state_compiles():
+    from lightgbm_tpu.continual import ContinualBooster, DriftStream
+    from lightgbm_tpu.continual.drift import _DRILL_PARAMS
+
+    p = dict(_DRILL_PARAMS)
+    p.update({"num_iterations": 5, "num_leaves": 7,
+              "telemetry": "counters"})
+    warm = DriftStream(num_features=5, rows=512, seed=61)
+    X0, y0 = warm.batch(0)
+    cb = ContinualBooster(p, X0, y0)
+    stream = DriftStream(num_features=5, rows=128, seed=62)
+    cb.tick(*stream.batch(0))               # settles the per-kind compiles
+    obs.get().reset(mode="counters")
+    for t in range(1, 4):
+        cb.tick(*stream.batch(t))
+    rep = obs.get().report()
+    assert rep["spans"]["continual.tick"]["count"] == 3
+    assert rep["spans"]["continual.refit"]["count"] == 3
+    # steady-state ticks add ZERO serving compiles — the runtime
+    # counter now shows what the jaxlint continual.tick budget pins
+    assert not any(k.startswith("serving.") for k in rep["compiles"]), \
+        rep["compiles"]

@@ -43,8 +43,10 @@ def test_profile_continual_smoke(capsys):
     assert d["rollback"]["pre_post_identical"]
 
 
-def test_ab_bench_drift_lane():
-    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=HERE)
+def test_ab_bench_drift_lane(tmp_path):
+    obs_path = str(tmp_path / "BENCH_obs.json")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=HERE,
+               BENCH_OBS_PATH=obs_path)
     out = subprocess.run(
         [sys.executable, os.path.join(HERE, "tools", "ab_bench.py"),
          "--drift", "--drift-rows", "192", "--rollback-within", "3"],
@@ -59,3 +61,11 @@ def test_ab_bench_drift_lane():
         f"rollback fired after {rec['rollback_delay_ticks']} ticks"
     assert rec["post_rollback_parity"] is True
     assert rec["swap_latency_s"] > 0
+    # ISSUE-8 satellite: the machine-readable perf artifact rides along
+    with open(obs_path) as fh:
+        art = json.load(fh)
+    assert art["schema"] == "lightgbm-tpu/bench-obs/v1"
+    assert art["tool"] == "ab_bench.drift"
+    assert art["timings"]["rollback_ok"] is True
+    assert any(k.startswith("serving.") for k in art["compile_counts"])
+    assert art["memory_peaks"]["owners"]

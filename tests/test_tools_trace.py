@@ -1,0 +1,73 @@
+"""Tier-1 lane for tools/trace_report.py (ISSUE-8): the --smoke
+self-check must drive the continual drift drills at telemetry=trace,
+export a VALID Chrome trace containing the tick/retrain/swap/rollback
+spans plus runtime compile events, and exit 0 — and the summarize path
+must read back what the exporters write (both formats)."""
+
+import importlib.util
+import json
+import os
+import sys
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(HERE, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_trace_report_smoke(capsys):
+    tool = _load_tool("trace_report")
+    rc = tool.main(["--smoke", "--rows", "160"])
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    payload = json.loads(out)
+    assert rc == 0, payload
+    assert payload["ok"] is True
+    assert payload["problems"] == []
+    spans = payload["spans"]
+    for name in ("continual.tick", "continual.retrain",
+                 "continual.swap", "continual.rollback"):
+        assert spans.get(name, 0) >= 1, (name, spans)
+    assert payload["compiles"], "no runtime compile events in the trace"
+    # the swap drill's kill+resume means the retrain span fired twice
+    assert spans["continual.retrain"] >= 2
+
+
+def test_trace_report_reads_both_export_formats(tmp_path, capsys):
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu import obs
+
+    tool = _load_tool("trace_report")
+    sess = obs.get()
+    sess.reset(mode="trace")
+    try:
+        rng = np.random.RandomState(1)
+        X = rng.normal(size=(600, 5))
+        y = X[:, 0] + 0.1 * rng.normal(size=600)
+        lgb.train({"objective": "regression", "verbosity": -1,
+                   "num_leaves": 7, "metric": ""},
+                  lgb.Dataset(X, label=y), num_boost_round=3)
+        paths = obs.export_session(str(tmp_path))
+    finally:
+        sess.reset(mode="off")
+
+    for key in ("trace", "jsonl"):
+        rc = tool.main([paths[key]])
+        out = capsys.readouterr().out.strip().splitlines()[-1]
+        summary = json.loads(out)
+        assert rc == 0, summary
+        assert summary["problems"] == []
+        assert summary["spans"]["train.iteration"]["count"] == 3
+
+    # a malformed artifact fails loudly
+    bad = tmp_path / "bad.json"
+    bad.write_text('{"traceEvents": [{"name": "x"}]}')
+    rc = tool.main([str(bad)])
+    capsys.readouterr()
+    assert rc != 0

@@ -50,6 +50,17 @@ def _parse_overrides(items):
     return out
 
 
+def _write_obs(args, tool, config, timings):
+    """Drop the machine-readable BENCH_obs.json artifact (ISSUE-8
+    satellite): config + timings + the telemetry session's compile
+    counts + memory peaks, so perf rounds have diffable artifacts, not
+    just PERF.md prose."""
+    from lightgbm_tpu.obs import benchio
+    path = benchio.write_bench_obs(tool, config, timings,
+                                   path=args.obs_out)
+    print(f"wrote {path}", file=sys.stderr)
+
+
 def _fault_smoke(args):
     """Robustness-cost smoke (`--fault`): the checkpoint guard rails
     must stay under `--max-overhead-pct` of training wall-clock at the
@@ -107,6 +118,7 @@ def _fault_smoke(args):
         resumed_iters = rounds - (kill_at // interval) * interval
         report = {
             "fault_mode": True, "rows": args.rows, "rounds": rounds,
+            "obs_artifact": args.obs_out,
             "checkpoint_interval": interval,
             "base_s": [round(t, 3) for t in base_times],
             "ckpt_s": [round(t, 3) for t in ckpt_times],
@@ -118,6 +130,10 @@ def _fault_smoke(args):
             "resumed_trees": int(bst.num_trees()),
         }
         print(json.dumps(report))
+        _write_obs(args, "ab_bench.fault",
+                   {"rows": args.rows, "rounds": rounds,
+                    "checkpoint_interval": interval},
+                   report)
         if not report["overhead_ok"]:
             raise SystemExit(
                 f"--fault: checkpoint overhead {overhead_pct:.2f}% exceeds "
@@ -164,6 +180,10 @@ def _drift_smoke(args):
             "post_rollback_parity": roll.get("pre_post_identical"),
         }
         print(json.dumps(report))
+        _write_obs(args, "ab_bench.drift",
+                   {"rows_per_tick": args.drift_rows,
+                    "rollback_within": args.rollback_within},
+                   report)
         problems = []
         if not report["detected_within_window"]:
             problems.append("regression not detected within the window")
@@ -217,7 +237,16 @@ def main():
     ap.add_argument("--rollback-within", type=int, default=3,
                     help="--drift: ticks within which rollback must "
                     "fire after an injected post-swap regression")
+    ap.add_argument("--obs-out", default=None, metavar="PATH",
+                    help="BENCH_obs.json artifact path (default: "
+                    "$BENCH_OBS_PATH or ./BENCH_obs.json)")
     args = ap.parse_args()
+
+    # telemetry at counters: the artifact records the run's compile
+    # events and memory peaks alongside the timings (zero-HLO, and the
+    # per-iteration span cost is noise vs the timed blocks)
+    from lightgbm_tpu import obs
+    obs.get().enable("counters")
 
     if args.fault:
         _fault_smoke(args)
@@ -320,6 +349,13 @@ def main():
             paired - delta_med))), 5),
     }
     print(json.dumps(report))
+    _write_obs(args, "ab_bench",
+               {"rows": args.rows, "features": args.features,
+                "leaves": args.leaves, "iters": args.iters,
+                "blocks": args.blocks,
+                "a_params": report["a_params"],
+                "b_params": report["b_params"]},
+               report)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,183 @@
+"""Render/summarize a lightgbm_tpu telemetry trace.
+
+Reads either artifact the obs exporters write — a Chrome-trace
+``trace.json`` (the ``traceEvents`` object Perfetto loads) or a
+``telemetry.jsonl`` event log — validates its structure, and prints ONE
+JSON summary line: span counts + total/mean durations by name, compile
+events, counter tracks, and any validation problems (non-zero exit when
+the artifact is malformed).
+
+    python tools/trace_report.py out/trace.json
+    python tools/trace_report.py out/telemetry.jsonl
+    python tools/trace_report.py --smoke      # tier-1 self-check
+
+``--smoke`` runs the continual drift drills (swap + rollback) with the
+session at ``telemetry=trace``, exports the Chrome trace, validates it,
+and asserts the spans an operator needs are all present —
+``continual.tick`` / ``continual.retrain`` / ``continual.swap`` /
+``continual.rollback`` — plus at least one runtime compile event.
+"""
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_KNOWN_PH = {"X", "B", "E", "C", "i", "I", "M", "s", "t", "f"}
+
+
+# ---------------------------------------------------------------------------
+# loading + validation
+# ---------------------------------------------------------------------------
+def load_events(path: str) -> List[Dict[str, Any]]:
+    """Events from a Chrome-trace object or a JSONL export."""
+    with open(path, encoding="utf-8") as fh:
+        head = fh.read(1)
+        fh.seek(0)
+        if head == "{":
+            first = fh.readline()
+            rest = fh.read()
+            if rest.strip():
+                # JSONL whose first line is the report object
+                events = []
+                for ln in rest.splitlines():
+                    if ln.strip():
+                        events.append(json.loads(ln))
+                json.loads(first)           # header must parse too
+                return events
+            doc = json.loads(first)
+            return list(doc.get("traceEvents", []))
+        return [json.loads(ln) for ln in fh if ln.strip()]
+
+
+def validate(events: List[Dict[str, Any]]) -> List[str]:
+    """Structural problems (Chrome-trace requirements the exporter
+    guarantees; a regression here breaks Perfetto loading)."""
+    problems = []
+    if not events:
+        problems.append("no events")
+    for i, ev in enumerate(events):
+        ph = ev.get("ph")
+        if ph is None:
+            problems.append(f"event {i} missing ph")
+            continue
+        if ph not in _KNOWN_PH:
+            problems.append(f"event {i} unknown ph {ph!r}")
+        if ph != "M" and "ts" not in ev:
+            problems.append(f"event {i} ({ev.get('name')}) missing ts")
+        if ph == "X" and (not isinstance(ev.get("dur"), int)
+                          or ev["dur"] < 0):
+            problems.append(f"event {i} ({ev.get('name')}) bad dur")
+        if ph != "M" and "name" not in ev:
+            problems.append(f"event {i} missing name")
+        if len(problems) > 20:
+            problems.append("... (truncated)")
+            break
+    return problems
+
+
+def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    spans: Dict[str, Dict[str, Any]] = {}
+    compiles: Dict[str, int] = {}
+    counters: Dict[str, float] = {}
+    for ev in events:
+        ph = ev.get("ph")
+        name = ev.get("name", "?")
+        if ph == "X":
+            s = spans.setdefault(name, {"count": 0, "total_us": 0})
+            s["count"] += 1
+            s["total_us"] += int(ev.get("dur", 0))
+        elif ph in ("i", "I") and name.startswith("compile:"):
+            key = name[len("compile:"):]
+            compiles[key] = compiles.get(key, 0) + 1
+        elif ph == "C":
+            args = ev.get("args") or {}
+            counters[name] = args.get("value", args)
+    for s in spans.values():
+        s["mean_us"] = round(s["total_us"] / max(s["count"], 1), 1)
+    return {"events": len(events),
+            "spans": dict(sorted(spans.items())),
+            "compiles": dict(sorted(compiles.items())),
+            "counters": dict(sorted(counters.items()))}
+
+
+# ---------------------------------------------------------------------------
+# --smoke: drive a drill at telemetry=trace and validate its trace
+# ---------------------------------------------------------------------------
+_REQUIRED_SPANS = ("continual.tick", "continual.retrain",
+                   "continual.swap", "continual.rollback")
+
+
+def smoke(rows: int) -> int:
+    import shutil
+    import tempfile
+
+    from lightgbm_tpu import obs
+    from lightgbm_tpu.continual import run_drift_drill
+
+    sess = obs.get()
+    sess.reset(mode="trace")
+    work = tempfile.mkdtemp(prefix="trace-report-")
+    problems: List[str] = []
+    try:
+        # swap drill: tick + detection + (killed-once, resumed) retrain
+        # + gated swap spans; rollback drill adds the rollback span
+        swap = run_drift_drill("swap", rows=rows, drift_at=4,
+                               post_ticks=5, checkpoint_dir=work)
+        roll = run_drift_drill("rollback", rows=rows, drift_at=3,
+                               post_ticks=5)
+        if swap.get("swap_tick") is None:
+            problems.append("swap drill produced no hot-swap")
+        if roll.get("rollback_tick") is None:
+            problems.append("rollback drill never rolled back")
+        obs.memory_snapshot()
+        trace_path = os.path.join(work, "trace.json")
+        obs.export_chrome_trace(sess, trace_path)
+        events = load_events(trace_path)
+        problems += validate(events)
+        summary = summarize(events)
+        for name in _REQUIRED_SPANS:
+            if name not in summary["spans"]:
+                problems.append(f"required span missing: {name}")
+        if not summary["compiles"]:
+            problems.append("no runtime compile events recorded")
+        print(json.dumps({"metric": "trace_report_smoke",
+                          "ok": not problems,
+                          "trace_events": summary["events"],
+                          "spans": {k: v["count"]
+                                    for k, v in summary["spans"].items()},
+                          "compiles": summary["compiles"],
+                          "problems": problems}))
+        return 1 if problems else 0
+    finally:
+        sess.reset(mode="off")
+        shutil.rmtree(work, ignore_errors=True)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("trace", nargs="?", help="trace.json or telemetry.jsonl")
+    ap.add_argument("--smoke", action="store_true",
+                    help="run the continual drills at telemetry=trace "
+                         "and validate the exported Chrome trace")
+    ap.add_argument("--rows", type=int, default=192,
+                    help="--smoke: rows per drill tick")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        return smoke(args.rows)
+    if not args.trace:
+        ap.error("give a trace file or --smoke")
+    events = load_events(args.trace)
+    problems = validate(events)
+    out = summarize(events)
+    out["problems"] = problems
+    out["path"] = args.trace
+    print(json.dumps(out))
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
