@@ -30,6 +30,9 @@ would otherwise catch fail tier-1 instead:
   train step's lowered while-body is op-for-op identical with
   telemetry off and at full trace mode (spans/counters/compile
   detection are host-side bookkeeping by construction).
+* ``health.off`` — same zero-HLO invariant for the model/data-health
+  layer (flight recorder, skew digests): the lowered while-body is
+  op-for-op identical with health off and at trace mode.
 
 Every metric is a ceiling checked against ``jaxlint_baseline.json``
 (see :mod:`lightgbm_tpu.analysis.baseline`).  All checks run on the
@@ -278,6 +281,63 @@ def check_telemetry_off() -> Dict[str, int]:
 
 
 # ---------------------------------------------------------------------------
+# health zero-HLO invariant
+# ---------------------------------------------------------------------------
+def check_health_off() -> Dict[str, int]:
+    """The health layer must never stage device ops in the training
+    loop: the fused train step's lowered while-body is OP-FOR-OP
+    identical with health off and at full trace mode (the flight
+    recorder consumes host records the trainer already materializes;
+    device digest reductions only run in explicit snapshot calls).
+    Mirrors ``telemetry.off``; every delta metric is an invariant
+    budgeted at 0."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+    from ..obs import health as obs_health
+    from ..obs import telemetry as obs_tel
+    from .hlo import body_counts
+
+    def lower_step(mode):
+        rng = np.random.RandomState(13)
+        X = rng.normal(size=(512, 6))
+        y = X[:, 0] - 0.5 * X[:, 2] + 0.1 * rng.normal(size=len(X))
+        bst = lgb.Booster(params={"objective": "regression",
+                                  "verbosity": -1, "num_leaves": 15,
+                                  "min_data_in_leaf": 5, "metric": "",
+                                  "health": mode},
+                          train_set=lgb.Dataset(X, label=y))
+        g = bst._gbdt
+        assert g._fused_phys is not None, \
+            "health.off budget needs the fused physical step"
+        pb, ghi = g._init_phys(g.learner._part0, g.scores)
+        fmask = jnp.ones((g.learner.F,), dtype=bool)
+        feat_used = jnp.zeros((g.learner.F,), dtype=bool)
+        lowered = g._fused_phys.lower(pb, ghi, fmask, jnp.int32(1),
+                                      feat_used)
+        return lowered.compile().as_text()
+
+    sess = obs_health.get()
+    tel = obs_tel.get()
+    prev, tel_prev = sess.mode, tel.mode
+    try:
+        sess.set_mode("off")
+        off = body_counts(lower_step("off"))
+        sess.set_mode("trace")
+        on = body_counts(lower_step("trace"))
+    finally:
+        sess.set_mode(prev)
+        tel.set_mode(tel_prev)       # health trace upgrades telemetry
+    keys = set(off["ops"]) | set(on["ops"])
+    hist_delta = sum(abs(off["ops"].get(k, 0) - on["ops"].get(k, 0))
+                     for k in keys)
+    return {"body_op_histogram_delta": hist_delta,
+            "total_ops_delta": abs(off["total_ops"] - on["total_ops"]),
+            "copies_delta": abs(off["copies"] - on["copies"])}
+
+
+# ---------------------------------------------------------------------------
 # continual-runtime tick/swap budgets
 # ---------------------------------------------------------------------------
 def check_continual_tick() -> Dict[str, int]:
@@ -326,6 +386,7 @@ CHECKS = {
     "shap.kernel": check_shap_kernel,
     "continual.tick": check_continual_tick,
     "telemetry.off": check_telemetry_off,
+    "health.off": check_health_off,
 }
 
 

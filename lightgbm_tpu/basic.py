@@ -399,8 +399,10 @@ class Booster:
         params = params or {}
         self.params = dict(params)
         self.config = Config(params)
+        from .obs import health as _obs_health
         from .obs import telemetry as _obs
         _obs.configure_from_config(self.config)
+        _obs_health.configure_from_config(self.config)
         self._gbdt: Optional[GBDT] = None
         self.train_set = train_set
         self.best_iteration = -1
@@ -590,6 +592,40 @@ class Booster:
             rep["memory"] = obs.memory_snapshot()
         return rep
 
+    def health_report(self) -> Dict[str, Any]:
+        """Model & data health (lightgbm_tpu/obs/health.py, gated by
+        ``health=off|counters|trace``): the training flight recorder
+        (per-iteration split decisions, gain trajectory, leaf/gradient
+        digests, effective sample counts), the reference data profile
+        captured at Dataset construction, and the serving-side
+        training↔serving skew digest (per-bucket rows, top-PSI feature
+        ranking, prediction-margin log2 histogram, alert count)."""
+        from .obs import health as _health
+        g = self._gbdt
+        # lagged fused-iteration records land in the recorder at
+        # materialization; a report is a materialization point
+        g._flush_pending()
+        rep: Dict[str, Any] = {"mode": _health.get().mode}
+        rep["flight_recorder"] = (g.flight.report()
+                                  if g.flight is not None else None)
+        prof = getattr(g, "health_profile", None)
+        if prof is None:
+            rep["reference_profile"] = None
+        else:
+            rep["reference_profile"] = {
+                "num_data": prof["num_data"],
+                "num_features": len(prof["features"]),
+                "features": [
+                    {k: fe[k] for k in ("index", "name", "num_bin",
+                                        "missing_rate", "zero_rate",
+                                        "cardinality")}
+                    for fe in prof["features"]],
+            }
+        mon = g.serving._skew
+        rep["serving_skew"] = (mon.report()
+                               if mon not in (None, False) else None)
+        return rep
+
     # ------------------------------------------------------------------
     def eval_train(self, feval=None):
         results = []
@@ -751,6 +787,17 @@ class Booster:
             body += (f"{n}={int(v)}\n" if imp_type == "split"
                      else f"{n}={float(v):g}\n")
         body += "\nparameters:\n" + self.config.save_to_string() + "\nend of parameters\n"
+        if getattr(g, "health_profile", None) is not None:
+            # the data-health reference profile rides the model file
+            # (one JSON line, like pandas_categorical below; loaders
+            # that predate it skip unknown header-less lines) as the
+            # offline-audit / scoring reference — live serving digests
+            # additionally need the in-session bin-space path, which a
+            # loaded model (threshold-index packs, no mappers) lacks
+            import json as _json
+            body += ("health_profile:"
+                     + _json.dumps(g.health_profile,
+                                   separators=(",", ":")) + "\n")
         if self.pandas_categorical is not None:
             # final line, like the reference Python wrapper (basic.py
             # _dump_pandas_categorical)
@@ -831,6 +878,17 @@ class Booster:
         for blk in blocks:
             body = blk.split("end of trees")[0]
             g.models.append(Tree.from_string("Tree=" + body))
+        # data-health reference profile (written after the parameters
+        # section; absent in models saved before it existed)
+        if "\nhealth_profile:" in text:
+            import json as _json
+            line = text.split("\nhealth_profile:", 1)[1].split("\n", 1)[0]
+            try:
+                g.health_profile = _json.loads(line)
+            except ValueError:
+                pass
+        from .obs import health as _obs_health
+        _obs_health.configure_from_config(self.config)
 
     def dump_model(self, num_iteration: int = -1, start_iteration: int = 0) -> dict:
         """reference: GBDT::DumpModel (gbdt_model_text.cpp:23-120)."""

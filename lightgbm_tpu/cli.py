@@ -222,6 +222,22 @@ class Application:
                          callbacks=callbacks)
         booster.save_model(cfg.output_model)
         log.info("Finished training; model saved to %s", cfg.output_model)
+        # model/data-health artifact (obs/health.py): the flight
+        # recorder + reference profile + skew digests of THIS run,
+        # next to the telemetry exports
+        from .obs import health as obs_health
+        if obs_health.enabled() and cfg.telemetry_out:
+            import json as _json
+            try:
+                os.makedirs(cfg.telemetry_out, exist_ok=True)
+                out = os.path.join(cfg.telemetry_out, "health.json")
+                with open(out, "w") as fh:
+                    _json.dump(booster.health_report(), fh, indent=1,
+                               default=str)
+                log.info("health report exported: %s", out)
+            except OSError as exc:
+                log.warning("health export to %s failed: %s",
+                            cfg.telemetry_out, exc)
 
     def predict(self) -> None:
         cfg = self.config

@@ -161,6 +161,20 @@ _PARAMS: List[_Param] = [
     # directory where the CLI writes telemetry.jsonl / trace.json /
     # metrics.prom when the task finishes ("" = no export)
     _p("telemetry_out", "", str, ("telemetry_dir",)),
+    # model & data health (lightgbm_tpu/obs/health.py + digest.py),
+    # riding the telemetry modes: "off" (default; zero host bookkeeping
+    # and — pinned by the jaxlint health.off budget — zero ops in any
+    # lowered program), "counters" (training flight recorder + reference
+    # profile + serving-side skew digests, all host-side), "trace"
+    # (counters plus flight-recorder / skew-alert marks on the telemetry
+    # ring — upgrades the telemetry session to trace so the PR-7
+    # exporters carry them).  See Booster.health_report()
+    _p("health", "off", str, ("health_mode",)),
+    # top-k features reported by skew rankings / the flight recorder
+    _p("health_topk", 5, int, (), ">0"),
+    # PSI above this fires a health.skew alert event (0.25 = the classic
+    # "distribution has shifted" rule of thumb)
+    _p("health_psi_threshold", 0.25, float, (), ">=0.0"),
     # --- Continual training (lightgbm_tpu/continual/) ---
     # windowed regression detection: mean tick metric over the last
     # continual_window ticks vs the window before; a relative

@@ -40,7 +40,11 @@ class DriftSpec:
     """Which drifts hit the stream, and when (tick indices, 0-based)."""
 
     covariate_shift_at: Optional[int] = None
-    covariate_shift: float = 2.5          # added to every feature mean
+    covariate_shift: float = 2.5          # added to the feature mean(s)
+    # None = shift EVERY feature (the classic whole-batch drill);
+    # an index = plant the shift on ONE feature, the scenario the
+    # health-layer skew attribution must pin (rank it #1)
+    covariate_shift_feature: Optional[int] = None
     label_flip_at: Optional[int] = None
     label_flip_fraction: float = 0.4
     nan_burst_at: Optional[int] = None
@@ -80,7 +84,10 @@ class DriftStream:
         X = rs.normal(size=(self.rows, self.f))
         if (sp.covariate_shift_at is not None
                 and t >= sp.covariate_shift_at):
-            X = X + sp.covariate_shift
+            if sp.covariate_shift_feature is None:
+                X = X + sp.covariate_shift
+            else:
+                X[:, int(sp.covariate_shift_feature)] += sp.covariate_shift
         raw = X @ self.coef + self.noise * rs.normal(size=self.rows)
         if self.binary:
             y = (raw > np.median(raw)).astype(np.float64)
@@ -136,6 +143,11 @@ def run_drift_drill(scenario: str = "swap", rows: int = 256,
     * ``rollback`` — no drift; a deliberately bad candidate is force-
       swapped in; expects the watchdog to roll back within the rollback
       window and post-rollback predictions bit-identical to pre-swap.
+    * ``attribution`` — covariate shift planted on ONE feature (the
+      stream's strongest coefficient) with ``health=counters``: the
+      regression tick's skew attribution must rank the planted feature
+      #1 against the reference profile (the acceptance drill for the
+      health layer; asserted by tests and ``ab_bench --drift``).
     """
     import time
 
@@ -143,11 +155,17 @@ def run_drift_drill(scenario: str = "swap", rows: int = 256,
     from .runtime import ContinualBooster
 
     p = dict(_DRILL_PARAMS)
+    if scenario == "attribution":
+        # the drill that must NAME the planted feature: health digests
+        # on, cheap retrain (the drill stops at the detection tick)
+        p.update({"health": "counters", "continual_retrain_rounds": 2})
     p.update(params or {})
     clk = ManualClock()
 
     spec = DriftSpec()
     retrain_fault = None
+    if scenario == "attribution":
+        spec.covariate_shift_at = drift_at
     if scenario in ("swap", "degrade"):
         spec.covariate_shift_at = drift_at
         if scenario == "swap" and checkpoint_dir:
@@ -163,6 +181,14 @@ def run_drift_drill(scenario: str = "swap", rows: int = 256,
             spec.kill_retrain_at_iteration = 1
             spec.kill_retrain_times = 10 ** 6   # every attempt dies
         retrain_fault = spec.retrain_fault()
+
+    planted = None
+    if scenario == "attribution":
+        # plant on the stream's strongest coefficient so the shift both
+        # regresses the metric and has an unambiguous right answer
+        planted = int(np.argmax(np.abs(
+            np.random.RandomState(seed).normal(size=features))))
+        spec.covariate_shift_feature = planted
 
     stream = DriftStream(num_features=features, rows=rows, seed=seed,
                          spec=spec)
@@ -206,6 +232,25 @@ def run_drift_drill(scenario: str = "swap", rows: int = 256,
         report["pre_post_identical"] = bool(
             np.array_equal(np.asarray(pre_pred), np.asarray(post_pred)))
         report["swap_tick"] = swap_tick
+    elif scenario == "attribution":
+        for t in range(n_ticks):
+            r = cb.tick(*stream.batch(t))
+            report["ticks"].append(r.to_json())
+            if r.drift_detected and detect_tick is None:
+                detect_tick = t
+                break
+        report["detect_tick"] = detect_tick
+        report["planted_feature"] = planted
+        top = (report["ticks"][-1].get("skew_top") or []
+               if detect_tick is not None else [])
+        report["skew_top"] = top
+        report["planted_rank"] = next(
+            (i + 1 for i, s in enumerate(top)
+             if s["feature"] == planted), None)
+        report["planted_ranked_first"] = report["planted_rank"] == 1
+        report["detected_within_window"] = (
+            detect_tick is not None and
+            detect_tick - drift_at <= 2 * cb.cfg.continual_window)
     else:
         for t in range(n_ticks):
             r = cb.tick(*stream.batch(t))

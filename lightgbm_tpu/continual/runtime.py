@@ -121,6 +121,10 @@ class TickReport:
     swap_new_traces: Dict[Any, int] = field(default_factory=dict)
     rolled_back: bool = False
     degraded: bool = False               # serving last-good after failures
+    # drift attribution (obs/health.py, health != off): at a regression
+    # tick, the features whose recent-window digest moved furthest from
+    # the reference profile, most-skewed first
+    skew_top: List[Dict[str, Any]] = field(default_factory=list)
     notes: List[str] = field(default_factory=list)
 
     def to_json(self) -> Dict[str, Any]:
@@ -174,6 +178,8 @@ class ContinualBooster:
         self.params = dict(params)
         self.cfg = Config(self.params)
         obs.configure_from_config(self.cfg)
+        from ..obs import health as _obs_health
+        _obs_health.configure_from_config(self.cfg)
         self.metric_name = resolve_metric(self.cfg.continual_metric,
                                           self.cfg.objective)
         self.checkpoint_dir = checkpoint_dir
@@ -211,6 +217,12 @@ class ContinualBooster:
         self._cooldown = 0
         self._bg: Optional[Dict[str, Any]] = None
         self._gate: Optional[Tuple[np.ndarray, np.ndarray]] = None
+        # drift attribution state (obs/health.py): reference profile +
+        # binner of the SERVED model, and a rolling window of per-tick
+        # digests so a regression tick can name the drifted features
+        self._health_ref = None
+        self._health_digests: deque = deque(maxlen=1)
+        self._refresh_health_ref()
         # telemetry HBM attribution: the recent-batch retrain buffer
         obs_memory.register("continual.buffers", self, _buffer_arrays)
 
@@ -237,6 +249,63 @@ class ContinualBooster:
     def _raw(self, X: np.ndarray) -> np.ndarray:
         return np.asarray(self.booster.predict(np.asarray(X),
                                                raw_score=True))
+
+    # -- drift attribution plumbing (obs/health.py) ---------------------
+    def _refresh_health_ref(self) -> None:
+        """(Re)bind the attribution reference to the CURRENTLY SERVED
+        model's profile and mappers; called at init and after every
+        swap/rollback — digests taken against an older model's bin
+        space are not comparable, so the window resets with it."""
+        from ..obs import health as obs_health
+        self._health_ref = None
+        self._health_digests = deque(
+            maxlen=max(2 * int(self.cfg.continual_window), 4))
+        if not obs_health.enabled():
+            return
+        g = self.booster._gbdt
+        prof = getattr(g, "health_profile", None)
+        ds = g.train_data
+        if prof is not None and ds is not None and ds.groups:
+            self._health_ref = (prof, ds)
+
+    def _health_observe(self, X: np.ndarray) -> None:
+        ref = self._health_ref
+        if ref is None:
+            return
+        _, ds = ref
+        from ..obs import digest as _digest
+        try:
+            binned = ds.bin_matrix(np.asarray(X, np.float64))
+        except Exception:
+            return                        # unbinnable batch: no digest
+        self._health_digests.append(
+            (_digest.bin_counts_host(binned, ds.max_group_bins), len(X)))
+
+    def _health_attribute(self) -> List[Dict[str, Any]]:
+        """Top-k drifted features for a regression tick: the recent
+        detection window's digests vs the reference profile."""
+        ref = self._health_ref
+        if ref is None or not self._health_digests:
+            return []
+        prof, ds = ref
+        from ..obs import health as obs_health
+        W = int(self.cfg.continual_window)
+        recent = list(self._health_digests)[-W:]
+        ranked = obs_health.attribute_drift(
+            prof, ds, [c for c, _ in recent],
+            sum(n for _, n in recent),
+            topk=int(getattr(self.cfg, "health_topk", 5) or 5))
+        if ranked:
+            obs.counter("health.drift.attributed")
+            obs.get().instant("health.drift", tick=self.tick_no,
+                              feature=ranked[0]["feature"],
+                              feature_name=ranked[0]["name"],
+                              psi=ranked[0]["psi"])
+            log.warning(
+                "continual: drift attribution — top skewed features: %s",
+                ", ".join(f"{s['name']} (psi={s['psi']:.3f})"
+                          for s in ranked[:3]))
+        return ranked
 
     def predict(self, X, **kw):
         """Serve from the current model (atomic against swaps: the
@@ -290,6 +359,7 @@ class ContinualBooster:
             r.notes.append("non-finite tick metric excluded from the "
                            "detection history and the swap gate")
         self.buffer.append((X, y, weight))
+        self._health_observe(X)
 
         # 2. rollback watchdog (runs BEFORE drift detection: a bad swap
         # must roll back, not trigger another retrain of the bad model)
@@ -299,6 +369,10 @@ class ContinualBooster:
         # 3. drift / regression detection -> retrain
         elif self._should_detect() and self._regressed():
             r.drift_detected = True
+            # name the offending features BEFORE the retrain consumes
+            # the window: the regression tick's report carries the
+            # attribution an operator (and the drift drill) reads
+            r.skew_top = self._health_attribute()
             log.warning("continual: metric regression detected at tick "
                         "%d (window=%d, threshold=%.3f)", self.tick_no,
                         self.cfg.continual_window,
@@ -589,6 +663,7 @@ class ContinualBooster:
         self.generation += 1
         self._watch_left = self.cfg.continual_rollback_window
         self._cooldown = self.cfg.continual_cooldown
+        self._refresh_health_ref()
         log.info("continual: swapped in generation %d (%.1f ms, traces "
                  "%s)", self.generation, 1e3 * r.swap_latency_s,
                  r.swap_new_traces)
@@ -633,6 +708,7 @@ class ContinualBooster:
             self._watch_left = 0
             self._pre_swap_baseline = None
             self._cooldown = self.cfg.continual_cooldown
+            self._refresh_health_ref()
             if r is not None:
                 r.rolled_back = True
                 r.generation = self.generation

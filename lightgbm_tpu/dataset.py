@@ -178,6 +178,9 @@ class BinnedDataset:
         self._keep_host: bool = True
         self._batched = None                         # cached BatchedMapper
         self.device_ingest = None                    # ops.construct.DeviceIngest
+        # data-health reference profile (obs/digest.py), captured lazily
+        # at construction when health != off and persisted with models
+        self._health_profile = None
 
     # jitted device buffers and the padded mapper tables are neither
     # picklable nor worth shipping; a host-binned-free dataset
@@ -198,6 +201,32 @@ class BinnedDataset:
             self._batched = BatchedMapper(self.bin_mappers,
                                           self.used_features)
         return self._batched
+
+    def reference_profile(self):
+        """The data-health reference profile of THIS dataset's rows
+        (obs/digest.py): per-feature bin occupancy, missing/zero rates
+        and categorical cardinalities, computed with one reduction over
+        the packed bin matrix — on device (one sync) when only the
+        ingest buffer holds the data, on host otherwise.  Cached; None
+        when no binned data exists."""
+        if self._health_profile is not None:
+            return self._health_profile
+        from .obs import digest as _digest
+        with obs.span("dataset.profile", rows=self.num_data):
+            if self.binned is not None:
+                counts = _digest.bin_counts_host(self.binned,
+                                                 self.max_group_bins)
+            elif self.device_ingest is not None:
+                di = self.device_ingest
+                snap = _digest.snapshot_device(
+                    di.buffer, self.max_group_bins, transposed=True,
+                    pad_cols=di.n_pad - di.N)
+                counts = snap["group_counts"]
+            else:
+                return None
+            self._health_profile = _digest.build_reference_profile(
+                self, counts)
+        return self._health_profile
 
     def host_binned(self) -> Optional[np.ndarray]:
         """The row-major (num_data, num_groups) host bin matrix,
@@ -262,6 +291,12 @@ class BinnedDataset:
         ds._bin_data(data)
         if config.linear_tree:
             ds.raw_data = np.ascontiguousarray(data, dtype=np.float32)
+        # data-health reference profile, captured while the binned data
+        # is guaranteed fresh (obs/health.py; persisted with the model)
+        from .obs import health as obs_health
+        obs_health.configure_from_config(config)
+        if obs_health.enabled():
+            ds.reference_profile()
         return ds
 
     @staticmethod
@@ -395,6 +430,11 @@ class BinnedDataset:
             ingest.finish()
             ds.device_ingest = ingest
         ds.raw_data = raw
+        if reference is None:
+            from .obs import health as obs_health
+            obs_health.configure_from_config(config)
+            if obs_health.enabled():
+                ds.reference_profile()
         return ds
 
     def _resolve_construct_mode(self, is_reference: bool) -> None:

@@ -316,6 +316,11 @@ class GBDT:
         self._fused_phys = None
         self._init_phys_fn = None
         self._scores_arr = None
+        # model & data health (obs/health.py): the training flight
+        # recorder (None when health=off) and the reference data profile
+        # persisted with the model; all host-side bookkeeping
+        self.flight = None
+        self.health_profile = None
 
         if train_data is not None:
             self._setup_training(train_data)
@@ -380,6 +385,11 @@ class GBDT:
         self.num_data = train_data.num_data
         self.max_feature_idx = train_data.num_total_features - 1
         self.feature_names = list(train_data.feature_names)
+        from ..obs import health as obs_health
+        obs_health.configure_from_config(cfg)
+        if obs_health.enabled():
+            self.flight = obs_health.FlightRecorder.from_config(cfg)
+            self.health_profile = train_data.reference_profile()
         if self.objective is not None:
             self.objective.init(train_data.metadata)
         self.train_metrics = create_metrics(
@@ -1264,6 +1274,7 @@ class GBDT:
                                        + self.init_scores[k_cls])
             else:
                 tree.leaf_value = np.asarray([self.init_scores[k_cls]])
+        self._health_record_tree(host_record, num_nodes)
         self.models.append(tree)
         self.device_trees.append({
             "nodes": nodes, "leaf_value": delta_leaf,
@@ -1280,6 +1291,42 @@ class GBDT:
         """Materialize all lagged fused-iteration records (no-op usually)."""
         if getattr(self, "_pending_recs", None):
             self._drain_pending(0)
+
+    # -- health flight recorder (obs/health.py) -------------------------
+    def _health_effective_rows(self) -> int:
+        """This iteration's effective sample count under GOSS/bagging —
+        the host-side derivation (the actual balanced-bagging draw is a
+        device scalar; reading it here would add the exact JL001 host
+        sync the sampling paths were scrubbed of)."""
+        cfg = self.config
+        N = self.num_data
+        if getattr(self, "goss", False):
+            top_k = max(int(N * cfg.top_rate), 1)
+            other_k = max(int(N * cfg.other_rate), 1)
+            return min(top_k + other_k, N)
+        if getattr(self, "need_bagging", False):
+            if self.balanced_bagging:
+                label = self.train_data.metadata.label
+                pos = int((np.asarray(label) > 0).sum())
+                return max(int(pos * cfg.pos_bagging_fraction
+                               + (N - pos) * cfg.neg_bagging_fraction), 1)
+            return max(int(N * cfg.bagging_fraction), 1)
+        return N
+
+    def _health_record_tree(self, host_record, num_nodes: int) -> None:
+        """Feed one just-materialized host tree record to the flight
+        recorder (a no-op unless health != off armed one at setup).
+        Called at BOTH materialization sites — the lagged fused drain
+        and the eager loop — with values already on the host, so it
+        adds zero device ops and zero syncs by construction (the
+        jaxlint ``health.off`` budget pins the lowering either way)."""
+        if self.flight is None:
+            return
+        K = self.num_tree_per_iteration
+        idx = len(self.models)             # the tree about to append
+        self.flight.record_tree(idx // K, idx % K, host_record,
+                                num_nodes,
+                                effective_rows=self._health_effective_rows())
 
     # ------------------------------------------------------------------
     def continue_from(self, trees, train_pred: np.ndarray) -> None:
@@ -1790,6 +1837,7 @@ class GBDT:
                     tree.leaf_value = np.asarray([self.init_scores[k]])
                     if tree.is_linear:
                         tree.leaf_const = np.asarray([self.init_scores[k]])
+            self._health_record_tree(host_record, num_nodes)
             self.models.append(tree)
             self.device_trees.append({
                 "nodes": nodes, "leaf_value": delta_leaf,

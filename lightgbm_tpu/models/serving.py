@@ -46,6 +46,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..obs import health as obs_health
 from ..obs import memory as obs_memory
 from ..obs import telemetry as obs
 from ..ops.predict import predict_leaf_binned, predict_leaf_thridx
@@ -103,6 +104,10 @@ class ServingEngine:
         # them, so the copy is serving-shaped traffic too) instead of
         # silently answering small batches from the host paths
         self._rewarm: set = set()
+        # training<->serving skew monitor (obs/health.py): None = not
+        # built yet, False = this model can't host one (no reference
+        # profile / no mappers)
+        self._skew = None
         # telemetry HBM attribution: whatever packs this engine holds
         obs_memory.register("serving.packs", self, _pack_memory_arrays)
 
@@ -304,14 +309,46 @@ class ServingEngine:
             pos += take
         return out
 
+    def _skew_monitor(self):
+        """The skew monitor for this model, built lazily the first time
+        health is enabled AND the model carries a reference profile +
+        training mappers; False caches "can't" so the eligibility scan
+        never repeats on the hot path."""
+        if self._skew is None:
+            g = self.gbdt
+            prof = getattr(g, "health_profile", None)
+            ds = g.train_data
+            if (prof is None or ds is None
+                    or getattr(ds, "groups", None) is None):
+                self._skew = False
+            else:
+                self._skew = obs_health.SkewMonitor.from_dataset(
+                    prof, ds, g.config)
+        return self._skew or None
+
     def _run_bucketed(self, kind: str, rows: np.ndarray, run, out_cols,
-                      dtype=np.float64, max_bucket: Optional[int] = None):
+                      dtype=np.float64, max_bucket: Optional[int] = None,
+                      observe: bool = True):
         """Pad ``rows`` (n, G) to buckets and collect ``run(padded)``
         slices into an (n, out_cols) host array."""
         n = rows.shape[0]
+        # training<->serving skew digests: for bin-space kinds the rows
+        # ARE the packed bin matrix, already host-resident — one
+        # vectorized bincount per chunk folds them into the rolling
+        # per-bucket digest (obs/health.py).  health=off costs one
+        # attribute load + compare.  ``observe=False`` opts a caller
+        # out (the early-stop loop re-runs the same rows per block with
+        # PARTIAL sums — double-counted digests and part-sum margins
+        # would poison the distributions).
+        mon = None
+        if observe and obs_health.enabled() \
+                and kind in ("raw", "leaf", "contrib"):
+            mon = self._skew_monitor()
         out = np.zeros((n, out_cols), dtype=dtype)
         for start, stop, bucket in self._chunks(n, max_bucket):
             chunk = rows[start:stop]
+            if mon is not None:
+                mon.observe_binned(chunk, bucket=bucket)
             if bucket > chunk.shape[0]:
                 pad = np.zeros((bucket - chunk.shape[0],) + chunk.shape[1:],
                                dtype=chunk.dtype)
@@ -324,6 +361,8 @@ class ServingEngine:
             with (obs.span(f"serve.{kind}@{bucket}")
                   if obs.enabled() else obs.NULL):
                 out[start:stop] = run(chunk)[:stop - start]
+        if mon is not None and kind == "raw":
+            mon.observe_margins(out)
         return out
 
     # ------------------------------------------------------------------
@@ -656,7 +695,8 @@ class ServingEngine:
                                                pk["deltas"], mask, bd))
                                  for pk in pack["per_k"]], axis=1)
 
-            out[active] += self._run_bucketed("raw", sub, run, K)
+            out[active] += self._run_bucketed("raw", sub, run, K,
+                                              observe=False)
         return out
 
     # ------------------------------------------------------------------

@@ -9,19 +9,26 @@ JSONL / Chrome-trace / Prometheus writers and
 artifact.  Enabled by the ``telemetry=off|counters|trace`` parameter
 (or ``LIGHTGBM_TPU_TELEMETRY``); read at runtime via
 ``Booster.telemetry_report()`` or the CLI's ``telemetry_out=`` export.
+
+Model & data health rides on top: :mod:`lightgbm_tpu.obs.digest`
+(on-device per-feature bin-occupancy digests with a bit-identical
+NumPy oracle, PSI/chi-square skew scoring) and
+:mod:`lightgbm_tpu.obs.health` (the ``health=off|counters|trace``
+session, training flight recorder, training↔serving skew monitor,
+drift attribution) — read via ``Booster.health_report()``.
 """
 
-from . import memory
+from . import digest, health, memory
 from .exporters import (export_all, export_chrome_trace, export_jsonl,
                         export_prometheus, prometheus_text)
 from .telemetry import (MODES, NULL, Telemetry, compile_event,
                         configure_from_config, counter, enabled, gauge,
-                        get, span)
+                        get, instant, span)
 
 __all__ = [
     "MODES", "NULL", "Telemetry", "compile_event",
     "configure_from_config", "counter", "enabled", "gauge", "get",
-    "span", "memory", "memory_snapshot",
+    "instant", "span", "digest", "health", "memory", "memory_snapshot",
     "export_all", "export_chrome_trace", "export_jsonl",
     "export_prometheus", "prometheus_text",
 ]

@@ -5,13 +5,22 @@ run now also drops one structured artifact so rounds can be diffed,
 plotted and regression-checked by tooling.  One file per run (atomic
 write), schema::
 
-    {"schema": "lightgbm-tpu/bench-obs/v1",
+    {"schema": "lightgbm-tpu/bench-obs/v2",
      "tool": "bench" | "ab_bench" | ...,
      "unix_time": ..., "backend": "cpu"|"tpu"|...,
      "config": {...},            # the knobs that shaped the run
      "timings": {...},           # the tool's own timing report
      "compile_counts": {...},    # telemetry compile events (key -> n)
-     "memory_peaks": {...}}      # ledger owners + backend allocator stats
+     "memory_peaks": {...},      # ledger owners + backend allocator stats
+     "health": {...}}            # v2: model/data-health section — digest
+                                 # overhead numbers, skew scores from the
+                                 # drift drill, flight-recorder summary
+                                 # (null when the run carried none)
+
+Schema history: v1 had no ``health`` key; v2 adds it (always present,
+possibly null).  ``validate_bench_obs`` checks the v2 shape — the
+``ab_bench --drift`` lane asserts its health numbers and
+``trace_report --smoke`` validates the document structure.
 
 Path: ``--obs-out``/caller argument, else ``$BENCH_OBS_PATH``, else
 ``BENCH_obs.json`` in the working directory.
@@ -22,16 +31,17 @@ from __future__ import annotations
 import json
 import os
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 from . import memory as obs_memory
 from . import telemetry as obs_telemetry
 from .exporters import _atomic_write
 
-SCHEMA = "lightgbm-tpu/bench-obs/v1"
+SCHEMA = "lightgbm-tpu/bench-obs/v2"
 
 __all__ = ["SCHEMA", "default_path", "collect_compile_counts",
-           "collect_memory_peaks", "write_bench_obs"]
+           "collect_memory_peaks", "write_bench_obs",
+           "validate_bench_obs"]
 
 
 def default_path() -> str:
@@ -57,9 +67,13 @@ def write_bench_obs(tool: str, config: Dict[str, Any],
                     timings: Dict[str, Any],
                     compile_counts: Optional[Dict[str, int]] = None,
                     memory_peaks: Optional[Dict[str, Any]] = None,
+                    health: Optional[Dict[str, Any]] = None,
                     path: Optional[str] = None) -> str:
     """Write the artifact; never raises past a warning (a failed
-    artifact write must not sink a finished benchmark)."""
+    artifact write must not sink a finished benchmark).  ``health``
+    is the v2 model/data-health section (skew scores, digest overhead
+    — see the module docstring); the key is always present so schema
+    consumers need no version branch."""
     try:
         import jax
         backend = jax.default_backend()
@@ -76,6 +90,7 @@ def write_bench_obs(tool: str, config: Dict[str, Any],
                            if compile_counts is None else compile_counts),
         "memory_peaks": (collect_memory_peaks()
                          if memory_peaks is None else memory_peaks),
+        "health": health,
     }
     out = path or default_path()
     try:
@@ -85,3 +100,28 @@ def write_bench_obs(tool: str, config: Dict[str, Any],
         from ..utils import log
         log.warning("could not write %s: %s", out, exc)
         return out
+
+
+def validate_bench_obs(doc: Dict[str, Any]) -> List[str]:
+    """Structural problems of a BENCH_obs document against schema v2
+    (empty list = valid).  Used by ``trace_report --smoke`` and the
+    ``ab_bench --drift`` lane so a malformed artifact fails loudly."""
+    problems: List[str] = []
+    if doc.get("schema") != SCHEMA:
+        problems.append(f"schema is {doc.get('schema')!r}, want {SCHEMA!r}")
+    for key, typ in (("tool", str), ("config", dict), ("timings", dict),
+                     ("compile_counts", dict), ("memory_peaks", dict)):
+        if not isinstance(doc.get(key), typ):
+            problems.append(f"{key} missing or not a {typ.__name__}")
+    if "health" not in doc:
+        problems.append("health key missing (v2 requires it, null ok)")
+    elif doc["health"] is not None:
+        h = doc["health"]
+        if not isinstance(h, dict):
+            problems.append("health is not an object")
+        elif not any(k in h for k in ("skew_top", "digest_overhead_pct",
+                                      "flight_recorder", "planted_rank")):
+            problems.append("health section carries none of the known "
+                            "keys (skew_top / digest_overhead_pct / "
+                            "flight_recorder / planted_rank)")
+    return problems

@@ -1,0 +1,359 @@
+"""On-device per-feature data-health digests over the binned matrix.
+
+The binned HBM-resident representation at the heart of the design makes
+data-quality monitoring nearly free: a per-feature bin-occupancy digest
+is ONE scatter-add reduction over the same packed (rows, G) / (G, N_pad)
+buffer the histogram kernels already stream (cf. the histogram-centric
+designs of arXiv:1706.08359 and the Booster inference accelerator,
+arXiv:2011.02022) — a sliver of the MXU work PERF.md budgets per
+iteration.  Everything here is integer-exact and comes in two strictly
+bit-identical flavors:
+
+* **device** (``bin_counts_device`` / ``bin_counts_device_t`` /
+  ``snapshot_device``) — one fused jitted reduction per snapshot, at
+  most ONE device→host sync (``jax.device_get`` of the whole result
+  tuple).  Never called from the training loop itself (the jaxlint
+  ``health.off`` tier-B budget pins the fused train step's lowering as
+  health-mode-independent); snapshots are explicit.
+* **host** (``bin_counts_host`` / ``margin_hist_host``) — the NumPy
+  oracle, also the implementation the serving-path skew digests use
+  (serving rows are already host-resident there, so the digest costs
+  one vectorized bincount and zero device work).
+
+On top of the raw group-column counts:
+
+* ``per_feature_counts`` unbundles EFB-packed group columns back into
+  exact per-original-feature bin occupancy (offset arithmetic only —
+  with the project's max_conflict_rate = 0 bundling there are no
+  conflicts to approximate);
+* ``build_reference_profile`` captures the training-time distribution
+  (per-feature bin counts, missing/zero rates, categorical
+  cardinalities) as a JSON-able document persisted alongside the model;
+* ``psi`` / ``chi2`` / ``rank_skew`` score a serving-time digest
+  against that reference (population stability index and the classic
+  chi-square statistic) and rank the most-skewed features.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = [
+    "MARGIN_BUCKETS", "bin_counts_host", "bin_counts_device",
+    "bin_counts_device_t", "margin_hist_host",
+    "snapshot_device", "per_feature_counts", "build_reference_profile",
+    "psi", "chi2", "rank_skew",
+]
+
+# prediction-margin log2 histogram: bucket 0 holds zero/underflow
+# margins (|m| < 2^-16), buckets 1..33 hold frexp exponents -16..16
+# (clipped), i.e. 2^(e-1) <= |m| < 2^e.  Fixed width keeps digests
+# mergeable across snapshots.
+MARGIN_BUCKETS = 34
+_MARGIN_EXP_LO = -16
+
+
+# ---------------------------------------------------------------------------
+# bin-occupancy counts (group-column space)
+# ---------------------------------------------------------------------------
+def bin_counts_host(binned, num_bins: int) -> np.ndarray:
+    """(G, num_bins) int64 occupancy counts of a row-major (n, G)
+    packed bin matrix — the NumPy oracle (one flattened bincount)."""
+    b = np.asarray(binned)
+    if b.ndim != 2:
+        raise ValueError("binned must be 2-D (rows, groups)")
+    n, G = b.shape
+    if G == 0 or n == 0:
+        return np.zeros((G, int(num_bins)), dtype=np.int64)
+    nb = int(num_bins)
+    flat = b.astype(np.int64) + np.arange(G, dtype=np.int64)[None, :] * nb
+    return np.bincount(flat.ravel(), minlength=G * nb) \
+        .reshape(G, nb).astype(np.int64)
+
+
+@functools.lru_cache(maxsize=1)
+def _dev_counts_fn():
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, static_argnames=("nb", "t"))
+    def impl(b, nb, t):
+        b = b.astype(jnp.int32)
+        if t:
+            G = b.shape[0]
+            flat = b + (jnp.arange(G, dtype=jnp.int32) * nb)[:, None]
+        else:
+            G = b.shape[1]
+            flat = b + (jnp.arange(G, dtype=jnp.int32) * nb)[None, :]
+        return jnp.zeros((G * nb,), jnp.int32).at[flat.ravel()] \
+            .add(1).reshape(G, nb)
+
+    return impl
+
+
+def _dev_counts(binned, num_bins: int, transposed: bool):
+    return _dev_counts_fn()(binned, nb=int(num_bins), t=bool(transposed))
+
+
+def bin_counts_device(binned, num_bins: int):
+    """Device twin of :func:`bin_counts_host` over a row-major (n, G)
+    buffer: one jitted scatter-add, result left ON DEVICE (callers
+    decide when to pay the single sync — see ``snapshot_device``)."""
+    return _dev_counts(binned, num_bins, transposed=False)
+
+
+def bin_counts_device_t(binned_t, num_bins: int):
+    """Feature-major twin over the learner's (G, N_pad) layout (the
+    direct-to-device ingest buffer).  Pad columns are all-zero by
+    construction; subtract them from bin 0 host-side."""
+    return _dev_counts(binned_t, num_bins, transposed=True)
+
+
+# ---------------------------------------------------------------------------
+# prediction-margin log2 histograms
+# ---------------------------------------------------------------------------
+def margin_hist_host(raw) -> np.ndarray:
+    """(MARGIN_BUCKETS,) int64 log2-bucket histogram of margins — the
+    NumPy oracle, float32 end to end like the device kernel so the two
+    are bit-identical on the same input."""
+    r = np.asarray(raw, dtype=np.float32)
+    if r.ndim == 2 and r.shape[1] > 1:
+        part = np.sort(r, axis=1)
+        m = part[:, -1] - part[:, -2]
+    else:
+        m = np.abs(r.reshape(-1))
+    m = np.abs(m)
+    if m.size == 0:
+        return np.zeros((MARGIN_BUCKETS,), np.int64)
+    _, e = np.frexp(m)
+    b = np.clip(e - _MARGIN_EXP_LO, 1, MARGIN_BUCKETS - 1)
+    b = np.where(np.isfinite(m) & (m > 0), b, 0)
+    return np.bincount(b.astype(np.int64),
+                       minlength=MARGIN_BUCKETS).astype(np.int64)
+
+
+@functools.lru_cache(maxsize=1)
+def _margin_hist_dev_fn():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def impl(r):
+        r = r.astype(jnp.float32)
+        if r.ndim == 2 and r.shape[1] > 1:
+            part = jnp.sort(r, axis=1)
+            m = part[:, -1] - part[:, -2]
+        else:
+            m = jnp.abs(r.reshape(-1))
+        m = jnp.abs(m)
+        _, e = jnp.frexp(m)
+        b = jnp.clip(e - _MARGIN_EXP_LO, 1, MARGIN_BUCKETS - 1)
+        b = jnp.where(jnp.isfinite(m) & (m > 0), b, 0)
+        return jnp.zeros((MARGIN_BUCKETS,), jnp.int32) \
+            .at[b.astype(jnp.int32)].add(1)
+
+    return impl
+
+
+def _margin_hist_dev(raw):
+    return _margin_hist_dev_fn()(raw)
+
+
+def snapshot_device(binned, num_bins: int, raw=None,
+                    transposed: bool = False,
+                    pad_cols: int = 0) -> Dict[str, np.ndarray]:
+    """One digest snapshot from device-resident buffers: the fused
+    bin-occupancy reduction (plus, optionally, the margin histogram of
+    ``raw`` scores) dispatched together and materialized with EXACTLY
+    one device→host sync.  ``pad_cols`` all-zero pad columns (the
+    (G, N_pad) ingest layout) are subtracted from bin 0."""
+    import jax
+    counts = _dev_counts(binned, num_bins, transposed)
+    parts = [counts]
+    if raw is not None:
+        parts.append(_margin_hist_dev(raw))
+    host = jax.device_get(parts)          # the ONE sync
+    counts = np.asarray(host[0], dtype=np.int64)
+    if pad_cols:
+        counts[:, 0] -= int(pad_cols)
+    out = {"group_counts": counts}
+    if raw is not None:
+        out["margin_hist"] = np.asarray(host[1], dtype=np.int64)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# group-column counts -> per-original-feature counts (EFB unbundling)
+# ---------------------------------------------------------------------------
+def per_feature_counts(groups, bin_mappers, num_data: int,
+                       group_counts: np.ndarray
+                       ) -> Dict[int, np.ndarray]:
+    """Exact per-feature bin occupancy from packed group-column counts.
+
+    Singleton groups ARE the feature.  Bundled features occupy disjoint
+    non-default ranges of the shared column (bin b != 0 lives at
+    ``offset + b - 1``; every bundle member has most_freq_bin == 0 by
+    the bundling precondition), so each member's default-bin count is
+    ``num_data`` minus its own non-default occupancy — exact, because
+    max_conflict_rate = 0 bundling admits no overlapping rows."""
+    out: Dict[int, np.ndarray] = {}
+    gc = np.asarray(group_counts, dtype=np.int64)
+    for g, grp in enumerate(groups):
+        if len(grp.feature_indices) == 1:
+            f = grp.feature_indices[0]
+            nb = bin_mappers[f].num_bin
+            out[f] = gc[g, :nb].copy()
+            continue
+        for sub, f in enumerate(grp.feature_indices):
+            bm = bin_mappers[f]
+            nb = bm.num_bin
+            offset = grp.bin_offsets[sub]
+            c = np.zeros((nb,), np.int64)
+            if nb > 1:
+                c[1:nb] = gc[g, offset:offset + nb - 1]
+            c[0] = int(num_data) - int(c[1:].sum())
+            out[f] = c
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the reference profile (training-time distribution, model-persisted)
+# ---------------------------------------------------------------------------
+PROFILE_VERSION = 1
+
+
+def build_reference_profile(ds, group_counts: np.ndarray,
+                            margin_hist: Optional[np.ndarray] = None
+                            ) -> Dict[str, Any]:
+    """JSON-able training-data profile for a constructed BinnedDataset:
+    per-feature bin counts, missing/zero rates and categorical
+    cardinalities — the reference every serving-time digest is scored
+    against.  ``ds`` duck-types groups / bin_mappers / num_data /
+    feature_names."""
+    from ..ops.binning import (BIN_CATEGORICAL, MISSING_NAN, MISSING_ZERO)
+    n = int(ds.num_data)
+    feats = per_feature_counts(ds.groups, ds.bin_mappers, n, group_counts)
+    names = list(getattr(ds, "feature_names", []) or [])
+    features: List[Dict[str, Any]] = []
+    for f in sorted(feats):
+        bm = ds.bin_mappers[f]
+        counts = feats[f]
+        is_cat = bm.bin_type == BIN_CATEGORICAL
+        if is_cat:
+            missing = int(counts[0])        # NaN/other -> bin 0
+            zero = int(counts[bm.categorical_2_bin.get(0, 0)]
+                       ) if 0 in bm.categorical_2_bin else 0
+            card = int(bm.num_bin - 1)
+        else:
+            missing = (int(counts[bm.num_bin - 1])
+                       if bm.missing_type == MISSING_NAN else
+                       int(counts[bm.default_bin])
+                       if bm.missing_type == MISSING_ZERO else 0)
+            zero = int(counts[bm.default_bin])
+            card = None
+        features.append({
+            "index": int(f),
+            "name": names[f] if f < len(names) else f"Column_{f}",
+            "num_bin": int(bm.num_bin),
+            "bin_type": int(bm.bin_type),
+            "missing_type": int(bm.missing_type),
+            "counts": [int(c) for c in counts],
+            "missing_rate": round(missing / max(n, 1), 6),
+            "zero_rate": round(zero / max(n, 1), 6),
+            "cardinality": card,
+        })
+    prof: Dict[str, Any] = {"version": PROFILE_VERSION, "num_data": n,
+                            "features": features}
+    if margin_hist is not None:
+        prof["margin_hist"] = [int(v) for v in margin_hist]
+    return prof
+
+
+# ---------------------------------------------------------------------------
+# skew scoring: PSI + chi-square against the reference
+# ---------------------------------------------------------------------------
+def coarsen(ref_counts, cur_counts, target_bins: int = 16):
+    """Merge adjacent fine bins into <= ``target_bins`` groups of
+    roughly equal REFERENCE mass before scoring.  255 near-empty fine
+    bins against a few hundred serving rows makes eps-floored PSI pure
+    sampling noise; equal-mass coarse bins are the standard fix and
+    keep the 0.25 rule-of-thumb threshold meaningful at small n.  The
+    same cuts apply to both vectors, so a genuine shift survives
+    coarsening while per-bin noise cancels."""
+    r = np.asarray(ref_counts, np.float64)
+    c = np.asarray(cur_counts, np.float64)
+    nb = len(r)
+    if nb <= target_bins:
+        return r, c
+    rn = r.sum()
+    if rn <= 0:
+        return r, c
+    quota = rn / target_bins
+    cuts = [0]
+    acc = 0.0
+    for i in range(nb):
+        acc += r[i]
+        if acc >= quota * len(cuts) and i + 1 < nb:
+            cuts.append(i + 1)
+    cuts.append(nb)
+    rr = np.add.reduceat(r, cuts[:-1])
+    cc = np.add.reduceat(c, cuts[:-1])
+    return rr, cc
+
+
+def psi(ref_counts: Sequence[int], cur_counts: Sequence[int],
+        eps: float = 1e-4) -> float:
+    """Population stability index between two bin-count vectors
+    (probabilities floored at ``eps`` so empty bins score finitely).
+    Rule of thumb: < 0.1 stable, 0.1-0.25 drifting, > 0.25 shifted."""
+    r = np.asarray(ref_counts, np.float64)
+    c = np.asarray(cur_counts, np.float64)
+    rn, cn = r.sum(), c.sum()
+    if rn <= 0 or cn <= 0:
+        return 0.0
+    p = np.maximum(r / rn, eps)
+    q = np.maximum(c / cn, eps)
+    return float(np.sum((q - p) * np.log(q / p)))
+
+
+def chi2(ref_counts: Sequence[int], cur_counts: Sequence[int]) -> float:
+    """Pearson chi-square statistic of the observed serving counts
+    against expectations scaled from the reference distribution,
+    normalized per observed row (scale-free across batch sizes)."""
+    r = np.asarray(ref_counts, np.float64)
+    c = np.asarray(cur_counts, np.float64)
+    rn, cn = r.sum(), c.sum()
+    if rn <= 0 or cn <= 0:
+        return 0.0
+    expected = r / rn * cn
+    mask = expected > 0
+    extra = c[~mask].sum()                 # observed mass in empty ref bins
+    stat = float(np.sum((c[mask] - expected[mask]) ** 2
+                        / expected[mask])) + float(extra * cn)
+    return float(stat / cn)
+
+
+def rank_skew(profile: Dict[str, Any],
+              cur_feature_counts: Dict[int, np.ndarray],
+              topk: int = 0) -> List[Dict[str, Any]]:
+    """Per-feature PSI/chi-square of a serving-time digest against the
+    reference profile, most-skewed first; ``topk`` trims (0 = all)."""
+    out: List[Dict[str, Any]] = []
+    for fe in profile.get("features", []):
+        f = int(fe["index"])
+        cur = cur_feature_counts.get(f)
+        if cur is None:
+            continue
+        ref = fe["counts"]
+        if len(cur) != len(ref):
+            continue                      # mapper mismatch: not scorable
+        cr, cc = coarsen(ref, cur)
+        out.append({"feature": f, "name": fe.get("name", str(f)),
+                    "psi": round(psi(cr, cc), 6),
+                    "chi2": round(chi2(cr, cc), 6),
+                    "rows": int(np.asarray(cur).sum())})
+    out.sort(key=lambda d: (-d["psi"], d["feature"]))
+    return out[:topk] if topk else out

@@ -14,7 +14,6 @@ from __future__ import annotations
 
 import json
 import os
-import re
 import tempfile
 from typing import Any, Dict
 
@@ -66,29 +65,42 @@ def export_jsonl(tel, path: str) -> str:
     return _atomic_write(path, "\n".join(lines) + "\n")
 
 
-_LABEL_BAD = re.compile(r"[^a-zA-Z0-9_]")
-
-
 def _esc(v: str) -> str:
-    return str(v).replace("\\", "\\\\").replace('"', '\\"')
+    """Label-value escaping per the exposition format: backslash,
+    double-quote and LINE FEED are the three characters with escape
+    sequences (an unescaped newline truncates the sample line and
+    corrupts every line after it)."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
 
 
 def prometheus_text(tel) -> str:
     """Prometheus exposition-format dump of the aggregate state (a
     text snapshot, not a live scrape endpoint — pipe it wherever the
-    fleet's node exporter picks up textfiles)."""
+    fleet's node exporter picks up textfiles).
+
+    Strictly conformant to the text format (round-tripped through a
+    full parser in tests/test_telemetry.py): one ``# TYPE`` per metric
+    family, and the span-latency summary owns its ``_sum``/``_count``
+    series — they are part of the summary family, never declared as a
+    separate counter (the Prometheus parser rejects a family whose
+    name collides with another family's reserved suffix)."""
     rep = tel.report()
     out = []
     out.append("# TYPE lightgbm_tpu_span_count counter")
-    out.append("# TYPE lightgbm_tpu_span_seconds_sum counter")
+    for name, h in sorted(rep["spans"].items()):
+        out.append('lightgbm_tpu_span_count{name="%s"} %s'
+                   % (_esc(name), h["count"]))
     out.append("# TYPE lightgbm_tpu_span_seconds summary")
     for name, h in sorted(rep["spans"].items()):
-        lbl = f'{{name="{_esc(name)}"}}'
-        out.append(f"lightgbm_tpu_span_count{lbl} {h['count']}")
-        out.append(f"lightgbm_tpu_span_seconds_sum{lbl} {h['total_s']}")
+        lbl = _esc(name)
         for q, qv in (("p50_s", "0.5"), ("p99_s", "0.99")):
             out.append('lightgbm_tpu_span_seconds{name="%s",quantile="%s"}'
-                       ' %s' % (_esc(name), qv, h[q]))
+                       ' %s' % (lbl, qv, h[q]))
+        out.append(f'lightgbm_tpu_span_seconds_sum{{name="{lbl}"}} '
+                   f'{h["total_s"]}')
+        out.append(f'lightgbm_tpu_span_seconds_count{{name="{lbl}"}} '
+                   f'{h["count"]}')
     out.append("# TYPE lightgbm_tpu_counter_total counter")
     for name, v in sorted(rep["counters"].items()):
         out.append(f'lightgbm_tpu_counter_total{{name="{_esc(name)}"}} {v}')
@@ -98,6 +110,7 @@ def prometheus_text(tel) -> str:
     out.append("# TYPE lightgbm_tpu_gauge gauge")
     for name, v in sorted(rep["gauges"].items()):
         out.append(f'lightgbm_tpu_gauge{{name="{_esc(name)}"}} {float(v)}')
+    out.append("# TYPE lightgbm_tpu_events_dropped counter")
     out.append(f"lightgbm_tpu_events_dropped {rep['events_dropped']}")
     return "\n".join(out) + "\n"
 

@@ -1,0 +1,366 @@
+"""Model & data health: the session, the training flight recorder and
+the training↔serving skew monitor.
+
+PR 7 gave the runtime *system* observability (spans, retrace counters,
+HBM ledger); this module watches *model and data* health on top of the
+same machinery:
+
+* a process-wide session gated by the ``health=off|counters|trace``
+  parameter, riding the telemetry modes (``trace`` also upgrades the
+  telemetry session to ``trace`` so health marks export through the
+  PR-7 JSONL / Chrome-trace / Prometheus writers with no new writer);
+* :class:`FlightRecorder` — per-iteration split decisions (feature,
+  bin, gain, leaf counts), gradient-norm digests and effective sample
+  counts under GOSS/bagging, recorded from the host tree records the
+  trainer ALREADY materializes — zero extra device ops or syncs, which
+  is exactly what the jaxlint tier-B ``health.off`` budget pins;
+* :class:`SkewMonitor` — rolling serving-time per-feature digests
+  (obs/digest.py) scored against the model's reference profile with
+  PSI / chi-square, per serving bucket, with threshold-crossing alert
+  events on the telemetry ring;
+* :func:`attribute_drift` — ranks the features whose serving-window
+  distribution moved most against the reference, so a continual-runtime
+  regression tick can NAME the offending features instead of only
+  flagging "metric regressed".
+
+The contract matches the telemetry layer's: **off is free** (one
+attribute load + string compare at every entry point) and **no mode
+ever stages device ops** — digests of device buffers happen only in
+explicit snapshot calls, each costing at most one device→host sync.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from . import digest
+from . import telemetry as obs
+
+__all__ = [
+    "MODES", "HealthSession", "get", "enabled", "configure_from_config",
+    "FlightRecorder", "SkewMonitor", "attribute_drift",
+]
+
+MODES = ("off", "counters", "trace")
+_MODE_RANK = {m: i for i, m in enumerate(MODES)}
+
+
+class HealthSession:
+    """Process-wide health mode (one session, like the telemetry one:
+    training, serving and the continual runtime all consult it)."""
+
+    def __init__(self, mode: str = "off"):
+        self.mode = "off"
+        self.set_mode(mode)
+
+    def set_mode(self, mode: str) -> None:
+        if mode not in MODES:
+            raise ValueError(f"health mode must be one of {MODES}, "
+                             f"got {mode!r}")
+        self.mode = mode
+
+    def enable(self, mode: str) -> None:
+        """Upgrade-only, like telemetry: a component asking for less
+        never silences a session another component raised.  ``trace``
+        also raises the telemetry session to ``trace`` — health events
+        ride its ring and exporters."""
+        if mode not in MODES:
+            raise ValueError(f"health mode must be one of {MODES}, "
+                             f"got {mode!r}")
+        if _MODE_RANK[mode] > _MODE_RANK[self.mode]:
+            self.mode = mode
+        if self.mode == "trace":
+            obs.get().enable("trace")
+
+    @property
+    def enabled(self) -> bool:
+        return self.mode != "off"
+
+
+_ENV_MODE = os.environ.get("LIGHTGBM_TPU_HEALTH", "off")
+_SESSION = HealthSession(_ENV_MODE if _ENV_MODE in MODES else "off")
+
+
+def get() -> HealthSession:
+    return _SESSION
+
+
+def enabled() -> bool:
+    return _SESSION.mode != "off"
+
+
+def configure_from_config(cfg) -> HealthSession:
+    """Enable the session from a Config's ``health`` parameter
+    (upgrade-only; invalid values fail loudly)."""
+    mode = str(getattr(cfg, "health", "off") or "off").strip().lower()
+    if mode not in MODES:
+        from ..utils import log
+        log.fatal("health must be one of %s, got %r",
+                  "|".join(MODES), mode)
+    if mode != "off":
+        _SESSION.enable(mode)
+    return _SESSION
+
+
+# ---------------------------------------------------------------------------
+# training flight recorder
+# ---------------------------------------------------------------------------
+class FlightRecorder:
+    """Bounded per-tree record of what training decided and why.
+
+    Everything recorded is a host value the trainer already
+    materialized (the device→host tree record): per-split (feature,
+    bin, gain), leaf counts, leaf-value norms (the gradient-norm digest
+    — leaf outputs are -G/(H+λ), so their magnitudes ARE the scaled
+    per-leaf gradient sums), and the iteration's effective sample count
+    under GOSS/bagging.  Oldest trees evict first; cumulative
+    per-feature totals never evict."""
+
+    MAX_TREES = 8192
+    TOP_SPLITS = 3
+
+    def __init__(self, topk: int = 5):
+        self.topk = int(topk)
+        self._lock = threading.Lock()
+        self.entries: deque = deque(maxlen=self.MAX_TREES)
+        self.evicted = 0
+        self.trees = 0
+        # cumulative per-feature totals (survive ring eviction)
+        self.feat_splits: Dict[int, int] = {}
+        self.feat_gain: Dict[int, float] = {}
+
+    @classmethod
+    def from_config(cls, cfg) -> "FlightRecorder":
+        return cls(topk=int(getattr(cfg, "health_topk", 5) or 5))
+
+    def record_tree(self, iteration: int, k: int, host_record,
+                    num_nodes: int,
+                    effective_rows: Optional[int] = None) -> None:
+        nn = int(num_nodes)
+        entry: Dict[str, Any] = {"it": int(iteration), "k": int(k),
+                                 "leaves": nn + 1}
+        if effective_rows is not None:
+            entry["effective_rows"] = int(effective_rows)
+        gain_total = 0.0
+        if nn > 0 and "node_feature" in host_record:
+            feats = np.asarray(host_record["node_feature"])[:nn]
+            gains = (np.asarray(host_record["node_gain"],
+                                dtype=np.float64)[:nn]
+                     if "node_gain" in host_record else np.zeros(nn))
+            bins = (np.asarray(host_record["node_threshold"])[:nn]
+                    if "node_threshold" in host_record
+                    else np.zeros(nn, np.int64))
+            gain_total = float(gains.sum())
+            entry["gain_total"] = round(gain_total, 6)
+            entry["gain_max"] = round(float(gains.max()), 6)
+            order = np.argsort(-gains)[:self.TOP_SPLITS]
+            entry["top_splits"] = [
+                {"feature": int(feats[i]), "bin": int(bins[i]),
+                 "gain": round(float(gains[i]), 6)} for i in order]
+        if "leaf_cnt" in host_record:
+            cnts = np.asarray(host_record["leaf_cnt"])[:nn + 1]
+            if cnts.size:
+                entry["leaf_cnt_min"] = int(cnts.min())
+                entry["leaf_cnt_max"] = int(cnts.max())
+        if "leaf_value" in host_record:
+            lv = np.asarray(host_record["leaf_value"],
+                            dtype=np.float64)[:nn + 1]
+            if lv.size:
+                entry["leaf_l2"] = round(float(np.sqrt((lv ** 2).sum())),
+                                         6)
+                entry["leaf_abs_max"] = round(float(np.abs(lv).max()), 6)
+        with self._lock:
+            self.trees += 1
+            if len(self.entries) == self.entries.maxlen:
+                self.evicted += 1
+            self.entries.append(entry)
+            if nn > 0 and "node_feature" in host_record:
+                for f, g in zip(feats.tolist(), gains.tolist()):
+                    f = int(f)
+                    self.feat_splits[f] = self.feat_splits.get(f, 0) + 1
+                    self.feat_gain[f] = self.feat_gain.get(f, 0.0) \
+                        + float(g)
+        top = entry.get("top_splits")
+        obs.instant("health.tree", it=int(iteration), k=int(k),
+                    leaves=nn + 1, gain_total=round(gain_total, 6),
+                    top_feature=(top[0]["feature"] if top else None))
+
+    # -- reporting ------------------------------------------------------
+    def report(self, trajectory: int = 64) -> Dict[str, Any]:
+        with self._lock:
+            entries = list(self.entries)
+            feat = sorted(self.feat_splits,
+                          key=lambda f: (-self.feat_gain.get(f, 0.0), f))
+            top_features = [
+                {"feature": f, "splits": self.feat_splits[f],
+                 "gain": round(self.feat_gain.get(f, 0.0), 6)}
+                for f in feat[:self.topk]]
+            tail = entries[-trajectory:]
+            return {
+                "trees_recorded": self.trees,
+                "entries_retained": len(entries),
+                "entries_evicted": self.evicted,
+                "top_features": top_features,
+                "gain_trajectory": [
+                    [e["it"], e.get("gain_total", 0.0)] for e in tail],
+                "effective_rows_last": next(
+                    (e["effective_rows"] for e in reversed(entries)
+                     if "effective_rows" in e), None),
+                "last_tree": entries[-1] if entries else None,
+            }
+
+
+# ---------------------------------------------------------------------------
+# training<->serving skew monitor
+# ---------------------------------------------------------------------------
+class SkewMonitor:
+    """Rolling serving-time digests per bucket, scored against the
+    model's reference profile (obs/digest.py).  All host NumPy — the
+    serving path's rows are already host-resident where this runs, so
+    observation costs one vectorized bincount and ZERO device work."""
+
+    ROLL_ROWS = 1 << 21        # halve counts beyond ~2M rows: "rolling"
+    # threshold-scan throttle: the full per-feature PSI scan costs a
+    # few ms, so it runs on a WALL-CLOCK cadence (an alert pipeline
+    # reads seconds anyway), never per observation — a scan landing
+    # inside a hot serving window was the dominant cost of the layer
+    # (measured ~3% of warm predict before the throttle, ~0.3% after)
+    CHECK_INTERVAL_S = 15.0
+    # per-observation digest cap: batches beyond this are stride-
+    # sampled (deterministic, unbiased for any row order) so the
+    # serving hot path pays O(cap) per call, not O(batch) — the ≤2%
+    # overhead budget PERF.md holds the layer to.  2k rows/call keeps
+    # PSI over 16 coarse bins accurate to ~±0.02 while the digest
+    # stays ~0.5 ms on the 2-core host
+    OBSERVE_CAP = 2048
+
+    def __init__(self, profile: Dict[str, Any], groups, bin_mappers,
+                 num_bins: int, topk: int = 5, threshold: float = 0.25):
+        self.profile = profile
+        self.groups = groups
+        self.bin_mappers = bin_mappers
+        self.nb = int(num_bins)
+        self.topk = int(topk)
+        self.threshold = float(threshold)
+        self._lock = threading.Lock()
+        self.counts: Dict[Any, np.ndarray] = {}     # bucket -> (G, nb)
+        self.rows: Dict[Any, int] = {}              # rows DIGESTED
+        self.seen: Dict[Any, int] = {}              # rows served
+        self.margin = np.zeros(digest.MARGIN_BUCKETS, np.int64)
+        self.alerts = 0
+        self._alerted: set = set()
+        self._last_check = time.monotonic()
+
+    @classmethod
+    def from_dataset(cls, profile: Dict[str, Any], ds, cfg
+                     ) -> "SkewMonitor":
+        return cls(profile, ds.groups, ds.bin_mappers, ds.max_group_bins,
+                   topk=int(getattr(cfg, "health_topk", 5) or 5),
+                   threshold=float(getattr(cfg, "health_psi_threshold",
+                                           0.25) or 0.25))
+
+    # -- observation ----------------------------------------------------
+    def observe_binned(self, rows: np.ndarray, bucket=None) -> None:
+        """Fold one (n, G) packed bin-space batch into the rolling
+        digest for ``bucket`` (stride-sampled beyond OBSERVE_CAP)."""
+        n = rows.shape[0]
+        if n == 0:
+            return
+        if n > self.OBSERVE_CAP:
+            rows = rows[::n // self.OBSERVE_CAP + 1]
+        c = digest.bin_counts_host(rows, self.nb)
+        with self._lock:
+            prev = self.counts.get(bucket)
+            self.counts[bucket] = c if prev is None else prev + c
+            self.rows[bucket] = self.rows.get(bucket, 0) + rows.shape[0]
+            self.seen[bucket] = self.seen.get(bucket, 0) + n
+            total = sum(self.rows.values())
+            if total > 2 * self.ROLL_ROWS:
+                for b in self.counts:
+                    self.counts[b] //= 2
+                    self.rows[b] //= 2
+            now = time.monotonic()
+            check = now - self._last_check >= self.CHECK_INTERVAL_S
+            if check:
+                self._last_check = now
+        if check:
+            self._check_thresholds()
+
+    def observe_margins(self, raw) -> None:
+        raw = np.asarray(raw)
+        if raw.shape[0] > self.OBSERVE_CAP:
+            raw = raw[::raw.shape[0] // self.OBSERVE_CAP + 1]
+        h = digest.margin_hist_host(raw)
+        with self._lock:
+            self.margin += h
+
+    # -- scoring --------------------------------------------------------
+    def feature_counts(self) -> Dict[int, np.ndarray]:
+        with self._lock:
+            if not self.counts:
+                return {}
+            total = sum(self.counts.values())
+            n = sum(self.rows.values())
+        return digest.per_feature_counts(self.groups, self.bin_mappers,
+                                         n, total)
+
+    def scores(self, topk: Optional[int] = None) -> List[Dict[str, Any]]:
+        fc = self.feature_counts()
+        if not fc:
+            return []
+        return digest.rank_skew(self.profile, fc,
+                                self.topk if topk is None else topk)
+
+    def _check_thresholds(self) -> None:
+        for s in self.scores(topk=0):
+            if s["psi"] > self.threshold and s["feature"] not in \
+                    self._alerted:
+                with self._lock:
+                    self._alerted.add(s["feature"])
+                    self.alerts += 1
+                obs.counter("health.skew.alerts")
+                obs.instant("health.skew", feature=s["feature"],
+                            feature_name=s["name"], psi=s["psi"],
+                            threshold=self.threshold)
+
+    def report(self) -> Dict[str, Any]:
+        # a report is an explicit snapshot point: crossings observed
+        # since the last periodic scan must not wait CHECK_EVERY more
+        # observations to surface
+        self._check_thresholds()
+        with self._lock:
+            rows = {str(k): int(v) for k, v in sorted(
+                self.rows.items(), key=lambda kv: str(kv[0]))}
+            seen = {str(k): int(v) for k, v in sorted(
+                self.seen.items(), key=lambda kv: str(kv[0]))}
+            margin = [int(v) for v in self.margin]
+            alerts = self.alerts
+        return {"rows_by_bucket": rows, "rows_total": sum(rows.values()),
+                "rows_seen": sum(seen.values()),
+                "alerts": alerts, "psi_threshold": self.threshold,
+                "top": self.scores(), "margin_hist": margin}
+
+
+# ---------------------------------------------------------------------------
+# drift attribution (the continual runtime's regression ticks)
+# ---------------------------------------------------------------------------
+def attribute_drift(profile: Dict[str, Any], ds,
+                    batch_counts: List[np.ndarray], rows: int,
+                    topk: int = 5) -> List[Dict[str, Any]]:
+    """Rank features by how far the RECENT serving window's digest
+    (summed per-batch group counts) moved from the reference profile —
+    the answer to "the metric regressed: WHICH feature drifted?"."""
+    if not batch_counts:
+        return []
+    total = batch_counts[0].copy()
+    for c in batch_counts[1:]:
+        total += c
+    fc = digest.per_feature_counts(ds.groups, ds.bin_mappers,
+                                   int(rows), total)
+    return digest.rank_skew(profile, fc, topk)

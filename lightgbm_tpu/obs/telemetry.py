@@ -50,7 +50,7 @@ from typing import Any, Dict, List, Optional
 
 __all__ = [
     "MODES", "Telemetry", "get", "enabled", "configure_from_config",
-    "span", "counter", "gauge", "compile_event", "NULL",
+    "span", "counter", "gauge", "compile_event", "instant", "NULL",
 ]
 
 MODES = ("off", "counters", "trace")
@@ -285,6 +285,19 @@ class Telemetry:
                                        * 1e6),
                              "args": {"value": value}})
 
+    def instant(self, name: str, **args) -> None:
+        """One instant ("i") event on the trace ring — trace mode only
+        (there is no aggregate to keep in counters mode).  Used by the
+        health layer for flight-recorder / skew-alert marks so the
+        PR-7 exporters carry them without any new writer."""
+        if self.mode != "trace":
+            return
+        with self._lock:
+            self._event({"ph": "i", "s": "t", "name": name,
+                         "ts": int((time.perf_counter() - self.epoch)
+                                   * 1e6),
+                         "args": args or {}})
+
     # -- retrace/compile detector ---------------------------------------
     def compile_event(self, key: str) -> None:
         """Call this from INSIDE a function handed to ``jax.jit``: the
@@ -376,3 +389,9 @@ def compile_event(key: str) -> None:
     if _SESSION.mode == "off":
         return
     _SESSION.compile_event(key)
+
+
+def instant(name: str, **args) -> None:
+    if _SESSION.mode != "trace":
+        return
+    _SESSION.instant(name, **args)

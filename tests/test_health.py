@@ -1,0 +1,332 @@
+"""Model & data health observability (lightgbm_tpu/obs/digest.py +
+health.py): device-digest bit-parity against the NumPy oracle across
+the awkward dataset shapes (NaN, zero-as-missing, categorical,
+max_bin_by_feature), the reference profile, the training flight
+recorder, serving-side skew digests, the continual runtime's drift
+attribution (the planted covariate-shift feature must rank #1), and
+the telemetry span stack unwinding through exceptions."""
+
+import pickle
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.obs import digest, health
+from lightgbm_tpu.obs import telemetry as obs_tel
+
+BASE = {"objective": "regression", "verbosity": -1, "num_leaves": 7,
+        "min_data_in_leaf": 5, "metric": ""}
+
+
+@pytest.fixture(autouse=True)
+def _reset_sessions():
+    """Health/telemetry sessions are process-global; tests must not
+    leak modes into each other (or into other files)."""
+    hs, ts = health.get(), obs_tel.get()
+    h_prev, t_prev = hs.mode, ts.mode
+    yield
+    hs.set_mode(h_prev)
+    ts.reset(mode=t_prev)
+
+
+def _datasets(rng):
+    """The four awkward binning shapes the digest must count exactly."""
+    n = 600
+    base = rng.normal(size=(n, 5))
+    nan = base.copy()
+    nan[rng.rand(n) < 0.15, 1] = np.nan                    # NaN missing
+    zeros = base.copy()
+    zeros[rng.rand(n) < 0.5, 2] = 0.0                      # exact zeros
+    cat = base.copy()
+    cat[:, 4] = rng.randint(0, 6, size=n)                  # categorical
+    return [
+        ("nan", nan, {}),
+        ("zero_as_missing", zeros, {"zero_as_missing": True}),
+        ("categorical", cat, {"categorical_feature": [4]}),
+        ("max_bin_by_feature", base,
+         {"max_bin_by_feature": "255,15,7,255,31"}),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# digest bit-parity: device reduction vs the NumPy oracle
+# ---------------------------------------------------------------------------
+def test_bin_counts_device_matches_oracle_across_datasets(rng):
+    y = rng.normal(size=600)
+    for name, X, extra in _datasets(rng):
+        ds = lgb.Dataset(X, label=y, params={**BASE, **extra})
+        ds.construct({**BASE, **extra})
+        binned = ds._inner.host_binned()
+        nb = ds._inner.max_group_bins
+        host = digest.bin_counts_host(binned, nb)
+        dev = np.asarray(digest.bin_counts_device(jnp.asarray(binned),
+                                                  nb))
+        assert np.array_equal(host, dev), name
+        # transposed (learner-layout) twin over the same data
+        dev_t = np.asarray(digest.bin_counts_device_t(
+            jnp.asarray(np.ascontiguousarray(binned.T)), nb))
+        assert np.array_equal(host, dev_t), name
+        # per-feature unbundling is a partition of the rows
+        feats = digest.per_feature_counts(
+            ds._inner.groups, ds._inner.bin_mappers,
+            ds._inner.num_data, host)
+        for f, counts in feats.items():
+            assert counts.sum() == ds._inner.num_data, (name, f)
+            assert (counts >= 0).all(), (name, f)
+
+
+def test_snapshot_device_pad_correction(rng):
+    b = rng.randint(0, 9, size=(6, 40)).astype(np.uint8)   # (G, n) layout
+    padded = np.concatenate([b, np.zeros((6, 24), np.uint8)], axis=1)
+    snap = digest.snapshot_device(jnp.asarray(padded), 9,
+                                  transposed=True, pad_cols=24)
+    host = digest.bin_counts_host(b.T, 9)
+    assert np.array_equal(snap["group_counts"], host)
+
+
+def test_margin_hist_device_matches_oracle(rng):
+    raw1 = (rng.normal(size=500) * 10 ** rng.uniform(-8, 8, size=500)) \
+        .astype(np.float32)
+    raw1[:7] = 0.0
+    h = digest.margin_hist_host(raw1)
+    d = np.asarray(digest._margin_hist_dev(jnp.asarray(raw1)))
+    assert np.array_equal(h, d)
+    assert h.sum() == 500 and h[0] >= 7
+    # multiclass margins (top1 - top2)
+    rawk = rng.normal(size=(200, 4)).astype(np.float32)
+    hk = digest.margin_hist_host(rawk)
+    dk = np.asarray(digest._margin_hist_dev(jnp.asarray(rawk)))
+    assert np.array_equal(hk, dk)
+    assert hk.sum() == 200
+
+
+# ---------------------------------------------------------------------------
+# the reference profile
+# ---------------------------------------------------------------------------
+def test_reference_profile_rates_and_cardinality(rng):
+    n = 800
+    X = rng.normal(size=(n, 3))
+    X[rng.rand(n) < 0.25, 0] = np.nan
+    X[rng.rand(n) < 0.4, 1] = 0.0
+    X[:, 2] = rng.randint(0, 5, size=n)
+    y = rng.normal(size=n)
+    health.get().set_mode("counters")
+    ds = lgb.Dataset(X, label=y,
+                     params={**BASE, "health": "counters",
+                             "categorical_feature": [2]})
+    ds.construct({**BASE, "health": "counters",
+                  "categorical_feature": [2]})
+    prof = ds._inner.reference_profile()
+    assert prof["num_data"] == n
+    by_idx = {fe["index"]: fe for fe in prof["features"]}
+    nan_rate = float(np.isnan(X[:, 0]).mean())
+    assert abs(by_idx[0]["missing_rate"] - nan_rate) < 1e-6
+    zero_rate = float((X[:, 1] == 0.0).mean())
+    assert abs(by_idx[1]["zero_rate"] - zero_rate) < 0.02
+    assert by_idx[2]["cardinality"] == 5
+    # counts are a partition of the rows
+    for fe in prof["features"]:
+        assert sum(fe["counts"]) == n
+
+
+def test_reference_profile_device_path_matches_host(rng):
+    """construct_device=on + free_host_binned leaves only the (G, N_pad)
+    ingest buffer: the profile then comes from the DEVICE digest (one
+    fused reduction + one sync, pad-corrected) and must equal the host
+    oracle's profile bit-for-bit."""
+    X = rng.normal(size=(700, 6))
+    X[rng.rand(700) < 0.2, 3] = 0.0
+    y = X[:, 0] + 0.1 * rng.normal(size=700)
+    health.get().set_mode("counters")
+    p_off = {**BASE, "health": "counters", "construct_device": "off"}
+    p_on = {**BASE, "health": "counters", "construct_device": "on",
+            "free_host_binned": True}
+    ds_off = lgb.Dataset(X, label=y, params=p_off)
+    ds_off.construct(p_off)
+    ds_on = lgb.Dataset(X, label=y, params=p_on)
+    ds_on.construct(p_on)
+    prof_off = ds_off._inner.reference_profile()
+    prof_on = ds_on._inner.reference_profile()
+    if ds_on._inner.device_ingest is None:
+        pytest.skip("device ingest unavailable on this backend")
+    assert prof_on == prof_off
+
+
+def test_profile_survives_model_string_and_pickle(rng):
+    X = rng.normal(size=(400, 4))
+    y = X[:, 0] + rng.normal(size=400) * 0.1
+    bst = lgb.train({**BASE, "health": "counters"},
+                    lgb.Dataset(X, label=y), num_boost_round=3)
+    assert bst._gbdt.health_profile is not None
+    s = bst.model_to_string()
+    assert "health_profile:" in s
+    b2 = lgb.Booster(model_str=s)
+    assert b2._gbdt.health_profile == bst._gbdt.health_profile
+    b3 = pickle.loads(pickle.dumps(bst))
+    assert b3._gbdt.health_profile == bst._gbdt.health_profile
+    # the loaded model still predicts (profile line must not corrupt
+    # the tree parser)
+    p = b2.predict(X[:50], raw_score=True)
+    assert np.isfinite(np.asarray(p)).all()
+
+
+def test_health_off_is_a_noop(rng):
+    X = rng.normal(size=(300, 4))
+    y = X[:, 0]
+    bst = lgb.train(BASE, lgb.Dataset(X, label=y), num_boost_round=2)
+    assert bst._gbdt.flight is None
+    assert bst._gbdt.health_profile is None
+    rep = bst.health_report()
+    assert rep["mode"] == "off"
+    assert rep["flight_recorder"] is None
+    assert rep["serving_skew"] is None
+
+
+# ---------------------------------------------------------------------------
+# training flight recorder
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("fused", [True, False])
+def test_flight_recorder_records_every_tree(rng, fused):
+    X = rng.normal(size=(500, 5))
+    y = 3.0 * X[:, 2] + rng.normal(size=500) * 0.1
+    bst = lgb.train({**BASE, "health": "counters",
+                     "tpu_fused_iteration": fused},
+                    lgb.Dataset(X, label=y), num_boost_round=6)
+    rep = bst.health_report()["flight_recorder"]
+    assert rep["trees_recorded"] == 6
+    # the informative feature dominates the cumulative gain totals
+    assert rep["top_features"][0]["feature"] == 2
+    last = rep["last_tree"]
+    assert last["leaves"] >= 2 and "top_splits" in last
+    assert last["top_splits"][0]["gain"] > 0
+    assert last["leaf_l2"] > 0 and last["leaf_cnt_max"] >= 5
+    assert last["effective_rows"] == 500
+    assert len(rep["gain_trajectory"]) == 6
+
+
+def test_flight_recorder_effective_rows_sampling(rng):
+    X = rng.normal(size=(1000, 4))
+    y = X[:, 0] + rng.normal(size=1000) * 0.1
+    goss = lgb.train({**BASE, "health": "counters", "boosting": "goss",
+                      "top_rate": 0.2, "other_rate": 0.1},
+                     lgb.Dataset(X, label=y), num_boost_round=2)
+    assert goss.health_report()["flight_recorder"][
+        "effective_rows_last"] == 300
+    bag = lgb.train({**BASE, "health": "counters",
+                     "bagging_fraction": 0.5, "bagging_freq": 1},
+                    lgb.Dataset(X, label=y), num_boost_round=2)
+    assert bag.health_report()["flight_recorder"][
+        "effective_rows_last"] == 500
+
+
+def test_flight_recorder_trace_marks_ride_the_telemetry_ring(rng):
+    obs_tel.get().reset(mode="off")
+    X = rng.normal(size=(300, 4))
+    y = X[:, 0]
+    lgb.train({**BASE, "health": "trace"}, lgb.Dataset(X, label=y),
+              num_boost_round=3).health_report()
+    # health=trace upgraded the telemetry session; tree marks recorded
+    assert obs_tel.get().mode == "trace"
+    names = {e.get("name") for e in obs_tel.get().snapshot_events()}
+    assert "health.tree" in names
+
+
+# ---------------------------------------------------------------------------
+# serving-side skew digests
+# ---------------------------------------------------------------------------
+def test_serving_skew_ranks_shifted_feature(rng):
+    n = 5000
+    X = rng.normal(size=(n, 6))
+    y = X[:, 0] * 2 + np.sin(X[:, 1])
+    bst = lgb.train({**BASE, "health": "counters"},
+                    lgb.Dataset(X, label=y), num_boost_round=4)
+    bst.predict(X, raw_score=True)          # warm + self-digest
+    mon = bst._gbdt.serving._skew
+    assert mon not in (None, False)
+    rep = mon.report()
+    assert rep["rows_seen"] == n
+    # beyond OBSERVE_CAP the digest stride-samples (the hot-path cost
+    # cap); the sampled count is what the distributions are over
+    assert 1024 <= rep["rows_total"] <= n
+    assert rep["top"][0]["psi"] < 0.05      # same distribution: no skew
+    assert sum(rep["margin_hist"]) == rep["rows_total"]
+    Xs = X.copy()
+    Xs[:, 3] += 3.0
+    bst.predict(Xs, raw_score=True)
+    rep2 = mon.report()
+    assert rep2["top"][0]["feature"] == 3
+    assert rep2["top"][0]["psi"] > 0.5
+    assert rep2["alerts"] >= 1              # threshold crossing fired
+    assert obs_tel.get().counters if obs_tel.enabled() else True
+
+
+def test_serving_skew_off_means_no_monitor(rng):
+    X = rng.normal(size=(5000, 4))
+    y = X[:, 0]
+    bst = lgb.train(BASE, lgb.Dataset(X, label=y), num_boost_round=2)
+    bst.predict(X, raw_score=True)
+    assert bst._gbdt.serving._skew is None
+
+
+# ---------------------------------------------------------------------------
+# drift attribution: the acceptance drill
+# ---------------------------------------------------------------------------
+def test_attribution_drill_ranks_planted_feature_first():
+    from lightgbm_tpu.continual import run_drift_drill
+    rep = run_drift_drill("attribution", rows=192, drift_at=4,
+                          post_ticks=6, seed=11)
+    assert rep["detect_tick"] is not None
+    assert rep["detected_within_window"]
+    assert rep["planted_rank"] == 1, rep["skew_top"]
+    # clear separation, not a photo finish
+    assert rep["skew_top"][0]["psi"] > 3 * rep["skew_top"][1]["psi"]
+
+
+def test_tick_reports_carry_skew_attribution(rng):
+    from lightgbm_tpu.continual.drift import (DriftSpec, DriftStream,
+                                              _DRILL_PARAMS)
+    from lightgbm_tpu.continual.runtime import ContinualBooster
+    planted = int(np.argmax(np.abs(
+        np.random.RandomState(5).normal(size=5))))   # the stream's coef
+    spec = DriftSpec(covariate_shift_at=3, covariate_shift_feature=planted,
+                     covariate_shift=3.0)
+    stream = DriftStream(num_features=5, rows=192, seed=5, spec=spec)
+    X0, y0 = DriftStream(num_features=5, rows=768, seed=6).batch(0)
+    p = dict(_DRILL_PARAMS)
+    p["health"] = "counters"
+    cb = ContinualBooster(p, X0, y0)
+    top = None
+    for t in range(10):
+        r = cb.tick(*stream.batch(t))
+        if r.drift_detected:
+            top = r.skew_top
+            break
+    assert top, "regression tick never carried an attribution"
+    assert top[0]["feature"] == planted
+
+
+# ---------------------------------------------------------------------------
+# telemetry span-stack hygiene (satellite)
+# ---------------------------------------------------------------------------
+def test_span_stack_unwinds_when_wrapped_op_raises():
+    sess = obs_tel.get()
+    sess.reset(mode="counters")
+    with pytest.raises(ValueError):
+        with obs_tel.span("outer"):
+            with obs_tel.span("inner"):
+                raise ValueError("boom")
+    assert sess.current_span() is None
+    # nested partial failure: outer survives an inner raise
+    with obs_tel.span("outer2"):
+        with pytest.raises(RuntimeError):
+            with obs_tel.span("inner2"):
+                raise RuntimeError("boom")
+        assert sess.current_span() == "outer2"
+    assert sess.current_span() is None
+    # both spans still recorded their histograms despite the raise
+    rep = sess.report()
+    for name in ("outer", "inner", "outer2", "inner2"):
+        assert rep["spans"][name]["count"] == 1

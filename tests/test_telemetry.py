@@ -302,3 +302,169 @@ def test_continual_tick_spans_and_zero_steady_state_compiles():
     # counter now shows what the jaxlint continual.tick budget pins
     assert not any(k.startswith("serving.") for k in rep["compiles"]), \
         rep["compiles"]
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition-format conformance (ISSUE-9 satellite): the
+# exported text must survive a STRICT parser of the text format —
+# metric/label name grammar, escaping, TYPE declaration rules, summary
+# family suffix ownership, duplicate-sample detection
+# ---------------------------------------------------------------------------
+import re as _re
+
+_METRIC_NAME = _re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_NAME = _re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+_TYPES = {"counter", "gauge", "histogram", "summary", "untyped"}
+
+
+def _parse_label_block(s, errors, lineno):
+    """Parse `name="value",...` with the format's three escapes; returns
+    (labels dict) and flags bad names/escapes/structure."""
+    labels = {}
+    i = 0
+    while i < len(s):
+        m = _re.match(r"([^=,{}\s]+)=", s[i:])
+        if not m:
+            errors.append(f"line {lineno}: bad label syntax at {s[i:]!r}")
+            return labels
+        lname = m.group(1)
+        if not _LABEL_NAME.match(lname):
+            errors.append(f"line {lineno}: bad label name {lname!r}")
+        i += m.end()
+        if i >= len(s) or s[i] != '"':
+            errors.append(f"line {lineno}: label value not quoted")
+            return labels
+        i += 1
+        val = []
+        while i < len(s):
+            c = s[i]
+            if c == "\\":
+                if i + 1 >= len(s) or s[i + 1] not in ('\\', '"', 'n'):
+                    errors.append(f"line {lineno}: bad escape in label")
+                i += 2
+                continue
+            if c == '"':
+                break
+            if c == "\n":
+                errors.append(f"line {lineno}: raw newline in label")
+            val.append(c)
+            i += 1
+        labels[lname] = "".join(val)
+        i += 1                                     # closing quote
+        if i < len(s):
+            if s[i] != ",":
+                errors.append(f"line {lineno}: expected ',' in labels")
+                return labels
+            i += 1
+    return labels
+
+
+def parse_exposition(text):
+    """Strict text-exposition parser; returns (samples, types, errors).
+    Enforces: name grammar, one TYPE per family declared before its
+    samples, samples grouped per family, summary/histogram suffix
+    ownership (X_sum/X_count/X_bucket belong to family X and must not
+    be declared as their own family), float-parseable values, and no
+    duplicate (name, labelset) sample."""
+    samples, types, errors = [], {}, []
+    seen_families = set()
+    seen_samples = set()
+    for lineno, line in enumerate(text.split("\n"), 1):
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 2 and parts[1] == "TYPE":
+                if len(parts) < 4:
+                    errors.append(f"line {lineno}: malformed TYPE")
+                    continue
+                fam, typ = parts[2], parts[3].strip()
+                if not _METRIC_NAME.match(fam):
+                    errors.append(f"line {lineno}: bad family {fam!r}")
+                if typ not in _TYPES:
+                    errors.append(f"line {lineno}: bad type {typ!r}")
+                if fam in types:
+                    errors.append(f"line {lineno}: duplicate TYPE {fam}")
+                if fam in seen_families:
+                    errors.append(
+                        f"line {lineno}: TYPE {fam} after its samples")
+                types[fam] = typ
+            continue
+        m = _re.match(r"^([^\s{]+)(\{(.*)\})?\s+(\S+)(\s+\S+)?$", line)
+        if not m:
+            errors.append(f"line {lineno}: unparseable sample {line!r}")
+            continue
+        name, _, lbl, value, _ts = m.groups()
+        if not _METRIC_NAME.match(name):
+            errors.append(f"line {lineno}: bad metric name {name!r}")
+        labels = _parse_label_block(lbl, errors, lineno) if lbl else {}
+        try:
+            float(value)
+        except ValueError:
+            errors.append(f"line {lineno}: bad value {value!r}")
+        # resolve the family: summary/histogram suffixes fold in
+        fam = name
+        for suffix in ("_sum", "_count", "_bucket"):
+            base = name[:-len(suffix)] if name.endswith(suffix) else None
+            if base and types.get(base) in ("summary", "histogram"):
+                fam = base
+                break
+        if fam != name and name in types:
+            errors.append(f"{name} declared as its own family AND owned "
+                          f"by the {fam} {types[fam]}")
+        if fam in types and types[fam] == "summary" and fam == name \
+                and "quantile" not in labels:
+            errors.append(f"line {lineno}: summary sample {name} "
+                          "without quantile label")
+        seen_families.add(fam)
+        key = (name, tuple(sorted(labels.items())))
+        if key in seen_samples:
+            errors.append(f"line {lineno}: duplicate sample {key}")
+        seen_samples.add(key)
+        samples.append((name, labels, value))
+    return samples, types, errors
+
+
+def test_prometheus_text_round_trips_a_strict_parser():
+    sess = obs.get()
+    sess.reset(mode="trace")
+    # populate every family, including awkward label values the
+    # escaping must survive
+    with obs.span("train.iteration"):
+        pass
+    with obs.span('serve.raw@1024 "quoted"\\back\nline'):
+        pass
+    obs.counter("health.skew.alerts", 3)
+    obs.gauge("memory.dataset.binned", 12345.5)
+    sess.compile_event("serving.raw@1024")
+    text = obs.prometheus_text(sess)
+    samples, types, errors = parse_exposition(text)
+    assert not errors, "\n".join(errors)
+    names = {s[0] for s in samples}
+    assert "lightgbm_tpu_span_count" in names
+    assert "lightgbm_tpu_span_seconds_sum" in names
+    assert "lightgbm_tpu_span_seconds_count" in names
+    assert "lightgbm_tpu_counter_total" in names
+    assert "lightgbm_tpu_compiles_total" in names
+    assert "lightgbm_tpu_gauge" in names
+    # the summary family owns its _sum/_count (no separate TYPE)
+    assert types["lightgbm_tpu_span_seconds"] == "summary"
+    assert "lightgbm_tpu_span_seconds_sum" not in types
+    # every non-comment line of the export parsed as exactly one sample
+    n_lines = sum(1 for ln in text.strip().split("\n")
+                  if ln and not ln.startswith("#"))
+    assert len(samples) == n_lines
+
+
+def test_prometheus_parser_rejects_the_old_nonconforming_shape():
+    """The parser itself must have teeth: the pre-fix export shape
+    (summary's _sum declared as its own counter family; raw newline in
+    a label) must fail it."""
+    bad = ('# TYPE x_seconds_sum counter\n'
+           '# TYPE x_seconds summary\n'
+           'x_seconds_sum{name="a"} 1.0\n')
+    _, _, errors = parse_exposition(bad)
+    assert any("own family" in e for e in errors)
+    bad2 = 'm{name="a\nb"} 1\n'
+    _, _, errors2 = parse_exposition(bad2)
+    assert errors2

@@ -61,11 +61,20 @@ def test_ab_bench_drift_lane(tmp_path):
         f"rollback fired after {rec['rollback_delay_ticks']} ticks"
     assert rec["post_rollback_parity"] is True
     assert rec["swap_latency_s"] > 0
+    # ISSUE-9: the health lane rode along — the planted single-feature
+    # covariate shift must be attributed #1
+    assert rec["health"]["planted_rank"] == 1, rec["health"]
+    assert rec["health"]["skew_top"][0]["feature"] == \
+        rec["health"]["planted_feature"]
     # ISSUE-8 satellite: the machine-readable perf artifact rides along
+    # (schema v2 since ISSUE-9: the health section is part of it)
     with open(obs_path) as fh:
         art = json.load(fh)
-    assert art["schema"] == "lightgbm-tpu/bench-obs/v1"
+    assert art["schema"] == "lightgbm-tpu/bench-obs/v2"
     assert art["tool"] == "ab_bench.drift"
     assert art["timings"]["rollback_ok"] is True
+    assert art["health"]["planted_rank"] == 1
     assert any(k.startswith("serving.") for k in art["compile_counts"])
     assert art["memory_peaks"]["owners"]
+    from lightgbm_tpu.obs import benchio
+    assert benchio.validate_bench_obs(art) == []

@@ -1,0 +1,66 @@
+"""Tier-1 lane for tools/health_report.py (ISSUE-9): the --smoke
+self-check must validate Booster.health_report() end to end (flight
+recorder, reference profile, serving skew digests, model-string
+persistence) AND the covariate-shift attribution drill (planted
+feature ranked #1), exiting 0; the model-summary path must print the
+embedded profile of a saved model and fail loudly on one saved without
+health."""
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(HERE, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_health_report_smoke(capsys):
+    tool = _load_tool("health_report")
+    rc = tool.main(["--smoke", "--rows", "160"])
+    payload = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0, payload
+    assert payload["ok"] is True and payload["problems"] == []
+    assert payload["trees_recorded"] == 8
+    assert payload["planted_rank"] == 1
+    assert payload["serving_rows"] >= 4608
+
+
+def test_health_report_model_summary(tmp_path, capsys):
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.obs import health
+
+    tool = _load_tool("health_report")
+    prev = health.get().mode
+    try:
+        rng = np.random.RandomState(2)
+        X = rng.normal(size=(400, 3))
+        y = X[:, 0] + 0.1 * rng.normal(size=400)
+        with_prof = str(tmp_path / "with.txt")
+        lgb.train({"objective": "regression", "verbosity": -1,
+                   "num_leaves": 7, "metric": "", "health": "counters"},
+                  lgb.Dataset(X, label=y), num_boost_round=2) \
+            .save_model(with_prof)
+        rc = tool.main([with_prof])
+        doc = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert rc == 0
+        assert doc["num_features"] == 3 and doc["num_data"] == 400
+
+        health.get().set_mode("off")
+        without = str(tmp_path / "without.txt")
+        bst = lgb.Booster(model_file=with_prof)
+        bst._gbdt.health_profile = None
+        bst.save_model(without)
+        rc = tool.main([without])
+        doc = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert rc != 0 and doc["health_profile"] is None
+    finally:
+        health.get().set_mode(prev)

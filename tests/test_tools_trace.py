@@ -71,3 +71,53 @@ def test_trace_report_reads_both_export_formats(tmp_path, capsys):
     rc = tool.main([str(bad)])
     capsys.readouterr()
     assert rc != 0
+
+
+def test_trace_report_merge_distinct_pids(tmp_path, capsys):
+    """`merge` combines per-rank exports into one Chrome trace with a
+    distinct pid (and a process_name row) per input file."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu import obs
+
+    tool = _load_tool("trace_report")
+    sess = obs.get()
+    rank_files = []
+    try:
+        for rank in range(3):
+            sess.reset(mode="trace")
+            rng = np.random.RandomState(rank)
+            X = rng.normal(size=(400, 4))
+            y = X[:, 0] + 0.1 * rng.normal(size=400)
+            lgb.train({"objective": "regression", "verbosity": -1,
+                       "num_leaves": 7, "metric": ""},
+                      lgb.Dataset(X, label=y), num_boost_round=2)
+            # mix the two export formats like a mixed-rank run would
+            if rank % 2:
+                p = str(tmp_path / f"rank{rank}.jsonl")
+                obs.export_jsonl(sess, p)
+            else:
+                p = str(tmp_path / f"rank{rank}.json")
+                obs.export_chrome_trace(sess, p)
+            rank_files.append(p)
+    finally:
+        sess.reset(mode="off")
+
+    out_path = str(tmp_path / "merged.json")
+    rc = tool.main(["merge", "-o", out_path] + rank_files)
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0, summary
+    assert summary["problems"] == []
+    assert summary["pids"] == [1, 2, 3]
+    # every rank's spans merged: 3 ranks x 2 iterations
+    assert summary["spans"]["train.iteration"]["count"] == 6
+
+    with open(out_path) as fh:
+        doc = json.load(fh)
+    names = [(e.get("pid"), e["args"]["name"])
+             for e in doc["traceEvents"]
+             if e.get("ph") == "M" and e.get("name") == "process_name"]
+    assert len(names) == 3 and len({p for p, _ in names}) == 3
+    # the merged artifact itself validates through the normal path
+    rc = tool.main([out_path])
+    capsys.readouterr()
+    assert rc == 0

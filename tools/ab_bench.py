@@ -50,13 +50,14 @@ def _parse_overrides(items):
     return out
 
 
-def _write_obs(args, tool, config, timings):
+def _write_obs(args, tool, config, timings, health=None):
     """Drop the machine-readable BENCH_obs.json artifact (ISSUE-8
-    satellite): config + timings + the telemetry session's compile
-    counts + memory peaks, so perf rounds have diffable artifacts, not
-    just PERF.md prose."""
+    satellite; schema v2 since ISSUE-9 adds the ``health`` section):
+    config + timings + the telemetry session's compile counts + memory
+    peaks, so perf rounds have diffable artifacts, not just PERF.md
+    prose."""
     from lightgbm_tpu.obs import benchio
-    path = benchio.write_bench_obs(tool, config, timings,
+    path = benchio.write_bench_obs(tool, config, timings, health=health,
                                    path=args.obs_out)
     print(f"wrote {path}", file=sys.stderr)
 
@@ -148,11 +149,15 @@ def _drift_smoke(args):
     of a forced post-swap regression AND that the restored model serves
     bit-identically to the last-good pack; plus the full swap drill
     (detection within the window, kill-mid-retrain resumed from
-    checkpoint, at most one compile per (kind, bucket) per swap)."""
+    checkpoint, at most one compile per (kind, bucket) per swap); plus
+    the ISSUE-9 health lane — the single-feature covariate-shift drill
+    whose skew attribution must rank the planted feature #1, recorded
+    in the BENCH_obs.json v2 ``health`` section and asserted here."""
     import shutil
     import tempfile
 
     from lightgbm_tpu.continual import run_drift_drill
+    from lightgbm_tpu.obs import benchio
 
     work = tempfile.mkdtemp(prefix="ab-drift-")
     try:
@@ -160,8 +165,16 @@ def _drift_smoke(args):
                                post_ticks=5, checkpoint_dir=work)
         roll = run_drift_drill("rollback", rows=args.drift_rows,
                                drift_at=3, post_ticks=5)
+        attr = run_drift_drill("attribution", rows=args.drift_rows,
+                               drift_at=4, post_ticks=6)
         rollback_delay = (None if roll.get("rollback_tick") is None else
                           roll["rollback_tick"] - roll["swap_tick"])
+        health = {
+            "planted_feature": attr.get("planted_feature"),
+            "planted_rank": attr.get("planted_rank"),
+            "skew_top": attr.get("skew_top"),
+            "attribution_detect_tick": attr.get("detect_tick"),
+        }
         report = {
             "drift_mode": True, "rows_per_tick": args.drift_rows,
             "detect_tick": swap.get("detect_tick"),
@@ -178,12 +191,13 @@ def _drift_smoke(args):
             "rollback_ok": (rollback_delay is not None
                             and rollback_delay <= args.rollback_within),
             "post_rollback_parity": roll.get("pre_post_identical"),
+            "health": health,
         }
         print(json.dumps(report))
         _write_obs(args, "ab_bench.drift",
                    {"rows_per_tick": args.drift_rows,
                     "rollback_within": args.rollback_within},
-                   report)
+                   report, health=health)
         problems = []
         if not report["detected_within_window"]:
             problems.append("regression not detected within the window")
@@ -199,6 +213,20 @@ def _drift_smoke(args):
         if not report["post_rollback_parity"]:
             problems.append("post-rollback serving is not bit-identical "
                             "to the last-good pack")
+        if health["planted_rank"] != 1:
+            problems.append(
+                "skew attribution ranked the planted feature "
+                f"#{health['planted_rank']} (feature "
+                f"{health['planted_feature']}), not #1")
+        # the artifact this lane just wrote must satisfy schema v2
+        obs_path = args.obs_out or benchio.default_path()
+        try:
+            with open(obs_path) as fh:
+                doc = json.load(fh)
+            problems += [f"BENCH_obs: {p}"
+                         for p in benchio.validate_bench_obs(doc)]
+        except (OSError, ValueError) as exc:
+            problems.append(f"BENCH_obs unreadable: {exc}")
         if problems:
             raise SystemExit("--drift: " + "; ".join(problems))
     finally:

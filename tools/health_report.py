@@ -1,0 +1,212 @@
+"""Model & data health report tool.
+
+Three modes:
+
+    python tools/health_report.py model.txt     # saved model: print its
+                                                # embedded reference
+                                                # profile summary
+    python tools/health_report.py --smoke       # tier-1 self-check
+    python tools/health_report.py --overhead    # paired off-vs-counters
+                                                # digest overhead measure
+
+``--smoke`` trains a small model at ``health=trace``, drives the
+serving path, and validates ``Booster.health_report()`` end to end
+(flight recorder populated with per-iteration split decisions, the
+reference profile present and model-persisted, serving skew digests
+accumulating), then runs the single-feature covariate-shift drill and
+asserts the skew attribution ranks the planted feature #1 — one JSON
+line, non-zero exit on any broken invariant.
+
+``--overhead`` measures what the health layer costs where it is hot:
+interleaved full trainings + warm predicts with health off vs counters
+(paired per-position deltas cancel slow host drift, the PERF.md
+measurement discipline) — the honest number the ≤2% budget is judged
+against.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def _train_small(params, rows=4608, features=8, rounds=8, seed=3):
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(rows, features))
+    y = X[:, 0] * 2.0 + np.sin(X[:, 1]) - X[:, 3] \
+        + 0.1 * rng.normal(size=rows)
+    base = {"objective": "regression", "verbosity": -1, "num_leaves": 15,
+            "min_data_in_leaf": 10, "metric": ""}
+    base.update(params)
+    bst = lgb.train(base, lgb.Dataset(X, label=y),
+                    num_boost_round=rounds)
+    return bst, X
+
+
+# ---------------------------------------------------------------------------
+def smoke(rows: int) -> int:
+    from lightgbm_tpu import obs
+    from lightgbm_tpu.continual import run_drift_drill
+    from lightgbm_tpu.obs import health as obs_health
+
+    problems: List[str] = []
+    health_prev = obs_health.get().mode
+    tel_prev = obs.get().mode
+    try:
+        bst, X = _train_small({"health": "trace"})
+        bst.predict(X, raw_score=True)        # warms + digests serving
+        bst.predict(X[:700], raw_score=True)  # a second bucket
+        rep = bst.health_report()
+        fr = rep.get("flight_recorder") or {}
+        if fr.get("trees_recorded", 0) < 8:
+            problems.append(f"flight recorder has "
+                            f"{fr.get('trees_recorded')} trees, want 8")
+        if not fr.get("top_features"):
+            problems.append("flight recorder has no per-feature totals")
+        if not fr.get("gain_trajectory"):
+            problems.append("flight recorder has no gain trajectory")
+        prof = rep.get("reference_profile")
+        if not prof or prof.get("num_features") != X.shape[1]:
+            problems.append(f"reference profile malformed: {prof!r}")
+        skew = rep.get("serving_skew")
+        if not skew or skew.get("rows_seen", 0) < len(X):
+            problems.append(f"serving skew digests missing rows: "
+                            f"{skew and skew.get('rows_seen')}")
+        if skew and sum(skew.get("margin_hist", [])) <= 0:
+            problems.append("prediction-margin histogram is empty")
+        # the profile must survive the model file round trip
+        import lightgbm_tpu as lgb
+        bst2 = lgb.Booster(model_str=bst.model_to_string())
+        if bst2._gbdt.health_profile is None:
+            problems.append("reference profile lost in the model string")
+
+        # covariate-shift attribution drill: planted feature must rank #1
+        drill = run_drift_drill("attribution", rows=rows, drift_at=4,
+                                post_ticks=6)
+        if not drill.get("planted_ranked_first"):
+            problems.append(
+                f"attribution ranked the planted feature "
+                f"#{drill.get('planted_rank')} "
+                f"(top: {(drill.get('skew_top') or [None])[0]})")
+        print(json.dumps({
+            "metric": "health_report_smoke", "ok": not problems,
+            "trees_recorded": fr.get("trees_recorded"),
+            "top_features": fr.get("top_features"),
+            "serving_rows": skew and skew.get("rows_seen"),
+            "planted_feature": drill.get("planted_feature"),
+            "planted_rank": drill.get("planted_rank"),
+            "skew_top": (drill.get("skew_top") or [])[:3],
+            "problems": problems}))
+        return 1 if problems else 0
+    finally:
+        obs_health.get().set_mode(health_prev)
+        obs.get().set_mode(tel_prev)
+
+
+# ---------------------------------------------------------------------------
+def overhead(reps: int, rows: int) -> int:
+    """Paired health=off vs health=counters cost of (a) a full small
+    training and (b) a warm bucketed predict — the two paths the layer
+    instruments."""
+    from lightgbm_tpu.obs import health as obs_health
+
+    health_prev = obs_health.get().mode
+    times: Dict[str, Dict[str, List[float]]] = {
+        "train": {"off": [], "counters": []},
+        "predict": {"off": [], "counters": []}}
+    try:
+        # warm compiles once per mode arm
+        for mode in ("off", "counters"):
+            obs_health.get().set_mode("off")
+            _train_small({"health": mode}, rows=rows)
+        for _ in range(reps):
+            for mode in ("off", "counters"):
+                obs_health.get().set_mode("off")
+                t0 = time.perf_counter()
+                bst, X = _train_small({"health": mode}, rows=rows)
+                times["train"][mode].append(time.perf_counter() - t0)
+                bst.predict(X, raw_score=True)      # warm the engine
+                t0 = time.perf_counter()
+                for _ in range(5):
+                    bst.predict(X, raw_score=True)
+                times["predict"][mode].append(time.perf_counter() - t0)
+    finally:
+        obs_health.get().set_mode(health_prev)
+
+    report: Dict[str, Any] = {"metric": "health_overhead", "rows": rows,
+                              "reps": reps}
+    for phase, arms in times.items():
+        off = np.asarray(arms["off"])
+        on = np.asarray(arms["counters"])
+        paired = on - off
+        med_off = float(np.median(off))
+        report[phase] = {
+            "off_s": round(med_off, 4),
+            "counters_s": round(float(np.median(on)), 4),
+            "paired_delta_s": round(float(np.median(paired)), 4),
+            "paired_delta_pct": round(
+                100.0 * float(np.median(paired)) / med_off, 2),
+            "mad_s": round(float(np.median(
+                np.abs(paired - np.median(paired)))), 4),
+        }
+    print(json.dumps(report))
+    return 0
+
+
+# ---------------------------------------------------------------------------
+def model_summary(path: str) -> int:
+    import lightgbm_tpu as lgb
+    bst = lgb.Booster(model_file=path)
+    prof = bst._gbdt.health_profile
+    if prof is None:
+        print(json.dumps({"path": path, "health_profile": None,
+                          "hint": "model was saved without health "
+                                  "enabled (health=counters|trace)"}))
+        return 1
+    feats = prof.get("features", [])
+    out = {
+        "path": path,
+        "num_data": prof.get("num_data"),
+        "num_features": len(feats),
+        "features": [{k: fe.get(k) for k in
+                      ("index", "name", "num_bin", "missing_rate",
+                       "zero_rate", "cardinality")} for fe in feats],
+    }
+    print(json.dumps(out))
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("model", nargs="?",
+                    help="saved model file: print its embedded health "
+                         "profile")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tier-1 self-check (see module docstring)")
+    ap.add_argument("--rows", type=int, default=192,
+                    help="--smoke: rows per drill tick")
+    ap.add_argument("--overhead", action="store_true",
+                    help="paired health=off vs counters cost measurement")
+    ap.add_argument("--reps", type=int, default=3,
+                    help="--overhead: paired repetitions")
+    ap.add_argument("--overhead-rows", type=int, default=20000,
+                    help="--overhead: training rows")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        return smoke(args.rows)
+    if args.overhead:
+        return overhead(args.reps, args.overhead_rows)
+    if not args.model:
+        ap.error("give a model file, --smoke or --overhead")
+    return model_summary(args.model)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,13 +9,23 @@ the artifact is malformed).
 
     python tools/trace_report.py out/trace.json
     python tools/trace_report.py out/telemetry.jsonl
+    python tools/trace_report.py merge -o merged.json r0.jsonl r1.jsonl
     python tools/trace_report.py --smoke      # tier-1 self-check
 
-``--smoke`` runs the continual drift drills (swap + rollback) with the
-session at ``telemetry=trace``, exports the Chrome trace, validates it,
-and asserts the spans an operator needs are all present —
-``continual.tick`` / ``continual.retrain`` / ``continual.swap`` /
-``continual.rollback`` — plus at least one runtime compile event.
+``merge`` combines multiple per-rank/per-process exports (either
+format) into ONE Chrome trace with a distinct pid per input file —
+multi-process mesh runs write one telemetry file per rank, and
+Perfetto shows them as separate process tracks only when their pids
+differ (they usually don't: every rank reports its own os.getpid).
+
+``--smoke`` runs the continual drift drills (swap + rollback, with
+``health=counters`` so drift-attribution marks ride the trace) at
+``telemetry=trace``, exports the Chrome trace, validates it, asserts
+the spans an operator needs are all present — ``continual.tick`` /
+``continual.retrain`` / ``continual.swap`` / ``continual.rollback`` —
+plus at least one runtime compile event and the ``health.drift``
+attribution mark, and validates a BENCH_obs.json v2 artifact
+round-trip (schema + health section).
 """
 
 import argparse
@@ -83,6 +93,7 @@ def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     spans: Dict[str, Dict[str, Any]] = {}
     compiles: Dict[str, int] = {}
     counters: Dict[str, float] = {}
+    marks: Dict[str, int] = {}
     for ev in events:
         ph = ev.get("ph")
         name = ev.get("name", "?")
@@ -93,6 +104,10 @@ def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         elif ph in ("i", "I") and name.startswith("compile:"):
             key = name[len("compile:"):]
             compiles[key] = compiles.get(key, 0) + 1
+        elif ph in ("i", "I"):
+            # non-compile instant marks (e.g. the health layer's
+            # flight-recorder / skew / drift-attribution events)
+            marks[name] = marks.get(name, 0) + 1
         elif ph == "C":
             args = ev.get("args") or {}
             counters[name] = args.get("value", args)
@@ -101,7 +116,71 @@ def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     return {"events": len(events),
             "spans": dict(sorted(spans.items())),
             "compiles": dict(sorted(compiles.items())),
-            "counters": dict(sorted(counters.items()))}
+            "counters": dict(sorted(counters.items())),
+            "marks": dict(sorted(marks.items()))}
+
+
+# ---------------------------------------------------------------------------
+# merge: per-rank exports -> one Chrome trace with distinct pids
+# ---------------------------------------------------------------------------
+def merge_traces(inputs: List[str], out_path: str) -> Dict[str, Any]:
+    """Combine per-rank/per-process telemetry exports (JSONL or Chrome
+    trace) into one Chrome trace.  Every rank reports its own
+    ``os.getpid()``, which collide across hosts and hide the per-rank
+    structure — each input file gets its OWN pid track (1-based input
+    order) plus a ``process_name`` metadata row naming the source
+    file, so Perfetto renders one labeled track per rank."""
+    merged: List[Dict[str, Any]] = []
+    for i, path in enumerate(inputs):
+        pid = i + 1
+        merged.append({"ph": "M", "name": "process_name", "pid": pid,
+                       "ts": 0,
+                       "args": {"name": f"rank{i}:"
+                                f" {os.path.basename(path)}"}})
+        for ev in load_events(path):
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                continue               # replaced by the per-file row
+            ev = dict(ev)
+            ev["pid"] = pid
+            merged.append(ev)
+    doc = {
+        "traceEvents": merged,
+        "displayTimeUnit": "ms",
+        "otherData": {"exporter": "tools/trace_report.py merge",
+                      "merged_from": [os.path.basename(p)
+                                      for p in inputs]},
+    }
+    tmp = out_path + f".tmp{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh)
+    os.replace(tmp, out_path)
+    return doc
+
+
+def merge_main(argv: List[str]) -> int:
+    ap = argparse.ArgumentParser(
+        prog="trace_report.py merge",
+        description="merge per-rank telemetry exports into one Chrome "
+                    "trace with distinct pids")
+    ap.add_argument("inputs", nargs="+",
+                    help="per-rank trace.json / telemetry.jsonl files")
+    ap.add_argument("-o", "--out", required=True,
+                    help="merged Chrome trace output path")
+    args = ap.parse_args(argv)
+    doc = merge_traces(args.inputs, args.out)
+    events = [e for e in doc["traceEvents"] if e.get("ph") != "M"]
+    problems = validate(events)
+    pids = sorted({e.get("pid") for e in events})
+    out = summarize(events)
+    out["problems"] = problems
+    out["path"] = args.out
+    out["pids"] = pids
+    out["inputs"] = len(args.inputs)
+    if len(pids) != len(args.inputs):
+        out["problems"].append(
+            f"expected {len(args.inputs)} distinct pids, got {len(pids)}")
+    print(json.dumps(out))
+    return 1 if out["problems"] else 0
 
 
 # ---------------------------------------------------------------------------
@@ -117,22 +196,36 @@ def smoke(rows: int) -> int:
 
     from lightgbm_tpu import obs
     from lightgbm_tpu.continual import run_drift_drill
+    from lightgbm_tpu.obs import benchio
+    from lightgbm_tpu.obs import health as obs_health
 
     sess = obs.get()
     sess.reset(mode="trace")
+    health_prev = obs_health.get().mode
+    obs_health.get().set_mode("counters")
     work = tempfile.mkdtemp(prefix="trace-report-")
     problems: List[str] = []
     try:
         # swap drill: tick + detection + (killed-once, resumed) retrain
-        # + gated swap spans; rollback drill adds the rollback span
+        # + gated swap spans; rollback drill adds the rollback span.
+        # health=counters rides along so the regression tick emits its
+        # drift-attribution mark onto the trace ring
         swap = run_drift_drill("swap", rows=rows, drift_at=4,
-                               post_ticks=5, checkpoint_dir=work)
+                               post_ticks=5, checkpoint_dir=work,
+                               params={"health": "counters"})
         roll = run_drift_drill("rollback", rows=rows, drift_at=3,
-                               post_ticks=5)
+                               post_ticks=5,
+                               params={"health": "counters"})
         if swap.get("swap_tick") is None:
             problems.append("swap drill produced no hot-swap")
         if roll.get("rollback_tick") is None:
             problems.append("rollback drill never rolled back")
+        detect = next((t for t in swap.get("ticks", [])
+                       if t.get("drift_detected")), None)
+        skew_top = (detect or {}).get("skew_top") or []
+        if not skew_top:
+            problems.append("swap drill's regression tick carried no "
+                            "skew attribution")
         obs.memory_snapshot()
         trace_path = os.path.join(work, "trace.json")
         obs.export_chrome_trace(sess, trace_path)
@@ -144,20 +237,44 @@ def smoke(rows: int) -> int:
                 problems.append(f"required span missing: {name}")
         if not summary["compiles"]:
             problems.append("no runtime compile events recorded")
+        if "health.drift" not in summary["marks"]:
+            problems.append("health.drift attribution mark missing "
+                            "from the trace")
+        # BENCH_obs v2 round trip: write an artifact carrying the
+        # drill's health section, read it back, validate the schema
+        obs_path = os.path.join(work, "BENCH_obs.json")
+        benchio.write_bench_obs(
+            "trace_report.smoke", {"rows": rows},
+            {"swap_tick": swap.get("swap_tick"),
+             "rollback_tick": roll.get("rollback_tick")},
+            health={"skew_top": skew_top}, path=obs_path)
+        try:
+            with open(obs_path) as fh:
+                doc = json.load(fh)
+            problems += [f"BENCH_obs: {p}"
+                         for p in benchio.validate_bench_obs(doc)]
+        except (OSError, ValueError) as exc:
+            problems.append(f"BENCH_obs unreadable: {exc}")
         print(json.dumps({"metric": "trace_report_smoke",
                           "ok": not problems,
                           "trace_events": summary["events"],
                           "spans": {k: v["count"]
                                     for k, v in summary["spans"].items()},
                           "compiles": summary["compiles"],
+                          "marks": summary["marks"],
                           "problems": problems}))
         return 1 if problems else 0
     finally:
         sess.reset(mode="off")
+        obs_health.get().set_mode(health_prev)
         shutil.rmtree(work, ignore_errors=True)
 
 
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "merge":
+        return merge_main(argv[1:])
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("trace", nargs="?", help="trace.json or telemetry.jsonl")
     ap.add_argument("--smoke", action="store_true",
