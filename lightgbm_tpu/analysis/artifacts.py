@@ -73,6 +73,26 @@ def check_while_body_mega() -> Dict[str, int]:
     }
 
 
+_FRONTIER_K = 4
+
+
+def check_while_body_frontier() -> Dict[str, int]:
+    """Frontier-batched (tpu_frontier_k=4) tree-build while body: the
+    per-SPLIT bookkeeping op budget (outer-body ops amortize over up to
+    K splits per step) and the structural invariant that the K-row
+    parent-hist gather + 2K-row child scatter carry ZERO contextual
+    hist-state copies (the subtraction path's two copies per split are
+    the round-4 fixed-cost smoking gun; the K=1 budget pins them at
+    exactly 2, this budget pins their absence under batching)."""
+    from .hlo import report
+    r = report({"tpu_frontier_k": _FRONTIER_K})
+    return {
+        "ops_per_split": -(-r["total_ops"] // _FRONTIER_K),
+        "copies": r["copies"],
+        "hist_state_copies": r["hist_state_copies"],
+    }
+
+
 # ---------------------------------------------------------------------------
 # serving-engine checks
 # ---------------------------------------------------------------------------
@@ -380,6 +400,7 @@ def check_continual_tick() -> Dict[str, int]:
 CHECKS = {
     "while_body.default": check_while_body_default,
     "while_body.mega": check_while_body_mega,
+    "frontier.body": check_while_body_frontier,
     "serving.compiles": check_serving_compiles,
     "serving.transfers": check_serving_transfers,
     "train.donation": check_train_donation,

@@ -135,11 +135,15 @@ def report(params: Dict[str, Any] = None) -> Dict[str, Any]:
     out = body_counts(hlo)
     out["params"] = dict(params or {})
     out["mega"] = learner._use_mega
+    out["frontier_k"] = learner.frontier_k
     # the hist-state buffer shape (the subtraction path's per-split
-    # dynamic-slice target) — its copies are the round-4 smoking gun
-    L1, G, B = learner.L + 1, learner.G, learner.B
-    state_shapes = [f"f32[{L1},{G},{B},2]",
-                    f"f32[{L1},8,{learner._flat_geom[2]}]"
+    # dynamic-slice target) — its copies are the round-4 smoking gun.
+    # The frontier-batched body sizes the state by its speculative slack
+    # (L + K slots) instead of L + 1.
+    slots = learner.L + max(learner.frontier_k, 1)
+    G, B = learner.G, learner.B
+    state_shapes = [f"f32[{slots},{G},{B},2]",
+                    f"f32[{slots},8,{learner._flat_geom[2]}]"
                     if learner._flat_geom else None]
     out["hist_state_copies"] = sum(
         cnt for shape, cnt in out["copies_by_shape"].items()

@@ -856,12 +856,23 @@ class Booster:
         saved_params["objective"] = header.get(
             "objective", saved_params.get("objective", "regression")).split(" ")[0]
         saved_params["num_class"] = int(header.get("num_class", 1))
-        self.config = Config(saved_params)
-        # a model trained with telemetry on re-enables the session on
-        # restore (the pickle round-trip keeps counting, like the
-        # serving engine keeps its warm-name debt)
+        # the re-arm opt-in belongs to the LOADING call, not the saved
+        # model: capture it from the pre-load config (and env) before
+        # the saved params replace it, and make sure a saved
+        # obs_rearm_on_load can never re-enable itself on later loads
         from .obs import telemetry as _obs_tel
-        _obs_tel.configure_from_config(self.config)
+        allow_rearm = _obs_tel.rearm_on_load_allowed(self.config)
+        saved_params.pop("obs_rearm_on_load", None)
+        self.config = Config(saved_params)
+        # a model trained with telemetry on does NOT silently re-arm the
+        # process-wide session on restore: re-arming is opt-in
+        # (obs_rearm_on_load=True / LIGHTGBM_TPU_OBS_REARM_ON_LOAD=1)
+        # and skipping it warns once — a loaded model file is data, not
+        # a process configuration change.  (In an already-armed process
+        # — e.g. the pickle round-trip of a booster trained here —
+        # nothing changes: sessions are upgrade-only.)
+        _obs_tel.configure_from_config(self.config, from_model_load=True,
+                                       allow_rearm=allow_rearm)
         self.params = dict(saved_params)
         objective = create_objective(self.config)
         self._gbdt = GBDT(self.config, None, objective)
@@ -888,7 +899,8 @@ class Booster:
             except ValueError:
                 pass
         from .obs import health as _obs_health
-        _obs_health.configure_from_config(self.config)
+        _obs_health.configure_from_config(self.config, from_model_load=True,
+                                          allow_rearm=allow_rearm)
 
     def dump_model(self, num_iteration: int = -1, start_iteration: int = 0) -> dict:
         """reference: GBDT::DumpModel (gbdt_model_text.cpp:23-120)."""

@@ -161,6 +161,12 @@ _PARAMS: List[_Param] = [
     # directory where the CLI writes telemetry.jsonl / trace.json /
     # metrics.prom when the task finishes ("" = no export)
     _p("telemetry_out", "", str, ("telemetry_dir",)),
+    # loading a model whose saved params carry telemetry=counters|trace
+    # (or health=...) does NOT re-arm the process-wide session by
+    # default (a one-time warning names what was skipped); set this (or
+    # LIGHTGBM_TPU_OBS_REARM_ON_LOAD=1) to opt back into re-arming —
+    # see README "Observability"
+    _p("obs_rearm_on_load", False, bool),
     # model & data health (lightgbm_tpu/obs/health.py + digest.py),
     # riding the telemetry modes: "off" (default; zero host bookkeeping
     # and — pinned by the jaxlint health.off budget — zero ops in any
@@ -329,6 +335,17 @@ _PARAMS: List[_Param] = [
     # the attempt; "xla" runs the same math as plain XLA ops (the
     # correctness oracle, any backend); "off" disables
     _p("tpu_megakernel", "auto", str),
+    # frontier-batched tree growth: grow the top-K gain leaves of the
+    # current frontier per while-loop step instead of 1, amortizing the
+    # per-split fixed bookkeeping cost ~K-fold (models/learner.py; the
+    # oracle-order replay keeps trained trees BIT-identical to the K=1
+    # learner, including at the num_leaves budget boundary).  "auto"
+    # engages K=4 on TPU backends when the plain serial path is active
+    # and stays at 1 elsewhere; an explicit integer K forces batching on
+    # any backend (falls back to 1 with a warning when forced splits,
+    # monotone constraints, CEGB, extra_trees, feature_fraction_bynode,
+    # interaction constraints or a parallel tree learner are active)
+    _p("tpu_frontier_k", "auto", str),
     # radix-4 compaction network in the partition/mega kernels: half the
     # roll-network steps of the binary network (bit-identical layouts;
     # an instruction-budget lever — see PERF.md round 6)

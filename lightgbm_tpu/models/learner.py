@@ -68,6 +68,14 @@ NLF = 25
  ND_IS_BUNDLED, ND_NUM_BIN, ND_DEFAULT_BIN, ND_MISSING, ND_IS_CAT) = range(17)
 NND = 17
 
+# The frontier-batched mode (tpu_frontier_k > 1) appends parent-leaf
+# SNAPSHOT rows to its node matrix — the start/count/sum_g/depth of the
+# leaf each split consumed — so the oracle-order renumber pass can
+# reconstruct the leaf record of a PRUNED speculative split without a
+# host round-trip (see _renumber_frontier).
+(ND_START, ND_CNTP, ND_SUM_G, ND_DEPTH) = range(NND, NND + 4)
+NND_FR = NND + 4
+
 
 def _i2f(x):
     return jax.lax.bitcast_convert_type(
@@ -609,12 +617,68 @@ class SerialTreeLearner:
                                            "float32")) == "bfloat16_pair"
                             else jnp.float32)
         self._init_megakernel(config, dataset, parallel_mode)
+
+        # ---- frontier-batched growth (tpu_frontier_k) ----
+        # Grow the top-K gain leaves of the frontier per while-loop step
+        # instead of 1: the per-split fixed bookkeeping cost (scalar DUS
+        # writes, the parent-hist dynamic-slice read, kernel-launch fixed
+        # work) amortizes ~K-fold while an oracle-order replay carried in
+        # the loop keeps trained trees BIT-identical to the K=1 learner,
+        # including at the num_leaves budget boundary (see
+        # _build_tree_frontier).  Order-dependent machinery — forced
+        # splits, monotone constraint propagation, CEGB feature
+        # accounting, per-step RNG draws (extra_trees / bynode sampling),
+        # interaction constraints, parallel learners — falls back to K=1.
+        spec = str(getattr(config, "tpu_frontier_k", "auto")
+                   or "auto").strip().lower()
+        frontier_eligible = (parallel_mode == "serial"
+                             and axis_name is None
+                             and self.forced is None
+                             and not self.use_mc
+                             and not self.has_cegb
+                             and not self.extra_trees
+                             and not self.has_bynode
+                             and self.ic_masks is None
+                             and not self._ab_double
+                             # the Pallas pair-search without the mega
+                             # kernel implies the flat-hist RMW state
+                             # machinery; the batched body reproduces
+                             # the pair search only on the mega path
+                             and not (self._use_pallas_search
+                                      and self._use_mega is None)
+                             and self.F > 0)
+        if spec in ("auto", ""):
+            # on CPU hosts auto stays at 1: the win is real (see PERF.md
+            # round 12) but the bigger traced program taxes every fresh
+            # compile, which test-sized trainings pay more than they save
+            k_req = 4 if (frontier_eligible
+                          and jax.default_backend() == "tpu") else 1
+        else:
+            try:
+                k_req = int(spec)
+            except ValueError:
+                raise ValueError("tpu_frontier_k must be 'auto' or a "
+                                 f"positive integer, got {spec!r}")
+            if k_req < 1:
+                raise ValueError("tpu_frontier_k must be >= 1")
+            if k_req > 1 and not frontier_eligible:
+                log.warning(
+                    "tpu_frontier_k=%d needs the plain serial tree path "
+                    "(no forced splits, monotone constraints, CEGB, "
+                    "extra_trees, feature_fraction_bynode, interaction "
+                    "constraints or parallel learners); using 1", k_req)
+                k_req = 1
+        self.frontier_k = max(1, min(k_req, self.L - 1))
+
         # no histogram state exists on the mega path (the children
         # histograms feed the split search in-register), so the flat
-        # state and its probe compile are skipped entirely there
+        # state and its probe compile are skipped entirely there; the
+        # frontier-batched body replaces the per-split state RMW with
+        # one K-row gather + one 2K-row scatter, so it skips it too
         self._use_flat_hist = (self._use_pallas_search
                                and not self._use_pallas
                                and self._use_mega is None
+                               and self.frontier_k == 1
                                and getattr(config, "tpu_hist_state",
                                            "auto") != "xla")
         self._flat_geom = None
@@ -737,15 +801,20 @@ class SerialTreeLearner:
                                     num_groups=self.G)
         # quantized training rides INTEGER gradient carriers: the one-hot
         # matmuls run in bfloat16 (exact for the small int grid, double
-        # MXU rate — the int16-histogram analog) and the scale applies
-        # once per histogram
+        # MXU rate — the int16-histogram analog).  The histogram stays
+        # in the INTEGER domain here — exact at any summation order and
+        # through the whole parent-minus-child subtraction chain; the
+        # (grad, hess) scales apply once at the split-search inputs
+        # (_scale_hist).  Scaling per-histogram instead was an FMA trap:
+        # LLVM contracted `parent - h*scale` into a fused
+        # multiply-subtract in some compilation contexts and not others,
+        # so "identical" programs drifted by ULPs (the frontier-batched
+        # body's bit-identity contract caught it, PERF.md round 12).
         h = leaf_hist_slice(part_bins, part_ghi, start, cnt,
                             num_bins=self.B, row_chunk=self.row_chunk,
                             vary=self._pvary, num_groups=self.G,
                             dtype=(jnp.bfloat16 if scale is not None
                                    else self._hist_dtype))
-        if scale is not None:
-            h = h * scale[None, None, :]
         if self._ab_double == "hist" and scale is None:
             h = self._double_opaque(
                 h, lambda s2: leaf_hist_slice(
@@ -754,6 +823,15 @@ class SerialTreeLearner:
                     num_groups=self.G, dtype=self._hist_dtype),
                 part_ghi, start)
         return h
+
+    @staticmethod
+    def _scale_hist(h, scale):
+        """Integer-domain quantized histogram -> gain domain at a
+        split-search input ((..., 2) trailing (grad, hess) planes times
+        (gs, hs)).  Identity when quantized carriers are off."""
+        if scale is None:
+            return h
+        return h * scale[None, None, :]
 
     def _hist_leaf_flat(self, part_bins, part_ghi, start, cnt):
         """Smaller-child histogram directly in the lane-flattened (8, WL)
@@ -1000,11 +1078,12 @@ class SerialTreeLearner:
         hl_g, hl_h, hr_g, hr_h = unpack_hist4(acc, self.B)
         if hist_scale is not None:
             # quantized training: integer carriers accumulated exactly;
-            # the (grad, hess) scales apply once per histogram
-            hl_g = hl_g * hist_scale[0]
-            hr_g = hr_g * hist_scale[0]
-            hl_h = hl_h * hist_scale[1]
-            hr_h = hr_h * hist_scale[1]
+            # the (grad, hess) scales apply once per histogram.  The
+            # barrier pins the products' rounding across compilation
+            # contexts (see _hist_leaf).
+            hl_g, hl_h, hr_g, hr_h = jax.lax.optimization_barrier(
+                (hl_g * hist_scale[0], hl_h * hist_scale[1],
+                 hr_g * hist_scale[0], hr_h * hist_scale[1]))
         return moved, left_cnt, (hl_g, hl_h, hr_g, hr_h)
 
     # ------------------------------------------------------------------
@@ -1258,7 +1337,8 @@ class SerialTreeLearner:
         return best._replace(gain=gain)
 
     # ------------------------------------------------------------------
-    def _mc_refresh(self, st, lm, nleaves, feature_mask):
+    def _mc_refresh(self, st, lm, nleaves, feature_mask,
+                    hist_scale=None):
         """Region-exact `intermediate` monotone mode.
 
         TPU-native replacement for the reference's recursive
@@ -1331,7 +1411,8 @@ class SerialTreeLearner:
         if "leaf_fmask" in st:
             masks = masks & st["leaf_fmask"][:L]
         best = self._best_split_vmapped(
-            st["hist"][:L], lm[LM_SUM_G, :L], lm[LM_SUM_H, :L],
+            self._scale_hist(st["hist"][:L], hist_scale),
+            lm[LM_SUM_G, :L], lm[LM_SUM_H, :L],
             _f2i(lm[LM_CNT_G, :L]), _f2i(lm[LM_CNT, :L]),
             _f2i(lm[LM_DEPTH, :L]), newmin, newmax, lm[LM_VALUE, :L],
             masks, st["feat_used"],
@@ -1638,6 +1719,11 @@ class SerialTreeLearner:
         """Core tree loop over a prebuilt (8, N_pad) row payload whose
         rows are (grad, hess, rowid-bits, extras...); the extras ride the
         partition untouched (physical-order fused step)."""
+        if self.frontier_k > 1:
+            # batched frontier growth (the eligibility gate guarantees
+            # feat_used_init/aux0 are absent: no CEGB in batched mode)
+            return self._build_tree_frontier(part_bins, part_ghi0, bag_cnt,
+                                             feature_mask, hist_scale)
         L, G, B, F = self.L, self.G, self.B, self.F
         nodes = self.max_splits
         rng0 = jax.random.PRNGKey(seed)
@@ -1669,6 +1755,10 @@ class SerialTreeLearner:
         else:
             sum_g = root_hist[0, :, 0].sum()
             sum_h = root_hist[0, :, 1].sum()
+        if hist_scale is not None:
+            # integer-domain quantized totals -> gain domain (once)
+            sum_g = sum_g * hist_scale[0]
+            sum_h = sum_h * hist_scale[1]
         neg_inf = jnp.float32(-jnp.inf)
         pos_inf = jnp.float32(jnp.inf)
         lazy_extra = ()
@@ -1686,7 +1776,8 @@ class SerialTreeLearner:
             lazy_extra = lazy_extra + (
                 self._rand_bins(jax.random.fold_in(rngx, 0)),)
         best0 = self._sync_best(self._leaf_best_split(
-            root_hist, sum_g, sum_h, bag_cnt_g, bag_cnt, jnp.int32(0),
+            self._scale_hist(root_hist, hist_scale), sum_g, sum_h,
+            bag_cnt_g, bag_cnt, jnp.int32(0),
             neg_inf, pos_inf, jnp.float32(0.0), root_mask, feat_used0,
             *lazy_extra))
 
@@ -1799,7 +1890,8 @@ class SerialTreeLearner:
                 fcol = jax.lax.dynamic_slice(
                     lm, (0, f_leaf), (NLF, 1))[:, 0]
                 forced_info = self._forced_split_info(
-                    st["hist"][f_leaf], self.forced["feature"][forced_node],
+                    self._scale_hist(st["hist"][f_leaf], hist_scale),
+                    self.forced["feature"][forced_node],
                     self.forced["bin"][forced_node],
                     fcol[LM_SUM_G], fcol[LM_SUM_H], _f2i(fcol[LM_CNT_G]))
                 depth_ok = (self.max_depth <= 0) | \
@@ -1864,7 +1956,8 @@ class SerialTreeLearner:
                             jax.random.PRNGKey(self.extra_seed ^ 0x51AD),
                             st["s"])),)
                 adv = self._sync_best(self._leaf_best_split(
-                    st["hist"][best_leaf], pcol[LM_SUM_G],
+                    self._scale_hist(st["hist"][best_leaf], hist_scale),
+                    pcol[LM_SUM_G],
                     pcol[LM_SUM_H], _f2i(pcol[LM_CNT_G]),
                     _f2i(pcol[LM_CNT]), _f2i(pcol[LM_DEPTH]),
                     cmin_t, cmax_t, pcol[LM_VALUE], maskY,
@@ -2159,6 +2252,10 @@ class SerialTreeLearner:
                         hh = jnp.concatenate([hist_left[:, :BFs, 1],
                                               hist_right[:, :BFs, 1]],
                                              axis=0)
+                        if hist_scale is not None:
+                            # integer-domain state -> gain domain
+                            hg = hg * hist_scale[0]
+                            hh = hh * hist_scale[1]
                     onesF = jnp.ones((F, 1), jnp.float32)
                     dep_f = depth_child.astype(jnp.float32)
 
@@ -2252,7 +2349,9 @@ class SerialTreeLearner:
                         cmin_arg = jnp.stack([l_cmin, r_cmin])
                         cmax_arg = jnp.stack([l_cmax, r_cmax])
                     both = self._best_split_vmapped(
-                        jnp.stack([hist_left, hist_right]),
+                        self._scale_hist(jnp.stack([hist_left,
+                                                    hist_right]),
+                                         hist_scale),
                         jnp.stack([lsg, rsg]), jnp.stack([lsh, rsh]),
                         jnp.stack([left_cnt_g, right_cnt_g]),
                         jnp.stack([left_cnt, right_cnt]),
@@ -2324,7 +2423,8 @@ class SerialTreeLearner:
                     upd["leaf_hi"] = leaf_hi
                     st2 = {**st, **upd}
                     lm3, cat3 = self._mc_refresh(
-                        st2, lm2, upd["s"] + 1, feature_mask)
+                        st2, lm2, upd["s"] + 1, feature_mask,
+                        hist_scale=hist_scale)
                     upd["leafmat"] = jnp.where(valid, lm3, lm2)
                     if cat3 is not None:
                         upd["best_cat_set"] = jnp.where(valid, cat3,
@@ -2335,6 +2435,651 @@ class SerialTreeLearner:
             return self._unpack_state(state)
         final = jax.lax.while_loop(cond, body, state)
         return self._unpack_state(final)
+
+    # ------------------------------------------------------------------
+    # Frontier-batched growth (tpu_frontier_k > 1)
+    # ------------------------------------------------------------------
+    def _build_tree_frontier(self, part_bins, part_ghi0, bag_cnt,
+                             feature_mask, hist_scale=None):
+        """Grow the top-K frontier leaves per while-loop step.
+
+        Splitting leaf A never changes leaf B's histogram or best split
+        (per-leaf statistics depend only on the leaf's own rows), so K
+        splits per step are semantics-preserving — EXCEPT that leaf-wise
+        order decides WHICH splits fit the ``num_leaves`` budget and how
+        nodes/leaves are numbered.  Both are restored exactly by an
+        ORACLE-ORDER REPLAY carried in the loop:
+
+        * Every potential leaf is an *item*: item 0 is the root, items
+          ``1 + 2j + side`` are the children of our j-th executed split,
+          the last item is a write-trash slot.  The replay maintains the
+          K=1 oracle's priority queue over items (``avail``) and pops it
+          with the oracle's exact election (max gain, smallest oracle
+          leaf slot on ties — ops/split.py ``oracle_next_pick``).  A pop
+          of a split item commits it with the next oracle split index; a
+          pop of an UNSPLIT item stalls the replay: that item is the
+          oracle's guaranteed next split and seeds the next step's batch.
+        * Each step splits the stalled item plus the top-(K-1) remaining
+          positive-gain frontier candidates (speculative: the oracle may
+          or may not reach them within budget).  Including the stalled
+          item commits >= 1 oracle split per step, and the batch width
+          shrinks per the slot-reserve rule ``k <= slots_left - needed
+          + 1`` so at most K-1 speculative splits ever outlive the
+          budget — total splits are bounded by (L-1) + (K-1).
+        * After the loop, ``_renumber_frontier`` prunes the uncommitted
+          speculative splits and rebuilds leafmat/nodemat in oracle
+          numbering (child pointers from the replay arrays, pruned-leaf
+          records from per-split parent snapshots), yielding trees
+          bit-identical to the K=1 learner.
+
+        Pruned speculative partitions are UNDONE at tree end: f32
+        histogram accumulation is not order-invariant, so a permuted
+        row order inside a pruned leaf's range would ULP-perturb the
+        NEXT tree's histograms.  The slot-reserve rule bounds live
+        uncommitted splits by K-1, so a K-slot liveness ring of
+        pre-step rowid-row snapshots suffices: each step stamps its
+        snapshot into a ring slot whose previous occupants have all
+        committed, and the tree-end undo pass inverse-gathers the (at
+        most K-1, mutually disjoint) pruned ranges back into their
+        snapshot order — restoring the exact physical layout the K=1
+        oracle would hand the next iteration.
+
+        The amortization: ONE top-k election, ONE (NLF, K) leafmat
+        gather, ONE K-row parent-hist gather (replacing the K dynamic
+        slices whose contextual full-state copies are the round-4
+        fixed-cost smoking gun), ONE 2K-wide vmapped children search and
+        ONE 2K-column scatter per step, with only the per-leaf
+        partition/histogram passes (the payload-bound work) looping over
+        the K selected leaves.
+        """
+        L, G, B, F, K = self.L, self.G, self.B, self.F, self.frontier_k
+        MS = (L - 1) + (K - 1)      # split slots: budget + speculative slack
+        SL = MS + 2                 # leaf slots incl. one trash slot
+        TRASH = SL - 1
+        NI = 2 * MS + 2             # items: root + 2 per split + trash
+        IT = NI - 1                 # trash item
+        use_mega = self._use_mega is not None
+        neg_inf = jnp.float32(-jnp.inf)
+        pos_inf = jnp.float32(jnp.inf)
+
+        # ---- root (the K=1 path's root prep, serial-mode form) ----
+        root_hist = self._hist_leaf(part_bins, part_ghi0,
+                                    jnp.int32(self.row0),
+                                    jnp.int32(self.N), scale=hist_scale)
+        sum_g = root_hist[0, :, 0].sum()
+        sum_h = root_hist[0, :, 1].sum()
+        if hist_scale is not None:
+            # integer-domain quantized totals -> gain domain (once)
+            sum_g = sum_g * hist_scale[0]
+            sum_h = sum_h * hist_scale[1]
+        feat_used0 = jnp.zeros((F,), jnp.bool_)
+        best0 = self._leaf_best_split(
+            self._scale_hist(root_hist, hist_scale), sum_g, sum_h,
+            bag_cnt, bag_cnt, jnp.int32(0),
+            neg_inf, pos_inf, jnp.float32(0.0), feature_mask, feat_used0)
+        col0 = jnp.stack([
+            _i2f(self.row0), _i2f(self.N), _i2f(bag_cnt),
+            sum_g, sum_h, _i2f(0),
+            neg_inf, pos_inf,
+            jnp.float32(0.0), _i2f(-1), _i2f(0),
+            best0.gain, _i2f(best0.feature), _i2f(best0.threshold),
+            best0.default_left.astype(jnp.float32),
+            _i2f(best0.left_count), _i2f(best0.right_count),
+            best0.left_sum_g, best0.left_sum_h,
+            best0.right_sum_g, best0.right_sum_h,
+            best0.left_output, best0.right_output,
+            best0.is_cat.astype(jnp.float32), _i2f(-1)])
+        leafmat = jnp.zeros((NLF, SL), jnp.float32) \
+            .at[LM_BGAIN].set(neg_inf) \
+            .at[LM_CMIN].set(neg_inf) \
+            .at[LM_CMAX].set(pos_inf) \
+            .at[LM_PARENT].set(_i2f(jnp.full((SL,), -1, jnp.int32))) \
+            .at[LM_FORCED].set(_i2f(jnp.full((SL,), -1, jnp.int32))) \
+            .at[:, 0].set(col0)
+
+        state = {
+            "made": jnp.int32(0),       # splits executed (incl. speculative)
+            "m": jnp.int32(0),          # oracle splits committed by the replay
+            "done": ~(best0.gain > 0),
+            "part_bins": part_bins,
+            "part_ghi": part_ghi0,
+            "leafmat": leafmat,
+            "nodemat": jnp.zeros((NND_FR, MS + 1), jnp.float32),
+            "feat_used": feat_used0,
+            # oracle-replay item arrays
+            "it_gain": jnp.full((NI,), neg_inf).at[0].set(best0.gain),
+            "it_slot": jnp.zeros((NI,), jnp.int32),
+            "it_split": jnp.full((NI,), -1, jnp.int32),
+            "it_oslot": jnp.full((NI,), 2 ** 30, jnp.int32).at[0].set(0),
+            "avail": jnp.zeros((NI,), jnp.bool_).at[0].set(True),
+            "u_item": jnp.int32(0),     # the oracle's guaranteed next split
+            "pop_split": jnp.full((L,), -1, jnp.int32),
+            "ora_of": jnp.full((MS + 1,), -1, jnp.int32),
+            "slot_item": jnp.full((L + 1,), -1, jnp.int32).at[0].set(0),
+            # pre-step rowid snapshots for the tree-end undo of pruned
+            # speculative partitions (K slots suffice: live uncommitted
+            # splits never exceed K-1, each pinning one ring slot)
+            "ring": jnp.zeros((K, part_bins.shape[1]), jnp.float32),
+            "ring_live": jnp.zeros((K,), jnp.int32),
+            "rslot": jnp.zeros((MS + 1,), jnp.int32),
+        }
+        if not use_mega:
+            state["hist"] = jnp.zeros((SL, G, B, 2),
+                                      jnp.float32).at[0].set(root_hist)
+        if self.has_categorical:
+            state["best_cat_set"] = jnp.zeros(
+                (SL, self.BF), jnp.bool_).at[0].set(best0.cat_set)
+            state["node_cat_set"] = jnp.zeros((MS + 1, self.BF), jnp.bool_)
+        if self._use_pallas_part:
+            from ..ops.partition_pallas import sc_rows_for
+            state["sc_packed"] = jnp.zeros(
+                (sc_rows_for(self._pb_rows), part_bins.shape[1]), jnp.int32)
+        else:
+            state["sc32"] = jnp.zeros((G + self._ghi_rows,
+                                       part_bins.shape[1]), jnp.int32)
+        buf_keys = ("part_bins", "part_ghi",
+                    "sc_packed" if self._use_pallas_part else "sc32")
+
+        def cond(st):
+            return (~st["done"]) & (st["made"] < MS)
+
+        def body(st):
+            lm = st["leafmat"]
+            iotK = jax.lax.iota(jnp.int32, K)
+            # ---- select the step's batch: the oracle's guaranteed-next
+            # split plus the top-(K-1) speculative candidates ----
+            cand = st["avail"] & (st["it_split"] < 0) & (st["it_gain"] > 0)
+            scores = jnp.where(cand, st["it_gain"], neg_inf)
+            sel_items, sel_ok = split_ops.frontier_topk(
+                scores, st["u_item"], K)
+            ncand = jnp.sum(sel_ok.astype(jnp.int32))
+            # shrink K to the remaining budget on the final steps AND to
+            # the slot-reserve rule (enough split slots must remain to
+            # finish one committed split per step)
+            needed = jnp.int32(L - 1) - st["m"]
+            s_left = jnp.int32(MS) - st["made"]
+            k_step = jnp.minimum(jnp.minimum(jnp.int32(K), needed),
+                                 s_left - needed + 1)
+            k_step = jnp.clip(jnp.minimum(k_step, ncand), 1, K)
+            active = iotK < k_step
+            sel_items = jnp.where(active, sel_items, IT)
+            sel_slots = jnp.where(active,
+                                  jnp.take(st["it_slot"], sel_items),
+                                  TRASH)
+            j_idx = jnp.where(active, st["made"] + iotK, jnp.int32(MS))
+            wrb_slots = jnp.where(active, st["made"] + 1 + iotK,
+                                  jnp.int32(TRASH))
+            # stamp the pre-step rowid order into a free ring slot (one
+            # always exists: live slots <= uncommitted splits <= K-1).
+            # The row read pins the pre-mutation payload, which costs
+            # two coherence copies of part_ghi per step (~2% of the
+            # 262k-row iteration; barrier-sequencing did not remove
+            # them — measured, PERF.md round 12)
+            free_r = jnp.argmax(st["ring_live"] == 0).astype(jnp.int32)
+            if getattr(self, "_frontier_no_undo", False):
+                ring2 = st["ring"]        # measurement-only ablation
+            else:
+                ring2 = st["ring"].at[free_r].set(st["part_ghi"][2])
+            ring_live2 = st["ring_live"].at[free_r].set(k_step)
+            rslot2 = st["rslot"].at[j_idx].set(free_r)
+
+            # ---- ONE gather of the K chosen leaves' packed scalars ----
+            pcols = jnp.take(lm, sel_slots, axis=1)           # (NLF, K)
+            f_enums = _f2i(pcols[LM_BFEAT])
+            thrs = _f2i(pcols[LM_BTHR])
+            dls = pcols[LM_BDL] > 0.5
+            is_cats = pcols[LM_BISCAT] > 0.5
+            starts = _f2i(pcols[LM_START])
+            cnts = jnp.where(active, _f2i(pcols[LM_CNT]), 0)
+            lcg = _f2i(pcols[LM_BLCNT])
+            rcg = _f2i(pcols[LM_BRCNT])
+            small_is_left = lcg <= rcg
+            # one batched gather over the packed per-feature metadata
+            # (replaces K per-split lane-dynamic slices)
+            fmeta_k = jnp.take(self._fmeta, f_enums, axis=1)  # (8, K)
+            if self.has_categorical:
+                cat_sets = jnp.take(st["best_cat_set"], sel_slots, axis=0)
+            else:
+                cat_sets = jnp.zeros((K, 1), jnp.bool_)
+            if not use_mega:
+                # subtraction trick: ONE gather over the K parents
+                # replaces K dynamic-slices of the histogram state (the
+                # round-4 contextual double-copy pathology, PERF.md)
+                parent_hists = jnp.take(st["hist"], sel_slots, axis=0)
+
+            # ---- per-leaf payload passes: the k-loop runs ONLY the
+            # partitions (selected leaves occupy disjoint row ranges, so
+            # the passes commute and later lanes read ranges earlier
+            # lanes never touched) ----
+            depth_c = _f2i(pcols[LM_DEPTH]) + 1
+            bufs0 = {kk: st[kk] for kk in buf_keys}
+            use_ppair = use_mega and self._use_pallas_search
+            if use_mega:
+                acc0 = tuple(jnp.zeros((K, G, B), jnp.float32)
+                             for _ in range(4))
+            else:
+                acc0 = (jnp.zeros((K, G, B, 2), jnp.float32),)
+            carry0 = (bufs0, acc0, jnp.zeros((K,), jnp.int32),
+                      jnp.zeros((13, 2 * K), jnp.float32))
+
+            def kbody(k, carry):
+                bufs, acc, lcnt, seg = carry
+                fm = jax.lax.dynamic_slice(fmeta_k, (0, k), (8, 1))[:, 0]
+                dsc = (fm[2], fm[3], fm[4], fm[5], fm[6],
+                       thrs[k], dls[k], is_cats[k], cat_sets[k])
+                start = starts[k]
+                cnt = cnts[k]
+                if use_mega:
+                    moved, left_cnt, mh = self._split_leaf_mega(
+                        bufs, start, cnt, fm[1], dsc, hist_scale)
+                    acc = tuple(a.at[k].set(p[:, :B])
+                                for a, p in zip(acc, mh))
+                    if use_ppair:
+                        # the Pallas pair-search kernel, one program per
+                        # split exactly like the K=1 body (its last-ulp
+                        # gemm rounding differs from the XLA search, so
+                        # mixing implementations would break the
+                        # bit-identity contract on kernel backends)
+                        from ..ops.split_pallas import (
+                            best_split_pair_pallas)
+                        BFs = self.BF
+                        hg = jnp.concatenate([mh[0][:, :BFs],
+                                              mh[2][:, :BFs]], axis=0)
+                        hh = jnp.concatenate([mh[1][:, :BFs],
+                                              mh[3][:, :BFs]], axis=0)
+                        onesF = jnp.ones((F, 1), jnp.float32)
+                        dep_f = (depth_c[k]).astype(jnp.float32)
+
+                        def iblock(csg, csh, ccnt_g):
+                            return jnp.concatenate([
+                                onesF * csg, onesF * csh,
+                                onesF * ccnt_g.astype(jnp.float32),
+                                onesF * dep_f,
+                                feature_mask.astype(
+                                    jnp.float32)[:, None],
+                                jnp.zeros((F, 3), jnp.float32)], axis=1)
+
+                        info = jnp.concatenate(
+                            [iblock(pcols[LM_BLSG, k], pcols[LM_BLSH, k],
+                                    lcg[k]),
+                             iblock(pcols[LM_BRSG, k], pcols[LM_BRSH, k],
+                                    rcg[k])], axis=0)
+                        tile = best_split_pair_pallas(
+                            hg, hh, self._fmeta_pair, info,
+                            l1=self.l1, l2=self.l2,
+                            max_delta_step=self.max_delta_step,
+                            min_gain_to_split=self.min_gain_to_split,
+                            min_data_in_leaf=self.min_data_in_leaf,
+                            min_sum_hessian=self.min_sum_hessian,
+                            max_depth=self.max_depth,
+                            interpret=self._interp)
+                        seg = jax.lax.dynamic_update_slice(
+                            seg, jnp.transpose(tile[:1, :13]), (0, k))
+                        seg = jax.lax.dynamic_update_slice(
+                            seg, jnp.transpose(tile[1:2, :13]), (0, K + k))
+                else:
+                    moved, left_cnt = self._partition_leaf(
+                        bufs, start, cnt, fm[1], dsc)
+                    # the smaller-child histogram stays a PER-LEAF pass
+                    # on the leaf's own chunk grid: a lane-batched vmap
+                    # was measured and REJECTED (run-until-all-done
+                    # semantics cost K x max-lane chunks — 1.9x e2e on
+                    # skewed leaf sizes; PERF.md round 12)
+                    sm_start = jnp.where(small_is_left[k], start,
+                                         start + left_cnt)
+                    sm_cnt = jnp.where(small_is_left[k], left_cnt,
+                                       cnt - left_cnt)
+                    acc = (acc[0].at[k].set(self._hist_leaf(
+                        moved["part_bins"], moved["part_ghi"],
+                        sm_start, sm_cnt, scale=hist_scale)),)
+                return ({**bufs, **moved}, acc, lcnt.at[k].set(left_cnt),
+                        seg)
+
+            bufs, acc, left_cnts, seg_pp = jax.lax.fori_loop(
+                0, K, kbody, carry0)
+            right_cnts = cnts - left_cnts
+            l_starts = starts
+            r_starts = starts + left_cnts
+
+            # ---- children histograms -> state / search inputs ----
+            ch_slots = jnp.concatenate([sel_slots, wrb_slots])
+            upd_hist = {}
+            if use_mega:
+                hist_left = jnp.stack([acc[0], acc[1]], axis=3)
+                hist_right = jnp.stack([acc[2], acc[3]], axis=3)
+            else:
+                small = acc[0]
+                large = parent_hists - small
+                sel_b = small_is_left[:, None, None, None]
+                hist_left = jnp.where(sel_b, small, large)
+                hist_right = jnp.where(sel_b, large, small)
+                # ONE 2K-row scatter replaces 2K per-split state updates
+                upd_hist["hist"] = st["hist"].at[ch_slots].set(
+                    jnp.concatenate([hist_left, hist_right], axis=0))
+
+            def seg13(bs):
+                return jnp.stack([
+                    bs.gain, _i2f(bs.feature), _i2f(bs.threshold),
+                    bs.default_left.astype(jnp.float32),
+                    _i2f(bs.left_count), _i2f(bs.right_count),
+                    bs.left_sum_g, bs.left_sum_h,
+                    bs.right_sum_g, bs.right_sum_h,
+                    bs.left_output, bs.right_output,
+                    bs.is_cat.astype(jnp.float32)])
+
+            two = jnp.concatenate
+            sum_g2 = two([pcols[LM_BLSG], pcols[LM_BRSG]])
+            sum_h2 = two([pcols[LM_BLSH], pcols[LM_BRSH]])
+            cnt_g2 = two([lcg, rcg])
+            depth2 = two([depth_c, depth_c])
+            out2 = two([pcols[LM_BLOUT], pcols[LM_BROUT]])
+
+            # ---- ONE 2K-wide batched best-split search over all the
+            # step's children (vs 2 per split before: the vmapped search
+            # is elementwise/scan-structured per lane, so batch width
+            # cannot change per-lane rounding — re-verified empirically
+            # by the bit-identity matrix in tests/test_frontier.py) ----
+            if use_ppair:
+                # the Pallas pair searches already ran per split inside
+                # the k-loop and emitted the packed segments directly
+                seg13_2k = seg_pp
+                ccat_2k = jnp.zeros((2 * K, 1), jnp.bool_)
+            else:
+                hist2k = two([hist_left, hist_right], axis=0)
+                if not use_mega:   # mega planes arrive already scaled
+                    hist2k = self._scale_hist(hist2k, hist_scale)
+                both = self._best_split_vmapped(
+                    hist2k, sum_g2, sum_h2, cnt_g2,
+                    two([left_cnts, right_cnts]), depth2,
+                    jnp.full((2 * K,), neg_inf),
+                    jnp.full((2 * K,), pos_inf),
+                    out2, jnp.broadcast_to(feature_mask, (2 * K, F)),
+                    st["feat_used"])
+                seg13_2k = seg13(both)                    # (13, 2K)
+                ccat_2k = both.cat_set
+
+            head = jnp.stack([
+                _i2f(two([l_starts, r_starts])),
+                _i2f(two([left_cnts, right_cnts])),
+                _i2f(cnt_g2),
+                sum_g2, sum_h2,
+                _i2f(depth2),
+                jnp.full((2 * K,), neg_inf), jnp.full((2 * K,), pos_inf),
+                out2,
+                _i2f(two([j_idx, j_idx])),
+                _i2f(two([jnp.zeros((K,), jnp.int32),
+                          jnp.ones((K,), jnp.int32)]))])  # (11, 2K)
+            cols = jnp.concatenate(
+                [head, seg13_2k,
+                 jnp.broadcast_to(_i2f(jnp.int32(-1)), (1, 2 * K))],
+                axis=0)
+            lm2 = lm.at[:, ch_slots].set(cols)
+
+            # ---- nodemat: ONE K-column scatter (child pointers and the
+            # parent fixups are derived at renumber time) ----
+            ncols = jnp.stack([
+                _i2f(fmeta_k[0]), _i2f(f_enums), _i2f(thrs),
+                dls.astype(jnp.float32), pcols[LM_BGAIN],
+                _i2f(-(sel_slots + 1)), _i2f(-(wrb_slots + 1)),
+                pcols[LM_VALUE], pcols[LM_SUM_H], pcols[LM_CNT_G],
+                _i2f(fmeta_k[1]), _i2f(fmeta_k[2]), _i2f(fmeta_k[3]),
+                _i2f(fmeta_k[4]), _i2f(fmeta_k[5]), _i2f(fmeta_k[6]),
+                is_cats.astype(jnp.float32),
+                pcols[LM_START], pcols[LM_CNT], pcols[LM_SUM_G],
+                pcols[LM_DEPTH]])                         # (NND_FR, K)
+            nm2 = st["nodemat"].at[:, j_idx].set(ncols)
+
+            # ---- replay item bookkeeping ----
+            ch_items = two([jnp.where(active, 1 + 2 * j_idx, IT),
+                            jnp.where(active, 2 + 2 * j_idx, IT)])
+            it_gain2 = st["it_gain"].at[ch_items].set(seg13_2k[0]) \
+                .at[IT].set(neg_inf)
+            it_slot2 = st["it_slot"].at[ch_items].set(ch_slots)
+            it_split2 = st["it_split"].at[sel_items].set(
+                jnp.where(active, j_idx, -1)).at[IT].set(-1)
+
+            upd_cat = {}
+            if self.has_categorical:
+                upd_cat["best_cat_set"] = st["best_cat_set"].at[
+                    ch_slots].set(ccat_2k)
+                upd_cat["node_cat_set"] = st["node_cat_set"].at[
+                    j_idx].set(cat_sets)
+
+            # ---- advance the oracle replay: pop committed splits until
+            # it stalls on a leaf not yet split (next step's required
+            # candidate), exhausts the num_leaves budget, or runs out of
+            # positive gains (tree done).  Amortized: total pops over the
+            # whole tree <= splits executed. ----
+            sim0 = {
+                "avail": st["avail"], "it_oslot": st["it_oslot"],
+                "slot_item": st["slot_item"],
+                "pop_split": st["pop_split"], "ora_of": st["ora_of"],
+                "ring_live": ring_live2,
+                "m": st["m"], "u_item": st["u_item"], "done": st["done"],
+                "stop": jnp.bool_(False),
+            }
+
+            def sim_cond(c):
+                return ~c["stop"]
+
+            def sim_body(c):
+                it, gmax = split_ops.oracle_next_pick(
+                    it_gain2, c["it_oslot"], c["avail"])
+                budget_done = c["m"] >= jnp.int32(L - 1)
+                dead = ~(gmax > 0)       # covers the empty-queue case
+                j2 = it_split2[it]
+                can_pop = (~budget_done) & (~dead) & (j2 >= 0)
+                stall = (~budget_done) & (~dead) & (j2 < 0)
+                i = c["m"]
+                j2c = jnp.maximum(j2, 0)
+                cl = 1 + 2 * j2c
+                cr = cl + 1
+                itx = jnp.where(can_pop, it, IT)
+                clx = jnp.where(can_pop, cl, IT)
+                crx = jnp.where(can_pop, cr, IT)
+                po = c["it_oslot"][it]
+                avail2 = (c["avail"].at[itx].set(False)
+                          .at[clx].set(True).at[crx].set(True)
+                          .at[IT].set(False))
+                oslot2 = (c["it_oslot"].at[clx].set(po)
+                          .at[crx].set(i + 1)
+                          .at[IT].set(jnp.int32(2 ** 30)))
+                slot_item2 = (c["slot_item"]
+                              .at[jnp.where(can_pop, po,
+                                            jnp.int32(L))].set(cl)
+                              .at[jnp.where(can_pop, i + 1,
+                                            jnp.int32(L))].set(cr))
+                pop_split2 = c["pop_split"].at[
+                    jnp.where(can_pop, i, jnp.int32(L - 1))].set(j2c)
+                ora2 = c["ora_of"].at[
+                    jnp.where(can_pop, j2c, jnp.int32(MS))].set(i)
+                # a committed split releases its undo-snapshot pin
+                rl2 = c["ring_live"].at[
+                    jnp.where(can_pop, rslot2[j2c], jnp.int32(K))].add(
+                    -1, mode="drop")
+                return {
+                    "avail": avail2, "it_oslot": oslot2,
+                    "slot_item": slot_item2, "pop_split": pop_split2,
+                    "ora_of": ora2, "ring_live": rl2,
+                    "m": c["m"] + can_pop.astype(jnp.int32),
+                    "u_item": jnp.where(stall, it, c["u_item"]),
+                    "done": c["done"] | budget_done | dead,
+                    "stop": ~can_pop,
+                }
+
+            sim = jax.lax.while_loop(sim_cond, sim_body, sim0)
+
+            return {
+                "made": st["made"] + k_step,
+                "m": sim["m"], "done": sim["done"],
+                "leafmat": lm2, "nodemat": nm2,
+                "feat_used": st["feat_used"],
+                "it_gain": it_gain2, "it_slot": it_slot2,
+                "it_split": it_split2,
+                "it_oslot": sim["it_oslot"], "avail": sim["avail"],
+                "u_item": sim["u_item"],
+                "pop_split": sim["pop_split"], "ora_of": sim["ora_of"],
+                "slot_item": sim["slot_item"],
+                "ring": ring2, "ring_live": sim["ring_live"],
+                "rslot": rslot2,
+                **{kk: bufs[kk] for kk in buf_keys},
+                **upd_hist, **upd_cat,
+            }
+
+        final = jax.lax.while_loop(cond, body, state)
+
+        # ---- undo the pruned speculative partitions: restore each
+        # pruned range to its snapshot (= oracle) row order so the next
+        # iteration's f32 accumulation order is bit-identical to K=1.
+        # Runs ONCE per tree, and only when something was actually
+        # pruned: in the common all-committed case the cond skips the
+        # O(N) position scatter and the two full-payload gathers.
+        Np = part_bins.shape[1]
+        jar = jnp.arange(MS, dtype=jnp.int32)
+        is_pruned = (jar < final["made"]) & (final["ora_of"][:MS] < 0)
+
+        def _undo(ops):
+            pb0, pg0 = ops
+            iota_n = jax.lax.iota(jnp.int32, Np)
+            pr_j, _ = jax.lax.top_k(jnp.where(is_pruned, jar, -1),
+                                    min(K, MS))
+            src_bits = pg0[2]
+            anymask = jnp.zeros((Np,), jnp.bool_)
+            for t in range(min(K, MS)):
+                jt = pr_j[t]
+                jc = jnp.maximum(jt, 0)
+                ncol = jax.lax.dynamic_slice(final["nodemat"], (0, jc),
+                                             (NND_FR, 1))[:, 0]
+                stt = _f2i(ncol[ND_START])
+                cntt = _f2i(ncol[ND_CNTP])
+                mask = (jt >= 0) & (iota_n >= stt) & (iota_n < stt + cntt)
+                src_bits = jnp.where(
+                    mask, final["ring"][final["rslot"][jc]], src_bits)
+                anymask = anymask | mask
+            cur = jnp.clip(_f2i(pg0[2]), 0, self.N)
+            pos_of = jnp.zeros((self.N + 1,),
+                               jnp.int32).at[cur].set(iota_n)
+            perm = jnp.where(
+                anymask,
+                jnp.take(pos_of, jnp.clip(_f2i(src_bits), 0, self.N)),
+                iota_n)
+            return (jnp.take(pb0, perm, axis=1),
+                    jnp.take(pg0, perm, axis=1))
+
+        pb1, pg1 = jax.lax.cond(
+            jnp.any(is_pruned), _undo, lambda ops: ops,
+            (final["part_bins"], final["part_ghi"]))
+        final = {**final, "part_bins": pb1, "part_ghi": pg1}
+        return self._unpack_state(self._renumber_frontier(final))
+
+    def _renumber_frontier(self, st: Dict[str, Any]) -> Dict[str, Any]:
+        """Prune uncommitted speculative splits and renumber the batched
+        build into the K=1 oracle's numbering.
+
+        Runs ONCE per tree, outside the while loop, fully vectorized (no
+        per-split loop): oracle split i executed our split pop_split[i];
+        oracle leaf slot l holds item slot_item[l].  A leaf whose item we
+        speculatively split (pruned) is reconstructed from that split's
+        parent-snapshot nodemat rows; its speculative best-split columns
+        LM_BLCNT..LM_BROUT are zeroed (the oracle stores the candidate
+        children stats there, but nothing downstream of _unpack_state
+        reads them — only LM_BGAIN, which the snapshot preserves).
+        Output shapes match the K=1 path exactly: leafmat (NLF, L+1),
+        nodemat (NND, L), s = committed split count."""
+        L, K = self.L, self.frontier_k
+        MS = (L - 1) + (K - 1)
+        NI = 2 * MS + 2
+        nodes = self.max_splits
+        m = st["m"]
+        neg_inf = jnp.float32(-jnp.inf)
+        pos_inf = jnp.float32(jnp.inf)
+        it_split = st["it_split"]
+        it_oslot = st["it_oslot"]
+        ora_of = st["ora_of"]
+
+        # ---- leaves ----
+        lidx = jax.lax.iota(jnp.int32, L)
+        items = st["slot_item"][:L]
+        has = (lidx <= m) & (items >= 0)
+        itc = jnp.clip(items, 0, NI - 1)
+        slots = jnp.take(st["it_slot"], itc)
+        from_lm = jnp.take(st["leafmat"], slots, axis=1)      # (NLF, L)
+        par_j = jnp.clip((itc - 1) // 2, 0, MS)
+        par_pop = jnp.where(itc > 0, jnp.take(ora_of, par_j), -1)
+        par_side = jnp.where(itc > 0, (itc - 1) % 2, 0)
+        from_lm = from_lm.at[LM_PARENT].set(_i2f(par_pop)) \
+                         .at[LM_PSIDE].set(_i2f(par_side))
+        jw = jnp.take(it_split, itc)
+        jwc = jnp.clip(jw, 0, MS)
+        snap = jnp.take(st["nodemat"], jwc, axis=1)           # (NND_FR, L)
+        zer = jnp.zeros((L,), jnp.float32)
+        recon = jnp.stack([
+            snap[ND_START], snap[ND_CNTP], snap[ND_ICOUNT],
+            snap[ND_SUM_G], snap[ND_IWEIGHT], snap[ND_DEPTH],
+            jnp.full((L,), neg_inf), jnp.full((L,), pos_inf),
+            snap[ND_IVALUE], _i2f(par_pop), _i2f(par_side),
+            snap[ND_GAIN], snap[ND_FEATURE_ENUM], snap[ND_THRESHOLD],
+            snap[ND_DL], zer, zer, zer, zer, zer, zer, zer, zer,
+            snap[ND_IS_CAT],
+            _i2f(jnp.full((L,), -1, jnp.int32))])             # (NLF, L)
+        init_col = jnp.zeros((NLF, 1), jnp.float32) \
+            .at[LM_BGAIN].set(neg_inf).at[LM_CMIN].set(neg_inf) \
+            .at[LM_CMAX].set(pos_inf) \
+            .at[LM_PARENT].set(_i2f(jnp.int32(-1))) \
+            .at[LM_FORCED].set(_i2f(jnp.int32(-1)))
+        init_cols = jnp.broadcast_to(init_col, (NLF, L))
+        pruned = has & (jw >= 0)
+        lm_f = jnp.where(pruned[None, :], recon,
+                         jnp.where(has[None, :], from_lm, init_cols))
+        lm_f = jnp.concatenate([lm_f, init_col], axis=1)      # (NLF, L+1)
+
+        # ---- nodes ----
+        nidx = jax.lax.iota(jnp.int32, nodes)
+        jvec = st["pop_split"][:nodes]
+        nvalid = nidx < m
+        jc = jnp.clip(jvec, 0, MS)
+        ncols = jnp.take(st["nodemat"], jc, axis=1)           # (NND_FR, nodes)
+        cl = 1 + 2 * jc
+        cr = cl + 1
+        jl = jnp.take(it_split, cl)
+        jr = jnp.take(it_split, cr)
+        ol = jnp.take(ora_of, jnp.clip(jl, 0, MS))
+        orr = jnp.take(ora_of, jnp.clip(jr, 0, MS))
+        left_ptr = jnp.where((jl >= 0) & (ol >= 0), ol,
+                             -(jnp.take(it_oslot, cl) + 1))
+        right_ptr = jnp.where((jr >= 0) & (orr >= 0), orr,
+                              -(jnp.take(it_oslot, cr) + 1))
+        ncols = ncols.at[ND_LEFT].set(_i2f(left_ptr)) \
+                     .at[ND_RIGHT].set(_i2f(right_ptr))
+        nm_f = jnp.where(nvalid[None, :], ncols[:NND],
+                         jnp.zeros((NND, nodes), jnp.float32))
+        nm_f = jnp.concatenate(
+            [nm_f, jnp.zeros((NND, 1), jnp.float32)], axis=1)  # (NND, L)
+
+        drop = ("leafmat", "nodemat", "hist", "it_gain", "it_slot",
+                "it_split", "it_oslot", "avail", "u_item", "pop_split",
+                "ora_of", "slot_item", "made", "m", "best_cat_set",
+                "node_cat_set", "ring", "ring_live", "rslot")
+        out = {k: v for k, v in st.items() if k not in drop}
+        if getattr(self, "_frontier_debug", False):
+            # test-only introspection of the replay (tests/test_frontier)
+            out["frontier_debug"] = {k: st[k] for k in drop if k in st}
+        out["s"] = m
+        out["leafmat"] = lm_f
+        out["nodemat"] = nm_f
+        if self.has_categorical:
+            leaf_cs = jnp.take(st["best_cat_set"], slots, axis=0)
+            prn_cs = jnp.take(st["node_cat_set"], jwc, axis=0)
+            bcs = jnp.where(pruned[:, None], prn_cs,
+                            jnp.where(has[:, None], leaf_cs, False))
+            out["best_cat_set"] = jnp.concatenate(
+                [bcs, jnp.zeros((1, self.BF), jnp.bool_)], axis=0)
+            ncs = jnp.where(nvalid[:, None],
+                            jnp.take(st["node_cat_set"], jc, axis=0),
+                            False)
+            out["node_cat_set"] = jnp.concatenate(
+                [ncs, jnp.zeros((1, self.BF), jnp.bool_)], axis=0)
+        return out
 
     def _unpack_state(self, st: Dict[str, Any]) -> Dict[str, Any]:
         """Expand the packed leaf/node matrices back into the per-field

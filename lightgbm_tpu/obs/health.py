@@ -95,15 +95,26 @@ def enabled() -> bool:
     return _SESSION.mode != "off"
 
 
-def configure_from_config(cfg) -> HealthSession:
+def configure_from_config(cfg, from_model_load: bool = False,
+                          allow_rearm: bool = None) -> HealthSession:
     """Enable the session from a Config's ``health`` parameter
-    (upgrade-only; invalid values fail loudly)."""
+    (upgrade-only; invalid values fail loudly).  With
+    ``from_model_load=True`` re-arming is OPT-IN, exactly like the
+    telemetry session (see obs/telemetry.py configure_from_config)."""
     mode = str(getattr(cfg, "health", "off") or "off").strip().lower()
     if mode not in MODES:
         from ..utils import log
         log.fatal("health must be one of %s, got %r",
                   "|".join(MODES), mode)
     if mode != "off":
+        if from_model_load:
+            from . import telemetry as _tel
+            allowed = (_tel.rearm_on_load_allowed(cfg)
+                       if allow_rearm is None else allow_rearm)
+            if not allowed:
+                if _MODE_RANK[mode] > _MODE_RANK[_SESSION.mode]:
+                    _tel.warn_rearm_skipped("health", mode)
+                return _SESSION
         _SESSION.enable(mode)
     return _SESSION
 

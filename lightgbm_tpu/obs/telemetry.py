@@ -353,16 +353,58 @@ def enabled() -> bool:
     return _SESSION.mode != "off"
 
 
-def configure_from_config(cfg) -> Telemetry:
+_REARM_WARNED = {"telemetry": False, "health": False}
+
+
+def rearm_on_load_allowed(cfg) -> bool:
+    """Whether a MODEL-LOAD path may arm the process-wide obs sessions
+    from the loaded model's saved params.  Off by default: a model file
+    is data, and loading one should not silently turn on process-wide
+    bookkeeping.  Opt back in per-load (``obs_rearm_on_load=True``) or
+    process-wide (``LIGHTGBM_TPU_OBS_REARM_ON_LOAD=1``)."""
+    if bool(getattr(cfg, "obs_rearm_on_load", False)):
+        return True
+    env = os.environ.get("LIGHTGBM_TPU_OBS_REARM_ON_LOAD", "")
+    return env.strip().lower() not in ("", "0", "false", "no", "off")
+
+
+def warn_rearm_skipped(kind: str, mode: str) -> None:
+    """One-time (per kind, per process) notice that a loaded model
+    carried an armed obs mode which was NOT applied."""
+    if _REARM_WARNED.get(kind):
+        return
+    _REARM_WARNED[kind] = True
+    from ..utils import log
+    log.warning(
+        "loaded model was saved with %s=%s; the process-wide %s session "
+        "is NOT re-armed on load.  Pass obs_rearm_on_load=True (or set "
+        "LIGHTGBM_TPU_OBS_REARM_ON_LOAD=1) to opt in.  (warned once)",
+        kind, mode, kind)
+
+
+def configure_from_config(cfg, from_model_load: bool = False,
+                          allow_rearm: bool = None) -> Telemetry:
     """Enable the session from a Config's ``telemetry`` parameter
     (upgrade-only; invalid values fail loudly like any other bad
-    parameter)."""
+    parameter).  With ``from_model_load=True`` (the Booster model
+    file/string restore paths) re-arming is OPT-IN: the saved mode is
+    ignored with a one-time warning unless allowed.  ``allow_rearm``
+    overrides the cfg/env probe — the load paths pass the LOADING
+    call's opt-in, never the saved model's (a saved
+    ``obs_rearm_on_load`` must not re-enable itself)."""
     mode = str(getattr(cfg, "telemetry", "off") or "off").strip().lower()
     if mode not in MODES:
         from ..utils import log
         log.fatal("telemetry must be one of %s, got %r",
                   "|".join(MODES), mode)
     if mode != "off":
+        allowed = (rearm_on_load_allowed(cfg) if allow_rearm is None
+                   else allow_rearm)
+        if from_model_load and not allowed:
+            # only loud when it would actually have upgraded the session
+            if _MODE_RANK[mode] > _MODE_RANK[_SESSION.mode]:
+                warn_rearm_skipped("telemetry", mode)
+            return _SESSION
         _SESSION.enable(mode)
     return _SESSION
 

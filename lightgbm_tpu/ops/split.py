@@ -324,17 +324,25 @@ def find_best_split_fast(feat_hist: jnp.ndarray, ctx: SplitContext,
         jnp.where(mask_f, cnt_bin, z),
         jnp.where(mask_r, G, z), jnp.where(mask_r, H, z),
         jnp.where(mask_r, cnt_bin, z)])                       # (6, F, BF)
-    # prefix sums as ONE inclusive lower-triangular matmul on the MXU:
-    # XLA's cumsum lowering costs a log-depth pass cascade per operand,
-    # and the per-split cost here is op-bound.  f32 dot keeps integer
-    # counts exact below 2^24; g/h sums round differently from a serial
-    # scan by at most the usual f32 dot-product reassociation.
-    tri = (jax.lax.broadcasted_iota(jnp.int32, (BF, BF), 0) <=
-           jax.lax.broadcasted_iota(jnp.int32, (BF, BF), 1)
-           ).astype(jnp.float32)
-    cs = jax.lax.dot_general(
-        stacked, tri, (((2,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)                   # (6, F, BF)
+    if jax.default_backend() == "tpu":
+        # prefix sums as ONE inclusive lower-triangular matmul on the
+        # MXU: XLA's cumsum lowering costs a log-depth pass cascade per
+        # operand, and the per-split cost on TPU is op-DISPATCH-bound.
+        # f32 dot keeps integer counts exact below 2^24; g/h sums round
+        # differently from a serial scan by at most the usual f32
+        # dot-product reassociation.
+        tri = (jax.lax.broadcasted_iota(jnp.int32, (BF, BF), 0) <=
+               jax.lax.broadcasted_iota(jnp.int32, (BF, BF), 1)
+               ).astype(jnp.float32)
+        cs = jax.lax.dot_general(
+            stacked, tri, (((2,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # (6, F, BF)
+    else:
+        # off-TPU the triangular matmul is O(F*BF^2) of REAL work — it
+        # dominated the CPU host's per-iteration fixed cost (~44 MFLOP
+        # per split at F=28, BF=255: ~60% of the 65k-row iteration,
+        # PERF.md round 12) — where the log-depth cumsum is O(F*BF)
+        cs = jnp.cumsum(stacked, axis=2)                      # (6, F, BF)
 
     left_g_f = cs[0]
     left_h_f = cs[1] + K_EPSILON
@@ -751,3 +759,44 @@ def find_best_split(feat_hist: jnp.ndarray, ctx: SplitContext,
     if with_feature_gains:
         return best, feat_gain
     return best
+
+
+# ---------------------------------------------------------------------------
+# Frontier-batched growth: top-K leaf selection (models/learner.py)
+# ---------------------------------------------------------------------------
+def oracle_next_pick(gains, oracle_slots, avail):
+    """The K=1 oracle's next-leaf election over a frontier of candidate
+    items: maximum gain, ties broken by the SMALLEST oracle leaf slot —
+    exactly the first-max semantics of ``jnp.argmax`` over the oracle's
+    leaf-indexed gain row (the serial learner's selection at
+    models/learner.py ``body``).  Vectorized like the (feature, bin)
+    gain argmax above: one masked max + one masked min + one argmax.
+
+    Args: gains (I,) f32; oracle_slots (I,) i32 (valid where avail);
+    avail (I,) bool.  Returns (item, gain) of the elected candidate
+    (item is arbitrary-but-deterministic when nothing is available:
+    gains must be -inf there so the caller's gain check gates it).
+    """
+    masked = jnp.where(avail, gains, K_MIN_SCORE)
+    gmax = jnp.max(masked)
+    tie = avail & (masked == gmax)
+    big = jnp.int32(2 ** 30)
+    slot = jnp.min(jnp.where(tie, oracle_slots, big))
+    item = jnp.argmax(tie & (oracle_slots == slot)).astype(jnp.int32)
+    return item, gmax
+
+
+def frontier_topk(scores, required, k):
+    """Select the step's split batch: the ``required`` item (the oracle's
+    guaranteed-next split) plus the top-(k-1) remaining candidates by
+    score.  ``scores`` must already be ``-inf`` for non-candidates.
+    Returns (items (k,), ok (k,) validity mask); slot 0 is always the
+    required item (the caller masks its own validity)."""
+    required = jnp.asarray(required, jnp.int32)
+    if k == 1:
+        return required[None], jnp.ones((1,), jnp.bool_)
+    rest = scores.at[required].set(K_MIN_SCORE)
+    topv, topi = jax.lax.top_k(rest, k - 1)
+    sel = jnp.concatenate([required[None], topi.astype(jnp.int32)])
+    ok = jnp.concatenate([jnp.ones((1,), jnp.bool_), jnp.isfinite(topv)])
+    return sel, ok

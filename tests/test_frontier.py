@@ -1,0 +1,239 @@
+"""Frontier-batched tree growth (tpu_frontier_k, models/learner.py
+_build_tree_frontier): growing the top-K frontier leaves per while-loop
+step must produce trees BIT-IDENTICAL to the K=1 oracle — including at
+the num_leaves budget boundary, where the oracle-order replay prunes
+speculative splits and the tree-end undo pass restores the pruned
+ranges' physical row order (next-iteration f32 accumulation order).
+
+Order-dependent machinery (forced splits, monotone constraints, CEGB,
+extra_trees, bynode sampling, interaction constraints, parallel
+learners) must fall back to K=1 with a warning.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.dataset import BinnedDataset
+from lightgbm_tpu.models.learner import SerialTreeLearner
+
+
+def _data(seed=7, n=700, f=6, cat=False):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    if cat:
+        X[:, -1] = rng.randint(0, 10, size=n)
+    y = (X[:, 0] + 0.5 * np.sin(X[:, 1] * 2)
+         + 0.4 * rng.randn(n) > 0).astype(np.float64)
+    return X, y
+
+
+BASE = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+        "min_data_in_leaf": 5, "metric": ""}
+
+
+def _trees(bst):
+    """Model text minus the [param] dump (tpu_frontier_k legitimately
+    differs between the arms; the TREES must not)."""
+    return [ln for ln in bst.model_to_string().splitlines()
+            if not ln.startswith("[")]
+
+
+def _train(X, y, nbr=2, cat=False, **kw):
+    p = {**BASE, **kw}
+    if cat:
+        p["categorical_feature"] = [X.shape[1] - 1]
+    return lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=nbr)
+
+
+# ---------------------------------------------------------------------------
+# bit-identity matrix vs the K=1 oracle
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("extra,cat", [
+    ({}, False),                                              # plain
+    ({"bagging_fraction": 0.6, "bagging_freq": 1}, False),    # bagging
+    ({"data_sample_strategy": "goss"}, False),                # GOSS
+    ({"use_quantized_grad": True}, False),                    # quantized
+    ({}, True),                                               # categorical
+    ({"min_gain_to_split": 5.0}, False),                      # early stop
+    ({"lambda_l1": 0.5, "lambda_l2": 3.0,
+      "path_smooth": 1.0}, False),                            # regularized
+])
+def test_frontier_bitidentity(extra, cat):
+    X, y = _data(cat=cat)
+    b1 = _train(X, y, cat=cat, **extra)
+    bk = _train(X, y, cat=cat, tpu_frontier_k=3, **extra)
+    assert bk._gbdt.learner.frontier_k == 3
+    assert _trees(b1) == _trees(bk)
+    d = np.abs(np.asarray(b1.predict(X[:200]))
+               - np.asarray(bk.predict(X[:200]))).max()
+    assert float(d) == 0.0
+
+
+def test_frontier_budget_boundary_partial_steps():
+    """num_leaves budgets that do not divide by K force partial final
+    steps (k_step shrinks to the remaining budget); trees must still be
+    bit-identical, for several K including K > the frontier width of
+    the early tree."""
+    X, y = _data(seed=3)
+    for L, K in ((8, 5), (12, 4), (15, 7)):
+        b1 = _train(X, y, num_leaves=L)
+        bk = _train(X, y, num_leaves=L, tpu_frontier_k=K)
+        assert _trees(b1) == _trees(bk), (L, K)
+
+
+def test_frontier_multiclass_and_regression():
+    X, y = _data(seed=11)
+    ym = (np.abs(X[:, 0]) + X[:, 1] > 1).astype(float) + (X[:, 2] > 0)
+    for params, yy in ((
+            {"objective": "multiclass", "num_class": 3}, ym), (
+            {"objective": "regression"}, X[:, 0] + 0.3 * X[:, 1])):
+        p1 = {**BASE, **params}
+        b1 = lgb.train(p1, lgb.Dataset(X, label=yy), num_boost_round=2)
+        bk = lgb.train({**p1, "tpu_frontier_k": 4},
+                       lgb.Dataset(X, label=yy), num_boost_round=2)
+        assert _trees(b1) == _trees(bk), params["objective"]
+
+
+def test_frontier_eager_path():
+    X, y = _data(seed=5)
+    b1 = _train(X, y, tpu_fused_iteration=False)
+    bk = _train(X, y, tpu_fused_iteration=False, tpu_frontier_k=3)
+    assert _trees(b1) == _trees(bk)
+
+
+def test_frontier_mega_xla_interplay():
+    """The mega-kernel XLA-oracle path has no histogram state at all;
+    the frontier body must reuse its per-leaf both-children pass and
+    stay bit-identical to the K=1 mega learner."""
+    X, y = _data(seed=9)
+    b1 = _train(X, y, tpu_megakernel="xla")
+    bk = _train(X, y, tpu_megakernel="xla", tpu_frontier_k=3)
+    assert b1._gbdt.learner._use_mega == "xla"
+    assert bk._gbdt.learner._use_mega == "xla"
+    assert bk._gbdt.learner.frontier_k == 3
+    assert _trees(b1) == _trees(bk)
+
+
+@pytest.mark.slow
+def test_frontier_megakernel_interpret_interplay():
+    """Interpreter-mode Pallas mega-kernel under frontier batching:
+    the k-loop drives one mega program per selected leaf and trees stay
+    bit-identical to the K=1 mega learner (slow: interpreter)."""
+    X, y = _data(seed=13, n=600)
+    kw = {"tpu_kernel_interpret": True, "tpu_megakernel": "pallas",
+          "tpu_row_chunk": 256}
+    b1 = _train(X, y, nbr=1, **kw)
+    bk = _train(X, y, nbr=1, tpu_frontier_k=3, **kw)
+    assert b1._gbdt.learner._use_mega == "pallas"
+    assert bk._gbdt.learner._use_mega == "pallas"
+    assert _trees(b1) == _trees(bk)
+
+
+# ---------------------------------------------------------------------------
+# speculation/prune internals: the replay's invariants where pruning
+# actually engages
+# ---------------------------------------------------------------------------
+def test_frontier_prune_engages_and_stays_bitidentical():
+    """Noisy (bagged) gains at a binding budget make children outrank
+    speculative picks, so some speculative splits must be PRUNED
+    (made > committed); the replay bounds the overshoot by K-1 and the
+    renumber+undo passes keep the record bit-identical to the oracle."""
+    import jax.numpy as jnp
+    X, y = _data(seed=7, n=900)
+    g0 = (0.5 - y).astype(np.float32)
+    K = 4
+    pruned_seen = 0
+    for seed in range(6):
+        r2 = np.random.RandomState(seed)
+        mask = r2.rand(len(y)) < 0.55
+        grad = np.where(mask, g0, 0.0).astype(np.float32)
+        hess = np.where(mask, 0.25, 0.0).astype(np.float32)
+        recs = {}
+        for k in (1, K):
+            cfg = Config({**BASE, "num_leaves": 12, "tpu_frontier_k": k})
+            ds = BinnedDataset.from_matrix(X, cfg, label=y)
+            lr = SerialTreeLearner(ds, cfg)
+            lr._frontier_debug = True
+            recs[k] = lr.build_tree(jnp.asarray(grad), jnp.asarray(hess),
+                                    bag_cnt=int(mask.sum()))
+        a, b = recs[1], recs[K]
+        for field in ("s", "leaf_start", "leaf_cnt", "leaf_value",
+                      "leaf_sum_g", "leaf_sum_h", "best_gain",
+                      "node_feature", "node_threshold", "node_gain",
+                      "node_left", "node_right", "indices"):
+            assert np.array_equal(np.asarray(a[field]),
+                                  np.asarray(b[field])), (seed, field)
+        dbg = b["frontier_debug"]
+        made = int(np.asarray(dbg["made"]))
+        m = int(np.asarray(b["s"]))
+        assert made - m <= K - 1          # overshoot bound
+        pruned_seen += int(made > m)
+    assert pruned_seen > 0, \
+        "no seed engaged pruning: the boundary lane tests nothing"
+
+
+# ---------------------------------------------------------------------------
+# fallbacks and config plumbing
+# ---------------------------------------------------------------------------
+def _learner_for(params, X, y):
+    cfg = Config({**BASE, **params})
+    ds = BinnedDataset.from_matrix(X, cfg, label=y)
+    return SerialTreeLearner(ds, cfg)
+
+
+def test_frontier_fallbacks_to_k1(tmp_path):
+    X, y = _data()
+    forced = tmp_path / "forced.json"
+    forced.write_text(json.dumps({"feature": 0, "threshold": 0.0}))
+    fallback_params = [
+        {"monotone_constraints": "1,0,0,0,0,0"},
+        {"monotone_constraints": "1,0,0,0,0,0",
+         "monotone_constraints_method": "intermediate"},
+        {"forcedsplits_filename": str(forced)},
+        {"cegb_penalty_split": 0.1},
+        {"cegb_penalty_feature_lazy": "0.1,0.1,0.1,0.1,0.1,0.1"},
+        {"extra_trees": True},
+        {"feature_fraction_bynode": 0.5},
+        {"interaction_constraints": "[0,1],[2,3]"},
+    ]
+    for p in fallback_params:
+        lr = _learner_for({**p, "tpu_frontier_k": 4}, X, y)
+        assert lr.frontier_k == 1, p
+    # a fallback-engaged training equals the plain learner exactly
+    b1 = _train(X, y, monotone_constraints="1,0,0,0,0,0")
+    bk = _train(X, y, monotone_constraints="1,0,0,0,0,0",
+                tpu_frontier_k=4)
+    assert bk._gbdt.learner.frontier_k == 1
+    assert _trees(b1) == _trees(bk)
+
+
+def test_frontier_k_plumbing():
+    X, y = _data()
+    # auto on CPU stays 1 (compile-budget heuristic; README)
+    assert _learner_for({}, X, y).frontier_k == 1
+    assert _learner_for({"tpu_frontier_k": "auto"}, X, y).frontier_k == 1
+    # explicit K engages anywhere, capped at num_leaves - 1
+    assert _learner_for({"tpu_frontier_k": 6}, X, y).frontier_k == 6
+    assert _learner_for({"tpu_frontier_k": 99}, X, y).frontier_k == 14
+    assert _learner_for({"tpu_frontier_k": 1}, X, y).frontier_k == 1
+    with pytest.raises(ValueError):
+        _learner_for({"tpu_frontier_k": 0}, X, y)
+    with pytest.raises(ValueError):
+        _learner_for({"tpu_frontier_k": "bogus"}, X, y)
+
+
+def test_frontier_model_io_round_trip(tmp_path):
+    """Frontier-trained boosters save/load/predict like any other."""
+    X, y = _data(seed=21)
+    bk = _train(X, y, tpu_frontier_k=3)
+    p1 = np.asarray(bk.predict(X[:100]))
+    out = tmp_path / "m.txt"
+    bk.save_model(str(out))
+    b2 = lgb.Booster(model_file=str(out))
+    p2 = np.asarray(b2.predict(X[:100]))
+    np.testing.assert_array_equal(p1, p2)

@@ -85,6 +85,9 @@ def test_resume_parity_goss(rng, tmp_path):
     assert _norm(ref.model_to_string()) == _norm(resumed)
 
 
+@pytest.mark.slow  # 7.9 s: tier-1 window offender per
+# test_durations.json; the bagging/GOSS resume-parity tests keep fast
+# in-window representatives of the resume lane
 def test_resume_parity_eager_custom_objective(rng, tmp_path):
     """Parity on the eager path (callable objective disables fusion),
     with a validation set whose restored scores must also match."""
@@ -194,6 +197,9 @@ def test_checkpoint_history_delta_log(rng, tmp_path):
     assert len(state2.eval_history) == 12
 
 
+@pytest.mark.slow  # 6.0 s: tier-1 window offender per
+# test_durations.json; test_checkpoint_history_delta_log keeps a fast
+# in-window representative of the history-log lane
 def test_checkpoint_history_resume_truncates_stale_tail(rng, tmp_path):
     """A killed run leaves history lines past the resumed checkpoint;
     the first post-resume save must rewrite the log so the resumed

@@ -468,3 +468,72 @@ def test_prometheus_parser_rejects_the_old_nonconforming_shape():
     bad2 = 'm{name="a\nb"} 1\n'
     _, _, errors2 = parse_exposition(bad2)
     assert errors2
+
+
+# ---------------------------------------------------------------------------
+# model-load re-arm is OPT-IN (ISSUE-10 satellite)
+# ---------------------------------------------------------------------------
+def test_model_load_does_not_rearm_sessions(monkeypatch):
+    """Loading a model whose saved params carry telemetry/health=counters
+    must NOT silently arm the process-wide sessions; the skip warns once
+    and `obs_rearm_on_load=True` (or the env knob) opts back in."""
+    from lightgbm_tpu.obs import health as obs_health
+    from lightgbm_tpu.obs import telemetry as obs_tel
+
+    monkeypatch.delenv("LIGHTGBM_TPU_OBS_REARM_ON_LOAD", raising=False)
+    X, y = _data(n=600)
+    bst = _train(X, y)
+    s = bst.model_to_string() \
+        .replace("[telemetry: off]", "[telemetry: counters]") \
+        .replace("[health: off]", "[health: counters]")
+    assert "[telemetry: counters]" in s and "[health: counters]" in s
+
+    # force a clean slate (sessions are process-wide; other tests arm them)
+    obs.get().reset(mode="off")
+    obs_health.get().set_mode("off")
+    for k in obs_tel._REARM_WARNED:
+        obs_tel._REARM_WARNED[k] = False
+
+    try:
+        b2 = lgb.Booster(model_str=s)
+        assert obs.get().mode == "off"
+        assert obs_health.get().mode == "off"
+        # the one-time warning fired for both kinds
+        assert obs_tel._REARM_WARNED["telemetry"]
+        assert obs_tel._REARM_WARNED["health"]
+        assert np.asarray(b2.predict(X[:50])).shape == (50,)
+
+        # per-load opt-in re-arms — and must NOT leak into the re-saved
+        # model: a later plain load of that file stays un-armed
+        b3 = lgb.Booster(model_str=s,
+                         params={"obs_rearm_on_load": True})
+        assert obs.get().mode == "counters"
+        assert obs_health.get().mode == "counters"
+        resaved = b3.model_to_string()
+        obs.get().reset(mode="off")
+        obs_health.get().set_mode("off")
+        lgb.Booster(model_str=resaved)
+        assert obs.get().mode == "off"
+        assert obs_health.get().mode == "off"
+
+        # env opt-in re-arms too; falsy spellings do not
+        from lightgbm_tpu.config import Config
+        monkeypatch.setenv("LIGHTGBM_TPU_OBS_REARM_ON_LOAD", "FALSE")
+        assert not obs_tel.rearm_on_load_allowed(Config({}))
+        monkeypatch.setenv("LIGHTGBM_TPU_OBS_REARM_ON_LOAD", "1")
+        lgb.Booster(model_str=s)
+        assert obs.get().mode == "counters"
+        assert obs_health.get().mode == "counters"
+
+        # an ALREADY-armed session is untouched either way
+        # (upgrade-only): the pickle round-trip of a counters-trained
+        # booster keeps counting
+        monkeypatch.delenv("LIGHTGBM_TPU_OBS_REARM_ON_LOAD",
+                           raising=False)
+        obs.get().reset(mode="counters")
+        lgb.Booster(model_str=s)
+        assert obs.get().mode == "counters"
+    finally:
+        # leave the sessions off for whatever runs next
+        obs.get().reset(mode="off")
+        obs_health.get().set_mode("off")

@@ -233,6 +233,116 @@ def _drift_smoke(args):
         shutil.rmtree(work, ignore_errors=True)
 
 
+def _frontier_smoke(args):
+    """Frontier-batching A/B (`--frontier`): K=1 oracle vs
+    tpu_frontier_k=K at several row counts, asserting TREE BIT-IDENTITY
+    between the arms after every timed iteration, and reporting per-arm
+    per-iteration AFFINE FITS t(rows) = fixed + slope*rows — the
+    frontier win is the FIXED (row-independent, per-split bookkeeping)
+    term, so the headline number is the fixed-cost reduction.  Exits
+    non-zero on any tree mismatch or when the reduction undercuts
+    `--frontier-min-pct`."""
+    import jax.numpy as jnp
+    import lightgbm_tpu as lgb
+
+    rows_list = [int(r) for r in args.frontier_rows.split(",") if r]
+    if len(rows_list) < 2:
+        raise SystemExit("--frontier needs >= 2 row counts for the "
+                         "affine fit (--frontier-rows r1,r2[,...])")
+    K = args.frontier_k
+    base = {"objective": "binary", "num_leaves": args.frontier_leaves,
+            "learning_rate": 0.1, "max_bin": 255, "verbosity": -1,
+            "metric": ""}
+    arms = {"A": {**base, "tpu_frontier_k": 1},
+            "B": {**base, "tpu_frontier_k": K}}
+
+    def trees(bst):
+        return [ln for ln in bst.model_to_string().splitlines()
+                if not ln.startswith("[")]
+
+    def sync(bst):
+        return float(jnp.sum(bst._gbdt.scores))
+
+    per_rows = {}
+    mismatch = []
+    rng = np.random.RandomState(7)
+    for rows in rows_list:
+        X = rng.normal(size=(rows, args.features)).astype(np.float32)
+        w = rng.normal(size=args.features)
+        y = ((X.dot(w) * 0.5 + rng.normal(size=rows)) > 0
+             ).astype(np.float32)
+        ds = lgb.Dataset(X, label=y)
+        ds.construct(arms["A"])
+        boosters = {n: lgb.Booster(params=p, train_set=ds)
+                    for n, p in arms.items()}
+        for n in boosters:          # compile + settle
+            boosters[n].update()
+            sync(boosters[n])
+        times = {"A": [], "B": []}
+        for _ in range(args.frontier_blocks):
+            for n in ("A", "B"):
+                bst = boosters[n]
+                t0 = time.time()
+                for _ in range(args.frontier_iters):
+                    bst.update()
+                sync(bst)
+                times[n].append((time.time() - t0) / args.frontier_iters)
+        if trees(boosters["A"]) != trees(boosters["B"]):
+            mismatch.append(rows)
+        kb = boosters["B"]._gbdt.learner.frontier_k
+        per_rows[rows] = {
+            "A_s_per_iter": round(float(np.median(times["A"])), 5),
+            "B_s_per_iter": round(float(np.median(times["B"])), 5),
+            "A_mad": round(float(np.median(np.abs(
+                np.asarray(times["A"]) - np.median(times["A"])))), 5),
+            "B_mad": round(float(np.median(np.abs(
+                np.asarray(times["B"]) - np.median(times["B"])))), 5),
+            "trees_identical": rows not in mismatch,
+            "effective_k": int(kb),
+        }
+
+    rr = np.asarray(rows_list, np.float64)
+    ta = np.asarray([per_rows[r]["A_s_per_iter"] for r in rows_list])
+    tb = np.asarray([per_rows[r]["B_s_per_iter"] for r in rows_list])
+    slope_a, fixed_a = np.polyfit(rr, ta, 1)
+    slope_b, fixed_b = np.polyfit(rr, tb, 1)
+    red = 100.0 * (1.0 - fixed_b / fixed_a) if fixed_a > 0 else 0.0
+    report = {
+        "frontier_mode": True, "k": K, "leaves": args.frontier_leaves,
+        "features": args.features, "iters": args.frontier_iters,
+        "blocks": args.frontier_blocks,
+        "per_rows": per_rows,
+        "fit_A": {"fixed_s_per_iter": round(float(fixed_a), 5),
+                  "slope_s_per_mrow": round(float(slope_a * 1e6), 4)},
+        "fit_B": {"fixed_s_per_iter": round(float(fixed_b), 5),
+                  "slope_s_per_mrow": round(float(slope_b * 1e6), 4)},
+        "fixed_reduction_pct": round(float(red), 2),
+        "min_reduction_pct": args.frontier_min_pct,
+        "trees_identical": not mismatch,
+    }
+    report["kernels_B"] = {
+        "_use_mega": getattr(
+            boosters["B"]._gbdt.learner, "_use_mega", None),
+        "frontier_k": int(boosters["B"]._gbdt.learner.frontier_k),
+    }
+    print(json.dumps(report))
+    _write_obs(args, "ab_bench.frontier",
+               {"rows": rows_list, "k": K,
+                "leaves": args.frontier_leaves,
+                "iters": args.frontier_iters,
+                "blocks": args.frontier_blocks}, report)
+    problems = []
+    if mismatch:
+        problems.append(f"frontier trees NOT bit-identical to the K=1 "
+                        f"oracle at rows={mismatch}")
+    if args.frontier_min_pct is not None and red < args.frontier_min_pct:
+        problems.append(
+            f"fixed-cost reduction {red:.2f}% undercuts the "
+            f"{args.frontier_min_pct}% bar")
+    if problems:
+        raise SystemExit("--frontier: " + "; ".join(problems))
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--rows", type=int, default=1_000_000)
@@ -265,6 +375,29 @@ def main():
     ap.add_argument("--rollback-within", type=int, default=3,
                     help="--drift: ticks within which rollback must "
                     "fire after an injected post-swap regression")
+    ap.add_argument("--frontier", action="store_true",
+                    help="frontier-batching A/B: K=1 oracle vs "
+                    "tpu_frontier_k=K across --frontier-rows, asserting "
+                    "tree bit-identity and the fixed-cost reduction of "
+                    "the per-iter affine fits")
+    ap.add_argument("--frontier-rows", default="16384,65536",
+                    metavar="R1,R2[,..]",
+                    help="--frontier: row counts for the affine fit")
+    ap.add_argument("--frontier-k", type=int, default=4,
+                    help="--frontier: batch width of arm B")
+    ap.add_argument("--frontier-leaves", type=int, default=63,
+                    help="--frontier: num_leaves (own default: the "
+                    "bench-wide 255 is CPU-hostile)")
+    ap.add_argument("--frontier-iters", type=int, default=8,
+                    help="--frontier: iterations per timed block")
+    ap.add_argument("--frontier-blocks", type=int, default=3,
+                    help="--frontier: timed blocks per arm (interleaved)")
+    ap.add_argument("--frontier-min-pct", type=float, default=None,
+                    help="--frontier: minimum fixed-cost reduction %% to "
+                    "assert (exit non-zero below it; default: report "
+                    "only — on CPU hosts the fixed cost is padded-chunk "
+                    "compute, not the bookkeeping the batching "
+                    "amortizes, see PERF.md round 12)")
     ap.add_argument("--obs-out", default=None, metavar="PATH",
                     help="BENCH_obs.json artifact path (default: "
                     "$BENCH_OBS_PATH or ./BENCH_obs.json)")
@@ -281,6 +414,9 @@ def main():
         return
     if args.drift:
         _drift_smoke(args)
+        return
+    if args.frontier:
+        _frontier_smoke(args)
         return
 
     import jax.numpy as jnp
