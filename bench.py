@@ -359,6 +359,19 @@ def main():
         print("tpu_selfcheck:", "ALL OK" if "ALL OK" in tail else "skip",
               file=sys.stderr)
 
+    # export-on-failure guard: if the measured run dies below here, the
+    # BENCH_obs artifact (and its BENCH_history.jsonl trajectory entry)
+    # is still emitted with aborted=true, so a crashed round leaves
+    # machine-readable evidence instead of a missing file
+    from lightgbm_tpu.obs import benchio
+    with benchio.abort_guard(
+            "bench",
+            {"rows": ROWS, "features": FEATURES, "leaves": NUM_LEAVES,
+             "iters": ITERS, "repeats": REPEATS}) as obs_guard:
+        _bench_body(lgb, obs_guard)
+
+
+def _bench_body(lgb, obs_guard):
     tunnel = _dispatch_probe()
     blocks, warm, construct_s = _train_blocks(lgb, ROWS, ITERS, REPEATS)
     per_iter = float(np.median(blocks))
@@ -441,16 +454,17 @@ def main():
         "detail": detail,
     }))
 
-    # machine-readable perf artifact (schema: lightgbm-tpu/bench-obs/v1;
+    # machine-readable perf artifact (schema: lightgbm-tpu/bench-obs/v3;
     # path overridable via BENCH_OBS_PATH) — the PERF.md round gets a
-    # diffable companion with compile counts and memory peaks
-    from lightgbm_tpu.obs import benchio
-    path = benchio.write_bench_obs(
-        "bench",
-        {"rows": ROWS, "features": FEATURES, "leaves": NUM_LEAVES,
-         "iters": ITERS, "repeats": REPEATS},
+    # diffable companion with compile counts, memory peaks and a
+    # fingerprinted BENCH_history.jsonl trajectory entry that
+    # `tools/perfwatch.py check` gates future rounds against
+    path = obs_guard.write(
         {"per_iter_s": round(per_iter, 4),
-         "vs_baseline": round(vs_baseline, 4), "detail": detail})
+         "vs_baseline": round(vs_baseline, 4), "detail": detail},
+        metrics={"per_iter_s": per_iter, "vs_baseline": vs_baseline,
+                 "construct_s": construct_s, "warmup_compile_s": warm},
+        rows=ROWS, features=FEATURES)
     print(f"wrote {path}", file=sys.stderr)
 
 

@@ -33,6 +33,9 @@ would otherwise catch fail tier-1 instead:
 * ``health.off`` — same zero-HLO invariant for the model/data-health
   layer (flight recorder, skew digests): the lowered while-body is
   op-for-op identical with health off and at trace mode.
+* ``perfwatch.off`` — same zero-HLO invariant for the perf-trajectory
+  layer (obs/regress.py): lowering inside an active perfwatch
+  recording (injectable clock + BENCH_history append) changes nothing.
 
 Every metric is a ceiling checked against ``jaxlint_baseline.json``
 (see :mod:`lightgbm_tpu.analysis.baseline`).  All checks run on the
@@ -358,6 +361,59 @@ def check_health_off() -> Dict[str, int]:
 
 
 # ---------------------------------------------------------------------------
+# perfwatch zero-HLO invariant
+# ---------------------------------------------------------------------------
+def check_perfwatch_off() -> Dict[str, int]:
+    """The perf-trajectory layer (obs/regress.py) must never stage
+    device ops or syncs: the fused train step's lowered while-body is
+    OP-FOR-OP identical whether or not a perfwatch recording (clock +
+    BENCH_history append) is in flight around the lowering.  Same
+    contract as ``telemetry.off``: spans are host clock reads, the
+    store is a host JSONL append — every delta metric is an invariant
+    budgeted at 0."""
+    import os
+    import tempfile
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+    from ..obs import regress
+    from .hlo import body_counts
+
+    def lower_step():
+        rng = np.random.RandomState(17)
+        X = rng.normal(size=(512, 6))
+        y = X[:, 0] - 0.5 * X[:, 2] + 0.1 * rng.normal(size=len(X))
+        bst = lgb.Booster(params={"objective": "regression",
+                                  "verbosity": -1, "num_leaves": 15,
+                                  "min_data_in_leaf": 5, "metric": ""},
+                          train_set=lgb.Dataset(X, label=y))
+        g = bst._gbdt
+        assert g._fused_phys is not None, \
+            "perfwatch.off budget needs the fused physical step"
+        pb, ghi = g._init_phys(g.learner._part0, g.scores)
+        fmask = jnp.ones((g.learner.F,), dtype=bool)
+        feat_used = jnp.zeros((g.learner.F,), dtype=bool)
+        lowered = g._fused_phys.lower(pb, ghi, fmask, jnp.int32(1),
+                                      feat_used)
+        return lowered.compile().as_text()
+
+    off = body_counts(lower_step())
+    with tempfile.TemporaryDirectory() as td:
+        with regress.recording("jaxlint.perfwatch",
+                               path=os.path.join(td, "h.jsonl"),
+                               config={}):
+            on = body_counts(lower_step())
+    keys = set(off["ops"]) | set(on["ops"])
+    hist_delta = sum(abs(off["ops"].get(k, 0) - on["ops"].get(k, 0))
+                     for k in keys)
+    return {"body_op_histogram_delta": hist_delta,
+            "total_ops_delta": abs(off["total_ops"] - on["total_ops"]),
+            "copies_delta": abs(off["copies"] - on["copies"])}
+
+
+# ---------------------------------------------------------------------------
 # continual-runtime tick/swap budgets
 # ---------------------------------------------------------------------------
 def check_continual_tick() -> Dict[str, int]:
@@ -408,6 +464,7 @@ CHECKS = {
     "continual.tick": check_continual_tick,
     "telemetry.off": check_telemetry_off,
     "health.off": check_health_off,
+    "perfwatch.off": check_perfwatch_off,
 }
 
 

@@ -59,7 +59,8 @@ RULES: Dict[str, str] = {
 # loops run per split / per iteration / per serving call; JL003 covers
 # the modules that stage device programs; JL005 the collective layer.
 JL001_SCOPE = ("ops/", "models/learner.py", "models/serving.py",
-               "models/boosting.py", "models/metric.py", "continual/")
+               "models/boosting.py", "models/metric.py", "continual/",
+               "obs/regress.py")
 JL003_SCOPE = ("ops/", "models/learner.py", "models/serving.py",
                "models/shap.py")
 JL005_SCOPE = ("parallel/",)

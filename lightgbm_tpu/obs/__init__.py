@@ -16,9 +16,14 @@ NumPy oracle, PSI/chi-square skew scoring) and
 :mod:`lightgbm_tpu.obs.health` (the ``health=off|counters|trace``
 session, training flight recorder, training↔serving skew monitor,
 drift attribution) — read via ``Booster.health_report()``.
+
+Perf trajectory: :mod:`lightgbm_tpu.obs.regress` persists every
+benchmark as a fingerprinted ``BENCH_history.jsonl`` entry and judges
+new samples against same-fingerprint history (median/MAD, noise-aware)
+— ``tools/perfwatch.py`` is the check/report/drill CLI on top.
 """
 
-from . import digest, health, memory
+from . import digest, health, memory, regress
 from .exporters import (export_all, export_chrome_trace, export_jsonl,
                         export_prometheus, prometheus_text)
 from .telemetry import (MODES, NULL, Telemetry, compile_event,
@@ -28,7 +33,8 @@ from .telemetry import (MODES, NULL, Telemetry, compile_event,
 __all__ = [
     "MODES", "NULL", "Telemetry", "compile_event",
     "configure_from_config", "counter", "enabled", "gauge", "get",
-    "instant", "span", "digest", "health", "memory", "memory_snapshot",
+    "instant", "span", "digest", "health", "memory", "regress",
+    "memory_snapshot",
     "export_all", "export_chrome_trace", "export_jsonl",
     "export_prometheus", "prometheus_text",
 ]

@@ -5,22 +5,28 @@ run now also drops one structured artifact so rounds can be diffed,
 plotted and regression-checked by tooling.  One file per run (atomic
 write), schema::
 
-    {"schema": "lightgbm-tpu/bench-obs/v2",
+    {"schema": "lightgbm-tpu/bench-obs/v3",
      "tool": "bench" | "ab_bench" | ...,
      "unix_time": ..., "backend": "cpu"|"tpu"|...,
-     "config": {...},            # the knobs that shaped the run
-     "timings": {...},           # the tool's own timing report
-     "compile_counts": {...},    # telemetry compile events (key -> n)
-     "memory_peaks": {...},      # ledger owners + backend allocator stats
-     "health": {...}}            # v2: model/data-health section — digest
-                                 # overhead numbers, skew scores from the
-                                 # drift drill, flight-recorder summary
-                                 # (null when the run carried none)
+     "fingerprint": {...},        # v3: hardware/config identity
+                                  # (obs/regress.py — device kind/count,
+                                  # CPU cores, jax versions, x64, shape
+                                  # band, tpu_* knobs)
+     "aborted": false,            # v3: true when the measured tool died
+                                  # and the artifact records the wreck
+     "config": {...},             # the knobs that shaped the run
+     "timings": {...},            # the tool's own timing report
+     "compile_counts": {...},     # telemetry compile events (key -> n)
+     "memory_peaks": {...},       # ledger owners + backend allocator stats
+     "health": {...}}             # model/data-health section (null when
+                                  # the run carried none)
 
-Schema history: v1 had no ``health`` key; v2 adds it (always present,
-possibly null).  ``validate_bench_obs`` checks the v2 shape — the
-``ab_bench --drift`` lane asserts its health numbers and
-``trace_report --smoke`` validates the document structure.
+Schema history: v1 had no ``health`` key; v2 added it (always present,
+possibly null); v3 adds ``fingerprint`` + ``aborted`` and every write
+also APPENDS a compact entry to the ``BENCH_history.jsonl`` trajectory
+(:mod:`lightgbm_tpu.obs.regress`) so the measurement survives past the
+one-file artifact.  ``validate_bench_obs`` checks v3 and still accepts
+v2 documents (older artifacts stay readable).
 
 Path: ``--obs-out``/caller argument, else ``$BENCH_OBS_PATH``, else
 ``BENCH_obs.json`` in the working directory.
@@ -28,20 +34,23 @@ Path: ``--obs-out``/caller argument, else ``$BENCH_OBS_PATH``, else
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import time
 from typing import Any, Dict, List, Optional
 
 from . import memory as obs_memory
+from . import regress
 from . import telemetry as obs_telemetry
 from .exporters import _atomic_write
 
-SCHEMA = "lightgbm-tpu/bench-obs/v2"
+SCHEMA = "lightgbm-tpu/bench-obs/v3"
+SCHEMA_V2 = "lightgbm-tpu/bench-obs/v2"
 
-__all__ = ["SCHEMA", "default_path", "collect_compile_counts",
-           "collect_memory_peaks", "write_bench_obs",
-           "validate_bench_obs"]
+__all__ = ["SCHEMA", "SCHEMA_V2", "default_path",
+           "collect_compile_counts", "collect_memory_peaks",
+           "write_bench_obs", "validate_bench_obs", "abort_guard"]
 
 
 def default_path() -> str:
@@ -63,27 +72,47 @@ def collect_memory_peaks() -> Dict[str, Any]:
     return out
 
 
+def _auto_metrics(timings: Dict[str, Any]) -> Dict[str, float]:
+    """Fallback trajectory metrics: the numeric scalars at the top
+    level of the timings report (producers that care pass ``metrics``
+    explicitly)."""
+    return {k: float(v) for k, v in (timings or {}).items()
+            if isinstance(v, (int, float)) and not isinstance(v, bool)}
+
+
 def write_bench_obs(tool: str, config: Dict[str, Any],
                     timings: Dict[str, Any],
                     compile_counts: Optional[Dict[str, int]] = None,
                     memory_peaks: Optional[Dict[str, Any]] = None,
                     health: Optional[Dict[str, Any]] = None,
-                    path: Optional[str] = None) -> str:
-    """Write the artifact; never raises past a warning (a failed
-    artifact write must not sink a finished benchmark).  ``health``
-    is the v2 model/data-health section (skew scores, digest overhead
-    — see the module docstring); the key is always present so schema
-    consumers need no version branch."""
+                    path: Optional[str] = None,
+                    metrics: Optional[Dict[str, float]] = None,
+                    aborted: bool = False,
+                    rows: Optional[int] = None,
+                    features: Optional[int] = None,
+                    fingerprint_extra: Optional[Dict[str, Any]] = None,
+                    history_path: Optional[str] = None) -> str:
+    """Write the artifact AND append a fingerprinted entry to the
+    ``BENCH_history.jsonl`` trajectory; never raises past a warning (a
+    failed artifact write must not sink a finished benchmark).
+    ``metrics`` selects the scalars the trajectory tracks (default:
+    the numeric top level of ``timings``); ``aborted`` marks a run
+    whose measured tool died — the detector skips it, the evidence
+    persists."""
     try:
         import jax
         backend = jax.default_backend()
     except Exception:
         backend = "unknown"
+    fp = regress.fingerprint(config, rows=rows, features=features,
+                             extra=fingerprint_extra)
     doc = {
         "schema": SCHEMA,
         "tool": tool,
         "unix_time": round(time.time(), 3),
         "backend": backend,
+        "fingerprint": fp,
+        "aborted": bool(aborted),
         "config": config,
         "timings": timings,
         "compile_counts": (collect_compile_counts()
@@ -94,27 +123,79 @@ def write_bench_obs(tool: str, config: Dict[str, Any],
     }
     out = path or default_path()
     try:
-        return _atomic_write(out, json.dumps(doc, sort_keys=True,
-                                             default=str) + "\n")
+        out = _atomic_write(out, json.dumps(doc, sort_keys=True,
+                                            default=str) + "\n")
     except OSError as exc:
         from ..utils import log
         log.warning("could not write %s: %s", out, exc)
-        return out
+    try:
+        regress.append_entry(
+            tool, metrics if metrics is not None else _auto_metrics(timings),
+            config=config, fingerprint_doc=fp, aborted=aborted,
+            path=history_path)
+    except OSError as exc:
+        from ..utils import log
+        log.warning("could not append %s: %s",
+                    history_path or regress.default_path(), exc)
+    return out
+
+
+class _ObsGuard:
+    def __init__(self, tool: str, config: Dict[str, Any],
+                 path: Optional[str], history_path: Optional[str]):
+        self.tool = tool
+        self.config = config
+        self.path = path
+        self.history_path = history_path
+        self.written = False
+
+    def write(self, timings: Dict[str, Any], **kw: Any) -> str:
+        self.written = True
+        kw.setdefault("tool", self.tool)
+        kw.setdefault("config", self.config)
+        kw.setdefault("path", self.path)
+        kw.setdefault("history_path", self.history_path)
+        return write_bench_obs(kw.pop("tool"), kw.pop("config"),
+                               timings, **kw)
+
+
+@contextlib.contextmanager
+def abort_guard(tool: str, config: Dict[str, Any],
+                path: Optional[str] = None,
+                history_path: Optional[str] = None):
+    """Export-on-failure for BENCH_obs writers (the CLI telemetry
+    contract): if the measured block dies before ``guard.write(...)``
+    ran, an artifact with ``aborted: true`` and the error text is
+    emitted anyway — a crashed benchmark leaves evidence, not a missing
+    file — and the failure propagates unchanged (the tool's exit code
+    survives)."""
+    guard = _ObsGuard(tool, config, path, history_path)
+    try:
+        yield guard
+    except BaseException as exc:
+        if not guard.written:
+            guard.write({"error": f"{type(exc).__name__}: {exc}"[:300]},
+                        metrics={}, aborted=True)
+        raise
 
 
 def validate_bench_obs(doc: Dict[str, Any]) -> List[str]:
-    """Structural problems of a BENCH_obs document against schema v2
-    (empty list = valid).  Used by ``trace_report --smoke`` and the
-    ``ab_bench --drift`` lane so a malformed artifact fails loudly."""
+    """Structural problems of a BENCH_obs document against schema v3
+    (empty list = valid); v2 documents remain valid — the trajectory
+    predates the fingerprint and old artifacts must stay readable.
+    Used by ``trace_report --smoke``, the ``ab_bench --drift`` lane and
+    tests so a malformed artifact fails loudly."""
     problems: List[str] = []
-    if doc.get("schema") != SCHEMA:
-        problems.append(f"schema is {doc.get('schema')!r}, want {SCHEMA!r}")
+    schema = doc.get("schema")
+    if schema not in (SCHEMA, SCHEMA_V2):
+        problems.append(f"schema is {schema!r}, want {SCHEMA!r} "
+                        f"(or the still-readable {SCHEMA_V2!r})")
     for key, typ in (("tool", str), ("config", dict), ("timings", dict),
                      ("compile_counts", dict), ("memory_peaks", dict)):
         if not isinstance(doc.get(key), typ):
             problems.append(f"{key} missing or not a {typ.__name__}")
     if "health" not in doc:
-        problems.append("health key missing (v2 requires it, null ok)")
+        problems.append("health key missing (v2+ requires it, null ok)")
     elif doc["health"] is not None:
         h = doc["health"]
         if not isinstance(h, dict):
@@ -124,4 +205,16 @@ def validate_bench_obs(doc: Dict[str, Any]) -> List[str]:
             problems.append("health section carries none of the known "
                             "keys (skew_top / digest_overhead_pct / "
                             "flight_recorder / planted_rank)")
+    if schema == SCHEMA:
+        fp = doc.get("fingerprint")
+        if not isinstance(fp, dict):
+            problems.append("fingerprint missing or not an object "
+                            "(v3 requires it)")
+        else:
+            for k in ("device_kind", "device_count", "cpu_count",
+                      "x64", "shape_band", "knobs"):
+                if k not in fp:
+                    problems.append(f"fingerprint.{k} missing")
+        if not isinstance(doc.get("aborted", False), bool):
+            problems.append("aborted is not a boolean")
     return problems

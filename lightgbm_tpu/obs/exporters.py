@@ -36,6 +36,32 @@ def _atomic_write(path: str, text: str) -> str:
     return path
 
 
+def _atomic_append(path: str, line: str) -> str:
+    """Append ``line`` to an append-only log in ONE ``write`` syscall
+    through an ``O_APPEND`` descriptor — POSIX makes the offset bump +
+    write atomic, so concurrent writers (two bench processes, a pytest
+    session and a profile tool) interleave whole lines, never splice
+    them.  If the file's last byte is not a newline (a writer died
+    mid-write), a leading newline detaches this record from the torn
+    tail so only the torn line is lost, not both."""
+    path = os.path.abspath(path)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    payload = line if line.endswith("\n") else line + "\n"
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+    try:
+        try:
+            with open(path, "rb") as fh:
+                fh.seek(-1, os.SEEK_END)
+                if fh.read(1) not in (b"\n", b""):
+                    payload = "\n" + payload
+        except OSError:
+            pass                      # empty file: nothing to detach
+        os.write(fd, payload.encode("utf-8"))
+    finally:
+        os.close(fd)
+    return path
+
+
 def export_chrome_trace(tel, path: str) -> str:
     """Write ``path`` as a Chrome-trace JSON object (the
     ``traceEvents`` array format Perfetto also loads)."""

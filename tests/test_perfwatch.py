@@ -1,0 +1,412 @@
+"""Perf observatory (ISSUE-11): the BENCH_history.jsonl trajectory
+store, the hardware/config fingerprint, the noise-aware regression
+gate and the ``tools/perfwatch.py`` CLI on top.
+
+Covers the acceptance contract — the gate flags a planted 3x slowdown
+(rc != 0) and passes identical re-runs clean (rc == 0) — plus the
+concurrency/corruption envelope of an append-only store: torn-file
+recovery, two writers interleaving, and the v2 -> v3 BENCH_obs schema
+round-trip through ``validate_bench_obs``.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from lightgbm_tpu.obs import benchio, regress
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_perfwatch():
+    spec = importlib.util.spec_from_file_location(
+        "perfwatch", os.path.join(HERE, "tools", "perfwatch.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture
+def hist(tmp_path):
+    return str(tmp_path / "BENCH_history.jsonl")
+
+
+def _seed(hist_path, values, tool="t", metric="per_iter_s", config=None):
+    for v in values:
+        regress.append_entry(tool, {metric: v}, config=config,
+                             path=hist_path)
+
+
+# ---------------------------------------------------------------------------
+# fingerprint + schema v3
+# ---------------------------------------------------------------------------
+def test_fingerprint_stable_and_shape_banded():
+    cfg = {"num_leaves": 63, "tpu_row_chunk": 4096, "seed": 7,
+           "verbosity": -1}
+    a = regress.fingerprint(cfg, rows=70_000, features=28)
+    b = regress.fingerprint(cfg, rows=100_000, features=28)
+    assert regress.fingerprint_key(a) == regress.fingerprint_key(b), \
+        "70k and 100k rows share the 2^17 band"
+    c = regress.fingerprint(cfg, rows=200_000, features=28)
+    assert regress.fingerprint_key(a) != regress.fingerprint_key(c), \
+        "a different shape band must fork the series"
+    d = regress.fingerprint({**cfg, "tpu_row_chunk": 512}, rows=70_000,
+                            features=28)
+    assert regress.fingerprint_key(a) != regress.fingerprint_key(d), \
+        "a perf-relevant knob must fork the series"
+    e = regress.fingerprint({**cfg, "seed": 99}, rows=70_000,
+                            features=28)
+    assert regress.fingerprint_key(a) == regress.fingerprint_key(e), \
+        "perf-irrelevant params must NOT fork the series"
+    # the live identity is honest about this host
+    assert a["cpu_count"] == os.cpu_count()
+    assert a["backend"] == "cpu"
+    assert a["device_count"] >= 1
+
+
+def test_fingerprint_knob_alias_and_extra():
+    # bench.py/ab_bench.py record "leaves": it must fork the series
+    # exactly like "num_leaves" would
+    f63 = regress.fingerprint({"leaves": 63}, rows=1000)
+    f255 = regress.fingerprint({"leaves": 255}, rows=1000)
+    assert f63["knobs"]["num_leaves"] == 63
+    assert regress.fingerprint_key(f63) != regress.fingerprint_key(f255)
+    assert regress.fingerprint_key(f63) == regress.fingerprint_key(
+        regress.fingerprint({"num_leaves": 63}, rows=1000))
+    # experiment parameters (ab_bench per-arm overrides, frontier K)
+    # fork via `extra`
+    e1 = regress.fingerprint({}, rows=1000,
+                             extra={"b": {"tpu_megakernel": "xla"}})
+    e2 = regress.fingerprint({}, rows=1000,
+                             extra={"b": {"tpu_row_chunk": 512}})
+    assert regress.fingerprint_key(e1) != regress.fingerprint_key(e2)
+
+
+def test_bench_obs_v3_roundtrip_and_trajectory(tmp_path, hist):
+    obs_path = str(tmp_path / "BENCH_obs.json")
+    out = benchio.write_bench_obs(
+        "unit_bench", {"rows": 5000, "features": 10, "num_leaves": 31},
+        {"per_iter_s": 0.25, "note": "x"},
+        metrics={"per_iter_s": 0.25}, rows=5000, features=10,
+        path=obs_path, history_path=hist)
+    doc = json.load(open(out))
+    assert doc["schema"] == benchio.SCHEMA
+    assert benchio.validate_bench_obs(doc) == []
+    assert doc["aborted"] is False
+    assert doc["fingerprint"]["shape_band"]["rows"] == "2^13"
+    entries, skipped = regress.read_history(hist)
+    assert skipped == 0 and len(entries) == 1
+    ent = entries[0]
+    assert ent["metrics"] == {"per_iter_s": 0.25}
+    assert ent["fingerprint_key"] == regress.fingerprint_key(
+        doc["fingerprint"])
+
+
+def test_v2_documents_still_validate():
+    v2 = {"schema": benchio.SCHEMA_V2, "tool": "bench", "config": {},
+          "timings": {}, "compile_counts": {}, "memory_peaks": {},
+          "health": None}
+    assert benchio.validate_bench_obs(v2) == []
+    # v3 without a fingerprint is NOT valid
+    v3 = dict(v2, schema=benchio.SCHEMA)
+    assert any("fingerprint" in p
+               for p in benchio.validate_bench_obs(v3))
+    v3["fingerprint"] = regress.fingerprint({})
+    v3["aborted"] = True          # the validator accepts aborted docs
+    assert benchio.validate_bench_obs(v3) == []
+    assert any("schema" in p for p in benchio.validate_bench_obs(
+        {"schema": "lightgbm-tpu/bench-obs/v1"}))
+
+
+# ---------------------------------------------------------------------------
+# store robustness: torn files, concurrent writers
+# ---------------------------------------------------------------------------
+def test_torn_file_recovery(hist):
+    _seed(hist, [1.0, 1.1, 0.9])
+    # a writer died mid-record: half a JSON object, no trailing newline
+    with open(hist, "a") as fh:
+        fh.write('{"schema": "lightgbm-tpu/bench-history/v1", "tool"')
+    entries, skipped = regress.read_history(hist)
+    assert len(entries) == 3 and skipped == 1
+    # the next append detaches itself from the torn tail and survives
+    regress.append_entry("t", {"per_iter_s": 1.05}, path=hist)
+    entries, skipped = regress.read_history(hist)
+    assert len(entries) == 4 and skipped == 1
+    assert entries[-1]["metrics"]["per_iter_s"] == 1.05
+
+
+def test_foreign_and_blank_lines_skipped(hist):
+    _seed(hist, [2.0])
+    with open(hist, "a") as fh:
+        fh.write("\n")
+        fh.write('{"schema": "something-else", "metrics": {}}\n')
+        fh.write("not json at all\n")
+    entries, skipped = regress.read_history(hist)
+    assert len(entries) == 1 and skipped == 2
+
+
+def test_concurrent_appends_interleave_whole_lines(hist):
+    n_writers, per = 4, 40
+
+    def writer(i):
+        for j in range(per):
+            regress.append_entry(f"w{i}", {"wall_s": float(j)},
+                                 path=hist)
+
+    threads = [threading.Thread(target=writer, args=(i,))
+               for i in range(n_writers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    entries, skipped = regress.read_history(hist)
+    assert skipped == 0, "interleaved appends must never splice lines"
+    assert len(entries) == n_writers * per
+    per_tool = {}
+    for e in entries:
+        per_tool.setdefault(e["tool"], []).append(e["metrics"]["wall_s"])
+    # each writer's own records kept their order (O_APPEND semantics)
+    assert all(v == sorted(v) and len(v) == per
+               for v in per_tool.values())
+
+
+# ---------------------------------------------------------------------------
+# the noise-aware detector
+# ---------------------------------------------------------------------------
+def test_detector_warmup_never_flags(hist):
+    _seed(hist, [1.0, 10.0])      # 10x jump, but only 1 prior sample
+    findings = regress.evaluate(*_read(hist))
+    assert [f.status for f in findings] == ["warmup"]
+    assert not regress.regressions(findings)
+
+
+def _read(hist_path):
+    entries, _ = regress.read_history(hist_path)
+    return (entries,)
+
+
+def test_detector_noise_band_and_planted_slowdown(hist):
+    _seed(hist, [1.0, 1.02, 0.98, 1.01])
+    # within the floor: ok
+    regress.append_entry("t", {"per_iter_s": 1.05}, path=hist)
+    findings = regress.evaluate(*_read(hist))
+    assert [f.status for f in findings] == ["ok"]
+    # 3x: REGRESSED
+    regress.append_entry("t", {"per_iter_s": 3.0}, path=hist)
+    findings = regress.evaluate(*_read(hist))
+    assert [f.status for f in findings] == ["REGRESSED"]
+    assert len(regress.regressions(findings)) == 1
+    # the paired statistic is the median/MAD of the priors
+    f = findings[0]
+    assert f.median == pytest.approx(1.01, abs=0.02)
+    assert f.n_prior == 5
+
+
+def test_detector_direction_throughput_and_aborted(hist):
+    # throughput metric: LOWER is worse
+    for v in (100.0, 101.0, 99.0, 100.5):
+        regress.append_entry("t", {"contrib_rows_per_s": v}, path=hist)
+    regress.append_entry("t", {"contrib_rows_per_s": 30.0}, path=hist)
+    findings = regress.evaluate(*_read(hist))
+    assert [f.status for f in findings] == ["REGRESSED"]
+    # an aborted entry is kept in the file but excluded from the series
+    regress.append_entry("t", {"contrib_rows_per_s": 1.0}, path=hist,
+                         aborted=True)
+    entries, _ = regress.read_history(hist)
+    assert entries[-1]["aborted"] is True
+    assert regress.evaluate(entries)[0].value == 30.0
+    # unknown-direction metrics report but never gate
+    for v in (5.0, 5.0, 5.0, 5.0, 50.0):
+        regress.append_entry("t2", {"detect_tick": v}, path=hist)
+    f2 = [f for f in regress.evaluate(*_read(hist))
+          if f.metric == "detect_tick"][0]
+    assert f2.status == "ungated" and not f2.regressed
+    # zero-centered signed deltas (ab_bench paired_delta_s): the
+    # relative floor vanishes at median ~0, so sub-millisecond jitter
+    # would gate — delta metrics must never gate
+    for v in (-0.0002, 0.0001, 0.0003, -0.0001, 0.002):
+        regress.append_entry("t3", {"paired_delta_s": v}, path=hist)
+    f3 = [f for f in regress.evaluate(*_read(hist))
+          if f.metric == "paired_delta_s"][0]
+    assert f3.status == "ungated" and not f3.regressed
+
+
+def test_different_fingerprints_never_compared(hist):
+    # 4 fast runs in one shape band, then a "slow" run in another band:
+    # series are split by fingerprint, so nothing can regress
+    for v in (1.0, 1.0, 1.0, 1.0):
+        regress.append_entry("t", {"per_iter_s": v},
+                             config={"rows": 1000}, path=hist)
+    regress.append_entry("t", {"per_iter_s": 9.0},
+                         config={"rows": 10_000_000}, path=hist)
+    findings = regress.evaluate(*_read(hist))
+    by_status = sorted(f.status for f in findings)
+    assert by_status == ["ok", "warmup"]
+
+
+# ---------------------------------------------------------------------------
+# the CLI gate: rc contract + drill (acceptance)
+# ---------------------------------------------------------------------------
+def test_check_rc_contract_in_process(hist, capsys):
+    pw = _load_perfwatch()
+    _seed(hist, [1.0, 1.0, 1.0, 1.0])
+    assert pw.main(["check", "--history", hist]) == 0
+    # planted 3x slowdown -> rc != 0
+    regress.append_entry("t", {"per_iter_s": 3.0}, path=hist)
+    assert pw.main(["check", "--history", hist]) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSED" in out and "per_iter_s" in out
+    # a re-run of identical measurements passes clean again
+    regress.append_entry("t", {"per_iter_s": 1.0}, path=hist)
+    assert pw.main(["check", "--history", hist]) == 0
+
+
+def test_drill_in_process(hist, capsys):
+    pw = _load_perfwatch()
+    assert pw.main(["drill", "--history", hist]) == 0
+    last = capsys.readouterr().out.strip().splitlines()[-1]
+    rep = json.loads(last)
+    assert rep["detected"] is True
+    assert rep["clean_rc"] == 0 and rep["planted_rc"] != 0 \
+        and rep["rerun_rc"] == 0
+    # the drill's measurements came from the injected clock, not the
+    # host: every baseline sample is exactly dt
+    entries, _ = regress.read_history(hist)
+    assert entries[0]["metrics"]["wall_s"] == pytest.approx(0.1)
+    import time
+    assert regress._CLOCK is time.perf_counter       # restored
+
+
+def test_drill_scoped_to_own_series_on_shared_store(hist, capsys):
+    """A pre-existing regression in an UNRELATED series must neither
+    fail the drill nor be masked by it: the drill's internal checks
+    are scoped to its own perfwatch.drill entries."""
+    pw = _load_perfwatch()
+    _seed(hist, [1.0, 1.0, 1.0, 1.0, 5.0], tool="bench")   # regressed
+    assert pw.main(["check", "--history", hist]) == 1
+    assert pw.main(["drill", "--history", hist]) == 0
+    capsys.readouterr()
+    # the shared store still gates its own regression afterwards,
+    # and scoping by tool isolates the clean drill series
+    assert pw.main(["check", "--history", hist]) == 1
+    assert pw.main(["check", "--history", hist, "--tool",
+                    "perfwatch.drill"]) == 0
+    # a typo'd --tool must fail loudly, not gate nothing with rc 0
+    assert pw.main(["check", "--history", hist, "--tool",
+                    "no_such_tool"]) == 2
+
+
+def test_report_renders_trajectory(hist, capsys):
+    pw = _load_perfwatch()
+    _seed(hist, [1.0, 1.1, 0.9], tool="bench")
+    assert pw.main(["report", "--history", hist]) == 0
+    out = capsys.readouterr().out
+    assert "bench/per_iter_s" in out and "n=3" in out
+    assert pw.main(["report", "--history", hist, "--tool",
+                    "nonexistent"]) == 0
+
+
+def test_drill_cli_subprocess():
+    """The tier-1 smoke of the acceptance contract through the real
+    entry point: plants a 3x slowdown via clock injection, asserts
+    detection (rc != 0 inside), exits 0 only when the whole contract
+    holds."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(HERE, "tools", "perfwatch.py"),
+         "drill", "--scale", "3.0"],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stdout + r.stderr
+    rep = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rep["detected"] is True and rep["ok"] is True
+    assert rep["clean_rc"] == 0 and rep["planted_rc"] != 0
+
+
+# ---------------------------------------------------------------------------
+# export-on-failure + producer wiring
+# ---------------------------------------------------------------------------
+def test_abort_guard_emits_artifact_on_failure(tmp_path, hist):
+    obs_path = str(tmp_path / "BENCH_obs.json")
+    with pytest.raises(SystemExit):
+        with benchio.abort_guard("unit_bench", {"rows": 100},
+                                 path=obs_path, history_path=hist):
+            raise SystemExit("measured tool died")
+    doc = json.load(open(obs_path))
+    assert doc["aborted"] is True
+    assert "measured tool died" in doc["timings"]["error"]
+    assert benchio.validate_bench_obs(doc) == []
+    entries, _ = regress.read_history(hist)
+    assert len(entries) == 1 and entries[0]["aborted"] is True
+
+
+def test_abort_guard_keeps_real_artifact_on_late_failure(tmp_path,
+                                                         hist):
+    """A lane that measured, wrote its artifact and THEN failed its
+    assertion must keep the real (non-aborted) artifact — the
+    measurement finished; the gate didn't."""
+    obs_path = str(tmp_path / "BENCH_obs.json")
+    with pytest.raises(SystemExit):
+        with benchio.abort_guard("unit_bench", {"rows": 100},
+                                 path=obs_path,
+                                 history_path=hist) as guard:
+            guard.write({"per_iter_s": 0.5})
+            raise SystemExit("assertion after the artifact")
+    doc = json.load(open(obs_path))
+    assert doc["aborted"] is False
+    assert doc["timings"] == {"per_iter_s": 0.5}
+
+
+def test_profile_tools_append_fingerprinted_entries(tmp_path,
+                                                    monkeypatch,
+                                                    capsys):
+    """profile_construct --smoke (cheap at tiny sizes) appends a v3
+    fingerprinted trajectory entry — the producer-wiring acceptance
+    lane that is feasible in-window (bench.py/ab_bench wiring runs the
+    identical guard.write path and is pinned by the committed seed
+    trajectory)."""
+    hist = str(tmp_path / "h.jsonl")
+    monkeypatch.setenv("BENCH_HISTORY_PATH", hist)
+    monkeypatch.setenv("BENCH_OBS_PATH", str(tmp_path / "obs.json"))
+    spec = importlib.util.spec_from_file_location(
+        "profile_construct", os.path.join(HERE, "tools",
+                                          "profile_construct.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main(["--rows", "4000", "--features", "6"]) == 0
+    capsys.readouterr()
+    entries, skipped = regress.read_history(hist)
+    assert skipped == 0 and len(entries) == 1
+    ent = entries[0]
+    assert ent["tool"] == "profile_construct"
+    assert ent["metrics"]["vectorized_s"] > 0
+    assert ent["fingerprint"]["shape_band"]["rows"] == "2^12"
+    doc = json.load(open(tmp_path / "obs.json"))
+    assert benchio.validate_bench_obs(doc) == []
+
+
+def test_committed_seed_trajectory_is_valid_and_covers_producers():
+    """The repo's bench trajectory is non-empty (ISSUE-11 satellite):
+    the committed BENCH_history.jsonl parses clean, every entry carries
+    a v3 fingerprint, and the acceptance producers — bench.py,
+    ab_bench, and at least two profile_* tools — have real entries."""
+    path = os.path.join(HERE, "BENCH_history.jsonl")
+    entries, skipped = regress.read_history(path)
+    assert skipped == 0, "committed trajectory must parse clean"
+    assert entries, "committed trajectory must be non-empty"
+    tools = {e["tool"] for e in entries}
+    assert "bench" in tools
+    assert any(t.startswith("ab_bench") for t in tools)
+    assert len([t for t in tools if t.startswith("profile_")]) >= 2
+    for e in entries:
+        assert e["fingerprint_key"] == regress.fingerprint_key(
+            e["fingerprint"])
+        assert e["metrics"], "seed entries must carry metrics"
+    # report renders it, and the gate runs clean on the seed
+    text = regress.render_report(entries)
+    assert "bench/" in text
+    assert not regress.regressions(regress.evaluate(entries))

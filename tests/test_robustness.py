@@ -57,6 +57,9 @@ def _kill_and_resume(params, X, y, rounds, kill_at, valid=None):
 # ---------------------------------------------------------------------------
 # kill -> resume parity
 # ---------------------------------------------------------------------------
+@pytest.mark.slow  # 2.8 s: tier-1 window offender per
+# test_durations.json; test_resume_parity_goss keeps a fast in-window
+# representative of the kill->resume parity lane
 def test_resume_parity_bagging_fused(rng, tmp_path):
     """Kill at iteration 13 of 20, resume from the iteration-10
     checkpoint: model text must be byte-identical to an uninterrupted
@@ -86,8 +89,8 @@ def test_resume_parity_goss(rng, tmp_path):
 
 
 @pytest.mark.slow  # 7.9 s: tier-1 window offender per
-# test_durations.json; the bagging/GOSS resume-parity tests keep fast
-# in-window representatives of the resume lane
+# test_durations.json; test_resume_parity_goss keeps a fast in-window
+# representative of the resume lane
 def test_resume_parity_eager_custom_objective(rng, tmp_path):
     """Parity on the eager path (callable objective disables fusion),
     with a validation set whose restored scores must also match."""
@@ -228,6 +231,10 @@ def test_checkpoint_history_resume_truncates_stale_tail(rng, tmp_path):
     assert a == b
 
 
+@pytest.mark.slow  # 1.6 s: tier-1 window trim per test_durations.json;
+# test_checkpoint_history_delta_log keeps the fast in-window
+# representative of the history-format lane (the legacy v1 reader has
+# no other consumer in the window)
 def test_checkpoint_legacy_full_history_state_loads(rng, tmp_path):
     """format_version-1 checkpoints (full eval_history inline in
     state.json) must keep loading."""
@@ -410,6 +417,9 @@ def test_retry_with_backoff_does_not_retry_fatal():
 # ---------------------------------------------------------------------------
 # satellites riding this PR
 # ---------------------------------------------------------------------------
+@pytest.mark.slow  # 4.9 s: tier-1 window offender per
+# test_durations.json; tests/test_engine.py::test_early_stopping keeps
+# a fast in-window early-stopping representative
 def test_early_stopping_custom_train_name(rng):
     """A train set named anything but "training" must not drive early
     stopping, and its eval rows carry the user's name (ADVICE round 5:

@@ -67,11 +67,13 @@ def test_ab_bench_drift_lane(tmp_path):
     assert rec["health"]["skew_top"][0]["feature"] == \
         rec["health"]["planted_feature"]
     # ISSUE-8 satellite: the machine-readable perf artifact rides along
-    # (schema v2 since ISSUE-9: the health section is part of it)
+    # (schema v3 since ISSUE-11: hardware fingerprint + aborted flag)
     with open(obs_path) as fh:
         art = json.load(fh)
-    assert art["schema"] == "lightgbm-tpu/bench-obs/v2"
+    assert art["schema"] == "lightgbm-tpu/bench-obs/v3"
     assert art["tool"] == "ab_bench.drift"
+    assert art["aborted"] is False
+    assert art["fingerprint"]["backend"] == "cpu"
     assert art["timings"]["rollback_ok"] is True
     assert art["health"]["planted_rank"] == 1
     assert any(k.startswith("serving.") for k in art["compile_counts"])

@@ -50,19 +50,29 @@ def _parse_overrides(items):
     return out
 
 
-def _write_obs(args, tool, config, timings, health=None):
-    """Drop the machine-readable BENCH_obs.json artifact (ISSUE-8
-    satellite; schema v2 since ISSUE-9 adds the ``health`` section):
-    config + timings + the telemetry session's compile counts + memory
-    peaks, so perf rounds have diffable artifacts, not just PERF.md
-    prose."""
-    from lightgbm_tpu.obs import benchio
-    path = benchio.write_bench_obs(tool, config, timings, health=health,
-                                   path=args.obs_out)
+def _write_obs(guard, args, tool, config, timings, health=None,
+               metrics=None, rows=None, fingerprint_extra=None):
+    """Drop the machine-readable BENCH_obs.json artifact (schema v3:
+    hardware fingerprint + aborted flag) AND its BENCH_history.jsonl
+    trajectory entry through the mode's abort guard (a lane that dies
+    BEFORE writing still emits one with aborted=true): config +
+    timings + the telemetry session's compile counts + memory peaks,
+    so perf rounds have diffable, regression-gated artifacts, not just
+    PERF.md prose.  ``metrics`` names the scalars the trajectory
+    tracks per fingerprint; ``rows`` and ``fingerprint_extra`` let a
+    lane fingerprint what it actually measured (the frontier/drift
+    lanes do not train at the top-level --rows, and two different
+    override experiments must never share a series)."""
+    path = guard.write(timings, tool=tool, config=config, health=health,
+                       metrics=metrics,
+                       rows=rows if rows is not None
+                       else getattr(args, "rows", None),
+                       features=getattr(args, "features", None),
+                       fingerprint_extra=fingerprint_extra)
     print(f"wrote {path}", file=sys.stderr)
 
 
-def _fault_smoke(args):
+def _fault_smoke(args, guard):
     """Robustness-cost smoke (`--fault`): the checkpoint guard rails
     must stay under `--max-overhead-pct` of training wall-clock at the
     bench config, and kill+resume must land.  Two interleaved full
@@ -131,10 +141,15 @@ def _fault_smoke(args):
             "resumed_trees": int(bst.num_trees()),
         }
         print(json.dumps(report))
-        _write_obs(args, "ab_bench.fault",
+        _write_obs(guard, args, "ab_bench.fault",
                    {"rows": args.rows, "rounds": rounds,
                     "checkpoint_interval": interval},
-                   report)
+                   report,
+                   metrics={"base_train_s": t_base,
+                            "ckpt_train_s": t_ckpt,
+                            "resume_wallclock_s": resume_s},
+                   fingerprint_extra={"rounds": rounds,
+                                      "ckpt_interval": interval})
         if not report["overhead_ok"]:
             raise SystemExit(
                 f"--fault: checkpoint overhead {overhead_pct:.2f}% exceeds "
@@ -143,7 +158,7 @@ def _fault_smoke(args):
         shutil.rmtree(work, ignore_errors=True)
 
 
-def _drift_smoke(args):
+def _drift_smoke(args, guard):
     """Continual-runtime smoke (`--drift`): inject a covariate shift,
     assert the rollback watchdog fires within `--rollback-within` ticks
     of a forced post-swap regression AND that the restored model serves
@@ -152,7 +167,7 @@ def _drift_smoke(args):
     checkpoint, at most one compile per (kind, bucket) per swap); plus
     the ISSUE-9 health lane — the single-feature covariate-shift drill
     whose skew attribution must rank the planted feature #1, recorded
-    in the BENCH_obs.json v2 ``health`` section and asserted here."""
+    in the BENCH_obs.json ``health`` section and asserted here."""
     import shutil
     import tempfile
 
@@ -194,10 +209,12 @@ def _drift_smoke(args):
             "health": health,
         }
         print(json.dumps(report))
-        _write_obs(args, "ab_bench.drift",
+        _write_obs(guard, args, "ab_bench.drift",
                    {"rows_per_tick": args.drift_rows,
                     "rollback_within": args.rollback_within},
-                   report, health=health)
+                   report, health=health,
+                   metrics={"swap_latency_s": report["swap_latency_s"]},
+                   rows=args.drift_rows)
         problems = []
         if not report["detected_within_window"]:
             problems.append("regression not detected within the window")
@@ -218,7 +235,7 @@ def _drift_smoke(args):
                 "skew attribution ranked the planted feature "
                 f"#{health['planted_rank']} (feature "
                 f"{health['planted_feature']}), not #1")
-        # the artifact this lane just wrote must satisfy schema v2
+        # the artifact this lane just wrote must satisfy the schema
         obs_path = args.obs_out or benchio.default_path()
         try:
             with open(obs_path) as fh:
@@ -233,7 +250,7 @@ def _drift_smoke(args):
         shutil.rmtree(work, ignore_errors=True)
 
 
-def _frontier_smoke(args):
+def _frontier_smoke(args, guard):
     """Frontier-batching A/B (`--frontier`): K=1 oracle vs
     tpu_frontier_k=K at several row counts, asserting TREE BIT-IDENTITY
     between the arms after every timed iteration, and reporting per-arm
@@ -326,11 +343,19 @@ def _frontier_smoke(args):
         "frontier_k": int(boosters["B"]._gbdt.learner.frontier_k),
     }
     print(json.dumps(report))
-    _write_obs(args, "ab_bench.frontier",
+    _write_obs(guard, args, "ab_bench.frontier",
                {"rows": rows_list, "k": K,
                 "leaves": args.frontier_leaves,
                 "iters": args.frontier_iters,
-                "blocks": args.frontier_blocks}, report)
+                "blocks": args.frontier_blocks}, report,
+               metrics={"fixed_A_s": float(fixed_a),
+                        "fixed_B_s": float(fixed_b),
+                        "slope_A_s_per_mrow": float(slope_a * 1e6),
+                        "slope_B_s_per_mrow": float(slope_b * 1e6)},
+               rows=max(rows_list),
+               fingerprint_extra={"frontier_rows": rows_list,
+                                  "frontier_k": K,
+                                  "num_leaves": args.frontier_leaves})
     problems = []
     if mismatch:
         problems.append(f"frontier trees NOT bit-identical to the K=1 "
@@ -343,7 +368,7 @@ def _frontier_smoke(args):
         raise SystemExit("--frontier: " + "; ".join(problems))
 
 
-def main():
+def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--rows", type=int, default=1_000_000)
     ap.add_argument("--features", type=int, default=28)
@@ -401,24 +426,40 @@ def main():
     ap.add_argument("--obs-out", default=None, metavar="PATH",
                     help="BENCH_obs.json artifact path (default: "
                     "$BENCH_OBS_PATH or ./BENCH_obs.json)")
-    args = ap.parse_args()
+    args = ap.parse_args(argv)
 
     # telemetry at counters: the artifact records the run's compile
     # events and memory peaks alongside the timings (zero-HLO, and the
     # per-iteration span cost is noise vs the timed blocks)
     from lightgbm_tpu import obs
+    from lightgbm_tpu.obs import benchio
     obs.get().enable("counters")
 
-    if args.fault:
-        _fault_smoke(args)
-        return
-    if args.drift:
-        _drift_smoke(args)
-        return
-    if args.frontier:
-        _frontier_smoke(args)
-        return
+    mode = ("ab_bench.fault" if args.fault else
+            "ab_bench.drift" if args.drift else
+            "ab_bench.frontier" if args.frontier else "ab_bench")
+    # export-on-failure: a lane that dies mid-measurement still leaves
+    # an aborted BENCH_obs artifact + trajectory entry; lanes that
+    # wrote their artifact and THEN failed an assertion keep the real
+    # (non-aborted) artifact — the measurement finished, the gate
+    # didn't
+    with benchio.abort_guard(mode, {"rows": args.rows,
+                                    "features": args.features,
+                                    "leaves": args.leaves},
+                             path=args.obs_out) as guard:
+        if args.fault:
+            _fault_smoke(args, guard)
+            return
+        if args.drift:
+            _drift_smoke(args, guard)
+            return
+        if args.frontier:
+            _frontier_smoke(args, guard)
+            return
+        _ab_body(args, guard)
 
+
+def _ab_body(args, guard):
     import jax.numpy as jnp
     import lightgbm_tpu as lgb
 
@@ -513,13 +554,18 @@ def main():
             paired - delta_med))), 5),
     }
     print(json.dumps(report))
-    _write_obs(args, "ab_bench",
+    _write_obs(guard, args, "ab_bench",
                {"rows": args.rows, "features": args.features,
                 "leaves": args.leaves, "iters": args.iters,
                 "blocks": args.blocks,
                 "a_params": report["a_params"],
                 "b_params": report["b_params"]},
-               report)
+               report,
+               metrics={"A_median_s": sa["median_s_per_iter"],
+                        "B_median_s": sb["median_s_per_iter"],
+                        "paired_delta_s": delta_med},
+               fingerprint_extra={"a": report["a_params"],
+                                  "b": report["b_params"]})
 
 
 if __name__ == "__main__":

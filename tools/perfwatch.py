@@ -1,0 +1,211 @@
+"""perfwatch — the benchmark-trajectory regression gate.
+
+    python tools/perfwatch.py check            # rc!=0 on a confirmed
+                                               # regression in the latest
+                                               # same-fingerprint samples
+    python tools/perfwatch.py report           # render the trajectory
+                                               # per metric
+    python tools/perfwatch.py drill            # plant a 3x slowdown via
+                                               # clock injection, assert
+                                               # the gate detects it AND
+                                               # that identical re-runs
+                                               # pass clean (tier-1 smoke)
+
+Reads ``BENCH_history.jsonl`` (``--history`` / ``$BENCH_HISTORY_PATH``
+/ repo root), the append-only store every measurement producer feeds:
+``bench.py``, ``tools/ab_bench.py`` (all modes), the ``profile_*``
+tools and the pytest conftest duration artifact.  Entries are keyed by
+a hardware/config fingerprint (device kind & count, CPU cores, jax
+versions, x64, dataset shape band, ``tpu_*`` knobs), and ``check``
+compares only within a fingerprint: the exact paired median/MAD
+statistic PERF.md rounds 10–12 compute by hand, behind a
+``--min-samples`` warmup and a MAD/floor threshold so 2-core CPU noise
+does not false-alarm.  See :mod:`lightgbm_tpu.obs.regress`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from lightgbm_tpu.obs import regress
+
+
+def _detector_kw(args):
+    return {"min_samples": args.min_samples, "z": args.z,
+            "floor_pct": args.floor_pct}
+
+
+def cmd_check(args) -> int:
+    entries, skipped = regress.read_history(args.history)
+    if skipped:
+        print(f"# skipped {skipped} unparseable line(s) (torn tail / "
+              "foreign content)", file=sys.stderr)
+    if getattr(args, "tool", None):
+        unfiltered = len(entries)
+        entries = [e for e in entries
+                   if args.tool in str(e.get("tool", ""))]
+        if unfiltered and not entries:
+            # a typo'd --tool must not silently gate nothing and
+            # report success
+            print(f"no entries match --tool {args.tool!r} "
+                  f"({unfiltered} entries in the store)",
+                  file=sys.stderr)
+            return 2
+    if not entries:
+        print("trajectory is empty — run a bench/profile tool (or "
+              "perfwatch drill) to seed it", file=sys.stderr)
+        return 0
+    findings = regress.evaluate(entries, **_detector_kw(args))
+    bad = regress.regressions(findings)
+    shown = bad if args.quiet else findings
+    for f in shown:
+        print(f.to_json() if args.as_json else f.render())
+    n_gated = sum(1 for f in findings if f.direction != 0
+                  and f.status != "warmup")
+    print(f"# {len(findings)} series ({n_gated} gated), "
+          f"{len(bad)} regression(s)", file=sys.stderr)
+    return 1 if bad else 0
+
+
+def cmd_report(args) -> int:
+    entries, skipped = regress.read_history(args.history)
+    if skipped:
+        print(f"# skipped {skipped} unparseable line(s)",
+              file=sys.stderr)
+    print(regress.render_report(entries, metric_filter=args.metric,
+                                tool_filter=args.tool))
+    return 0
+
+
+def cmd_drill(args) -> int:
+    """Deterministic end-to-end exercise of the gate in a hermetic
+    store: baseline entries recorded on a fixed step clock, then one
+    entry recorded through a ``--scale``-times clock (the faultinject-
+    style planted slowdown — no sleeps, no host dependence).  The gate
+    must pass the identical baseline (rc 0), flag the planted slowdown
+    (rc != 0), and pass again once an identical re-run follows it.
+    Exit 0 only when all three hold."""
+    own_tmp = args.history is None
+    if own_tmp:
+        fd, hist = tempfile.mkstemp(prefix="perfwatch-drill-",
+                                    suffix=".jsonl")
+        os.close(fd)
+    else:
+        hist = args.history
+    # the drill's verdict is scoped to its OWN series: on a shared
+    # store (explicit --history) an unrelated pre-existing regression
+    # must not fail the drill, and the drill must not mask it either
+    check_args = argparse.Namespace(
+        history=hist, min_samples=args.min_samples, z=args.z,
+        floor_pct=args.floor_pct, as_json=False, quiet=True,
+        tool="perfwatch.drill")
+    dt = 0.1
+    config = {"drill": True, "scale": args.scale}
+    try:
+        try:
+            for _ in range(args.min_samples + 1):
+                regress.set_clock(regress.StepClock(dt))
+                with regress.recording("perfwatch.drill", path=hist,
+                                       config=config):
+                    pass
+            clean_rc = cmd_check(check_args)
+            # planted slowdown: same workload, clock scaled 3x
+            regress.set_clock(regress.scaled_clock(
+                args.scale, base=regress.StepClock(dt)))
+            with regress.recording("perfwatch.drill", path=hist,
+                                   config=config):
+                pass
+            planted_rc = cmd_check(check_args)
+            # identical re-run after the incident: back in the noise band
+            regress.set_clock(regress.StepClock(dt))
+            with regress.recording("perfwatch.drill", path=hist,
+                                   config=config):
+                pass
+            rerun_rc = cmd_check(check_args)
+        finally:
+            regress.set_clock(None)
+        ok = clean_rc == 0 and planted_rc != 0 and rerun_rc == 0
+        print(json.dumps({
+            "drill": True, "scale": args.scale, "history": hist,
+            "clean_rc": clean_rc, "planted_rc": planted_rc,
+            "rerun_rc": rerun_rc, "detected": planted_rc != 0,
+            "ok": ok}))
+        if not ok:
+            print("drill FAILED: the gate must pass identical "
+                  "measurements (rc 0) and flag the planted "
+                  f"{args.scale}x slowdown (rc != 0)", file=sys.stderr)
+            return 1
+        return 0
+    finally:
+        if own_tmp and os.path.exists(hist):
+            os.unlink(hist)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.split("\n")[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    def common(p):
+        p.add_argument("--history", default=None, metavar="PATH",
+                       help="trajectory store (default: "
+                       "$BENCH_HISTORY_PATH or <repo>/BENCH_history"
+                       ".jsonl)")
+        p.add_argument("--min-samples", type=int,
+                       default=regress.MIN_SAMPLES,
+                       help="prior same-fingerprint runs required "
+                       "before a metric can regress (noise warmup)")
+        p.add_argument("--z", type=float, default=regress.Z_SCORE,
+                       help="MAD z-score multiplier of the change "
+                       "threshold")
+        p.add_argument("--floor-pct", type=float,
+                       default=regress.FLOOR_PCT,
+                       help="relative change floor %% (keeps zero-MAD "
+                       "histories from flagging on jitter)")
+
+    pc = sub.add_parser("check", help="gate the latest samples; "
+                        "rc!=0 on confirmed regression")
+    common(pc)
+    pc.add_argument("--json", action="store_true", dest="as_json")
+    pc.add_argument("--quiet", action="store_true",
+                    help="print only regressions")
+    pc.add_argument("--tool", default=None,
+                    help="substring filter on tool names (gate one "
+                    "producer's series only)")
+    pc.set_defaults(fn=cmd_check)
+
+    pr = sub.add_parser("report", help="render the trajectory per "
+                        "metric")
+    common(pr)
+    pr.add_argument("--metric", default=None,
+                    help="substring filter on metric names")
+    pr.add_argument("--tool", default=None,
+                    help="substring filter on tool names")
+    pr.set_defaults(fn=cmd_report)
+
+    pd = sub.add_parser("drill", help="plant a known slowdown via "
+                        "clock injection and assert detection")
+    common(pd)
+    pd.add_argument("--scale", type=float, default=3.0,
+                    help="planted slowdown factor")
+    pd.set_defaults(fn=cmd_drill)
+    pd.description = ("The drill appends its own perfwatch.drill "
+                      "entries: with an explicit --history they stay "
+                      "in that store (its checks are scoped to the "
+                      "drill's series); by default a temp file is "
+                      "used and removed.")
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

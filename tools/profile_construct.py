@@ -110,7 +110,7 @@ def run_cell(rows: int, features: int):
     }, parity
 
 
-def main():
+def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--smoke", action="store_true",
                     help="seconds-sized grid for tier-1")
@@ -118,7 +118,7 @@ def main():
                     help="comma-separated row counts (overrides grid)")
     ap.add_argument("--features", type=str, default="",
                     help="comma-separated feature counts")
-    args = ap.parse_args()
+    args = ap.parse_args(argv)
 
     if args.rows or args.features:
         rows = [int(r) for r in (args.rows or "100000").split(",")]
@@ -131,18 +131,33 @@ def main():
                 (1_000_000, 20), (1_000_000, 100)]
 
     import jax
-    cells = []
-    parity_ok = True
-    for rows, features in grid:
-        cell, parity = run_cell(rows, features)
-        parity_ok = parity_ok and parity
-        cells.append(cell)
-        print(f"# {rows}x{features}: host {cell['host_loop_s']}s "
-              f"vec {cell['vectorized_s']}s "
-              f"({cell['speedup']}x) device-map {cell['device_map_s']}",
-              file=sys.stderr)
-    rec = {"grid": cells, "parity_ok": bool(parity_ok),
-           "backend": jax.default_backend(), "smoke": bool(args.smoke)}
+
+    from lightgbm_tpu.obs import benchio
+    big_rows, big_feats = max(grid)
+    cfg = {"rows": big_rows, "features": big_feats,
+           "cells": len(grid), "smoke": bool(args.smoke)}
+    # export-on-failure guard: a crashed cell still drops an aborted
+    # BENCH_obs artifact + BENCH_history.jsonl trajectory entry
+    with benchio.abort_guard("profile_construct", cfg) as guard:
+        cells = []
+        parity_ok = True
+        for rows, features in grid:
+            cell, parity = run_cell(rows, features)
+            parity_ok = parity_ok and parity
+            cells.append(cell)
+            print(f"# {rows}x{features}: host {cell['host_loop_s']}s "
+                  f"vec {cell['vectorized_s']}s "
+                  f"({cell['speedup']}x) device-map "
+                  f"{cell['device_map_s']}", file=sys.stderr)
+        rec = {"grid": cells, "parity_ok": bool(parity_ok),
+               "backend": jax.default_backend(), "smoke": bool(args.smoke)}
+        big = [c for c in cells
+               if (c["rows"], c["features"]) == (big_rows, big_feats)][0]
+        guard.write(rec,
+                    metrics={"vectorized_s": big["vectorized_s"],
+                             "host_loop_s": big["host_loop_s"],
+                             "construct_speedup": big["speedup"] or 0.0},
+                    rows=big_rows, features=big_feats)
     print(json.dumps(rec))
     return 0 if parity_ok else 1
 

@@ -154,7 +154,20 @@ def main(argv=None):
         args.rows = min(args.rows, 512)
         args.features = min(args.features, 6)
         args.ticks = min(args.ticks, 6)
-    detail = run(args.rows, args.features, args.ticks, args.smoke)
+    from lightgbm_tpu.obs import benchio
+    cfg = {"rows": args.rows, "features": args.features,
+           "ticks": args.ticks, "smoke": bool(args.smoke)}
+    # export-on-failure guard: a crashed drill still drops an aborted
+    # BENCH_obs artifact + BENCH_history.jsonl trajectory entry
+    with benchio.abort_guard("profile_continual", cfg) as guard:
+        detail = run(args.rows, args.features, args.ticks, args.smoke)
+        guard.write(detail,
+                    metrics={"tick_ms": detail["tick"]["tick_ms"],
+                             "predict_only_ms":
+                                 detail["tick"]["predict_only_ms"],
+                             "swap_latency_ms":
+                                 detail["swap_latency_ms"]},
+                    rows=args.rows, features=args.features)
     print(json.dumps({"metric": "continual", "detail": detail}))
     if args.smoke:
         bad = check(detail)

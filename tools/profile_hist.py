@@ -79,10 +79,20 @@ def run(name, fn):
     t0 = time.time()
     float(many(bins, ghi))
     wall = time.time() - t0 - 0.105
-    print(f"{name:12s} per-pass={wall / REPS * 1e3:.2f} ms/Mrow-pass")
+    per_pass_ms = wall / REPS * 1e3
+    print(f"{name:12s} per-pass={per_pass_ms:.2f} ms/Mrow-pass")
+    return per_pass_ms
 
 
 if __name__ == "__main__":
     print(f"N={N} reps={REPS} {jax.devices()}")
-    run("current", variant_current)
-    run("fusedgen", variant_fusedgen)
+    from lightgbm_tpu.obs import benchio
+    # trajectory wiring: one fingerprinted entry per run (aborted=true
+    # if a variant dies, e.g. off-TPU), so on-hardware rounds of this
+    # harness are regression-gated like every other producer
+    with benchio.abort_guard("profile_hist",
+                             {"rows": N, "reps": REPS}) as guard:
+        metrics = {f"{name}_per_pass_ms": run(name, fn)
+                   for name, fn in (("current", variant_current),
+                                    ("fusedgen", variant_fusedgen))}
+        guard.write(dict(metrics), metrics=metrics, rows=N)

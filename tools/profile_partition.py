@@ -108,11 +108,20 @@ def run(C, variant):
 
 if __name__ == "__main__":
     print(f"N={N} reps={REPS} device={jax.devices()}")
-    for C in (4096, 2048, 8192):
-        for variant in ("full", "onenet", "nonet", "radix", "mega",
-                        "mega-radix"):
-            try:
-                run(C, variant)
-            except Exception as e:
-                print(f"C={C} variant={variant} FAILED: "
-                      + str(e).split(chr(10))[0][:100])
+    from lightgbm_tpu.obs import benchio
+    # trajectory wiring: one fingerprinted entry per run with every
+    # surviving (chunk, variant) cell as a gated `_us` metric, so
+    # on-hardware rounds of this harness are regression-gated too
+    with benchio.abort_guard("profile_partition",
+                             {"rows": N, "reps": REPS}) as guard:
+        metrics = {}
+        for C in (4096, 2048, 8192):
+            for variant in ("full", "onenet", "nonet", "radix", "mega",
+                            "mega-radix"):
+                try:
+                    metrics[f"C{C}_{variant}_per_chunk_us"] = \
+                        run(C, variant)
+                except Exception as e:
+                    print(f"C={C} variant={variant} FAILED: "
+                          + str(e).split(chr(10))[0][:100])
+        guard.write(dict(metrics), metrics=metrics, rows=N)

@@ -115,8 +115,22 @@ def main(argv=None):
         args.rows = min(args.rows, 3000)
         args.trees = min(args.trees, 10)
         args.host_oracle_rows = min(args.host_oracle_rows, 200)
-    out = run(args.rows, args.trees, args.features, args.smoke,
-              args.host_oracle_rows)
+    from lightgbm_tpu.obs import benchio
+    cfg = {"rows": args.rows, "trees": args.trees,
+           "features": args.features, "smoke": bool(args.smoke)}
+    # export-on-failure guard: a crashed harness still drops an aborted
+    # BENCH_obs artifact + BENCH_history.jsonl trajectory entry
+    with benchio.abort_guard("profile_predict", cfg) as guard:
+        out = run(args.rows, args.trees, args.features, args.smoke,
+                  args.host_oracle_rows)
+        top = out["detail"]["grid"][-1]
+        guard.write(out["detail"],
+                    metrics={"raw_rows_per_s": top["raw_rows_per_s"],
+                             "contrib_rows_per_s":
+                                 top["contrib_rows_per_s"],
+                             "raw_warm_s": top["raw_warm_s"],
+                             "contrib_warm_s": top["contrib_warm_s"]},
+                    rows=args.rows, features=args.features)
     print(json.dumps(out))
     # non-zero exit when the compile-count invariant is violated, so the
     # smoke lane fails loudly on a retrace regression

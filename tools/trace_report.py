@@ -24,7 +24,7 @@ differ (they usually don't: every rank reports its own os.getpid).
 the spans an operator needs are all present — ``continual.tick`` /
 ``continual.retrain`` / ``continual.swap`` / ``continual.rollback`` —
 plus at least one runtime compile event and the ``health.drift``
-attribution mark, and validates a BENCH_obs.json v2 artifact
+attribution mark, and validates a BENCH_obs.json v3 artifact
 round-trip (schema + health section).
 """
 
@@ -240,14 +240,19 @@ def smoke(rows: int) -> int:
         if "health.drift" not in summary["marks"]:
             problems.append("health.drift attribution mark missing "
                             "from the trace")
-        # BENCH_obs v2 round trip: write an artifact carrying the
-        # drill's health section, read it back, validate the schema
+        # BENCH_obs round trip (schema v3 since ISSUE-11): write an
+        # artifact carrying the drill's health section, read it back,
+        # validate the schema
         obs_path = os.path.join(work, "BENCH_obs.json")
         benchio.write_bench_obs(
             "trace_report.smoke", {"rows": rows},
             {"swap_tick": swap.get("swap_tick"),
              "rollback_tick": roll.get("rollback_tick")},
-            health={"skew_top": skew_top}, path=obs_path)
+            health={"skew_top": skew_top}, path=obs_path,
+            # a validation smoke is not a bench round: keep its
+            # trajectory entry in the same scratch dir, never in the
+            # committed BENCH_history.jsonl
+            history_path=os.path.join(work, "BENCH_history.jsonl"))
         try:
             with open(obs_path) as fh:
                 doc = json.load(fh)
