@@ -430,6 +430,29 @@ class Booster:
             log.fatal("Booster requires train_set, model_file or model_str")
 
     # ------------------------------------------------------------------
+    @classmethod
+    def _shell_for_gbdt(cls, gbdt) -> "Booster":
+        """A fully-attribute-initialized Booster wrapping an EXISTING
+        GBDT without training or loading anything — the serializer
+        entry point for code that holds a bare GBDT (a standalone
+        ``ServingEngine.__getstate__`` snapshotting its forest as a
+        model string).  Keeps the attribute surface in ONE place: any
+        instance attribute ``model_to_string`` (or what it calls) may
+        read must be set here, matching ``__init__``."""
+        shell = cls.__new__(cls)
+        shell.params = {}
+        shell.config = gbdt.config
+        shell._gbdt = gbdt
+        shell.train_set = None
+        shell.best_iteration = -1
+        shell.best_score = {}
+        shell._valid_names = []
+        shell._valid_sets = []
+        shell._train_data_name = "training"
+        shell.pandas_categorical = None
+        return shell
+
+    # ------------------------------------------------------------------
     # pickle / deepcopy: the GBDT holds jitted closures (fused step,
     # traversal, the serving engine's compiled predictors) that cannot
     # pickle, so — like the reference python-package Booster, which

@@ -2,13 +2,16 @@
 
 TPU-native re-implementation of the reference CLI (src/main.cpp,
 src/application/application.{h,cpp}): `key=value` argv plus a `config=` file,
-tasks train | predict | convert_model | refit | save_binary, plus the
-framework's own `continual` task — a deterministic drift drill through
-the continual-training runtime (lightgbm_tpu/continual/): drift is
-injected at a chosen tick, the regression must be detected, a
+tasks train | predict | convert_model | refit | save_binary, plus two
+framework-native tasks: `continual` — a deterministic drift drill
+through the continual-training runtime (lightgbm_tpu/continual/):
+drift is injected at a chosen tick, the regression must be detected, a
 background retrain (killed once and resumed from checkpoint) hot-swaps
 in, and a forced post-swap regression rolls back — the operator's
-rehearsal that every continual failure path works on THIS install.
+rehearsal that every continual failure path works on THIS install;
+and `serve` — the production serving plane (lightgbm_tpu/serving/):
+coalescing micro-batcher, multi-model registry with hot-swap/rollback,
+per-tenant admission control, stdlib HTTP.
 
 Usage:  python -m lightgbm_tpu task=train config=train.conf [key=value ...]
 """
@@ -96,6 +99,8 @@ class Application:
                 self.save_binary()
             elif task == "continual":
                 self.continual()
+            elif task == "serve":
+                self.serve()
             else:
                 log.fatal("Unknown task: %s", task)
         finally:
@@ -338,6 +343,17 @@ class Application:
         log.info("continual drill passed: detection, checkpointed "
                  "retrain, guarded swap, degradation and rollback all "
                  "exercised")
+
+    def serve(self) -> None:
+        """Run the production serving plane (lightgbm_tpu/serving/):
+        coalescing micro-batcher over the device ServingEngine,
+        multi-model registry with hot-swap/rollback endpoints, and
+        per-tenant admission control, behind a stdlib HTTP server.
+        Models: ``serve_models=name=path[,...]`` or ``input_model=``
+        (published as ``default``); see the ``serve_*`` parameter
+        family and README "Serving service"."""
+        from .serving.httpd import run_serve_task
+        run_serve_task(self.config)
 
     def save_binary(self) -> None:
         cfg = self.config

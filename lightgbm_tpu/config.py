@@ -207,6 +207,53 @@ _PARAMS: List[_Param] = [
     # tick metric: auto (from the objective) | l2 | binary_logloss |
     # multi_logloss — lower is better, computed on the host
     _p("continual_metric", "auto", str),
+    # overall retry deadline (seconds of backoff_schedule budget) for a
+    # retrain cycle; 0 = attempts alone bound it.  Consumed by
+    # robustness/retry.py backoff_schedule(deadline=) — the schedule
+    # truncates where the budget runs out, so a retrain degrades to
+    # last-good ON TIME instead of sleeping past its usefulness
+    _p("continual_retrain_deadline", 0.0, float, (), ">=0.0"),
+    # --- Serving service (lightgbm_tpu/serving/) ---
+    # `lightgbm_tpu serve`: coalescing micro-batcher + multi-model
+    # registry + per-tenant admission control over the ServingEngine.
+    # See README "Serving service".
+    _p("serve_host", "127.0.0.1", str),
+    _p("serve_port", 8080, int, (), ">=0"),
+    # resident models at startup: "name=path[,name=path...]"; falls
+    # back to input_model= published as "default"
+    _p("serve_models", "", str),
+    # micro-batcher: flush a coalescing lane at this many pending rows
+    # (pick one of the engine's power-of-two buckets) ...
+    _p("serve_flush_rows", 256, int, (), ">0"),
+    # ... or once its oldest request has waited this long (ms)
+    _p("serve_flush_ms", 2.0, float, (), ">=0.0"),
+    # bounded per-tenant queue depth (backpressure + ladder shedding)
+    _p("serve_queue_depth", 256, int, (), ">0"),
+    # per-tenant token bucket: sustained requests/s (0 = unlimited)
+    # and burst capacity
+    _p("serve_rate_limit", 0.0, float, (), ">=0.0"),
+    _p("serve_burst", 64.0, float, (), ">0.0"),
+    # default per-request deadline budget (ms; 0 = none): expired work
+    # is shed before dispatch, never after
+    _p("serve_default_deadline_ms", 0.0, float, (), ">=0.0"),
+    # hard per-request row cap (the rate limiter meters REQUESTS, so
+    # without a cap one huge-row request would buy unbounded device
+    # work for one token); default = the engine's MAX_BUCKET
+    _p("serve_max_request_rows", 65536, int, (), ">0"),
+    # per-model circuit breaker: consecutive dispatch failures that
+    # trip it, and the seeded backoff probe policy (jitter uses `seed`)
+    _p("serve_breaker_threshold", 5, int, (), ">0"),
+    _p("serve_breaker_base", 0.05, float, (), ">0.0"),
+    _p("serve_breaker_jitter", 0.0, float, (), ">=0.0"),
+    # registry pack-memory budget (MB; 0 = unlimited): LRU models'
+    # engine packs are evicted (lazily re-packed, never re-compiled)
+    _p("serve_pack_budget_mb", 0.0, float, (), ">=0.0"),
+    # operator endpoints (publish/rollback) auth: when set, requests
+    # must carry it as the X-Admin-Token header; when unset, the ops
+    # endpoints only answer loopback clients (hot-swapping a serving
+    # model from an arbitrary server-side file path is an OPERATOR
+    # action, never an open API)
+    _p("serve_admin_token", "", str),
     _p("use_quantized_grad", False, bool),
     _p("num_grad_quant_bins", 4, int),
     _p("quant_train_renew_leaf", False, bool),

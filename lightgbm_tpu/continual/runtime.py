@@ -518,6 +518,12 @@ class ContinualBooster:
                     base_delay=self.cfg.continual_backoff_base,
                     jitter=self.cfg.continual_backoff_jitter,
                     seed=self.cfg.seed + gen,
+                    # overall budget: the backoff schedule truncates
+                    # where the deadline runs out, so exhaustion (and
+                    # the degrade-to-last-good it triggers) lands on
+                    # time instead of sleeping past it
+                    deadline=(self.cfg.continual_retrain_deadline
+                              or None),
                     describe=f"continual retrain (generation {gen})",
                     sleep=self._sleep, clock=self._clock)
             finally:

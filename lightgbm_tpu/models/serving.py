@@ -113,16 +113,34 @@ class ServingEngine:
 
     # jitted callables and device packs are neither picklable nor worth
     # copying (sklearn deepcopy / dask shipping): a copy re-packs and
-    # re-traces ONCE on its first predict (see _rewarm above)
+    # re-traces ONCE on its first predict (see _rewarm above).  The
+    # GBDT itself holds jitted closures too, so a STANDALONE engine
+    # pickle (a registry snapshot, a worker shipping one engine) snaps
+    # the forest to its model string — the same model-text state
+    # Booster uses — and the restored copy rebuilds a loaded-model
+    # GBDT whose first predict re-packs + traces once per
+    # (kind, bucket), exactly like a pickled Booster's engine.
     def __getstate__(self):
-        # union, not fallback: a restored-then-partially-re-packed
-        # engine still owes re-warms for the names it hasn't rebuilt
-        return {"gbdt": self.gbdt,
-                "warm": sorted(set(self._packs) | self._rewarm)}
+        from ..basic import Booster
+        g = self.gbdt
+        g._flush_pending()
+        # a boolean, not the name list: the restored forest is a
+        # LOADED model serving from a different pack family, so only
+        # was-warm-at-all survives (same contract as Booster's
+        # _serving_was_warm flag)
+        return {"model_str":
+                Booster._shell_for_gbdt(g).model_to_string(),
+                "warm": bool(self._packs or self._rewarm)}
 
     def __setstate__(self, state):
-        self.__init__(state["gbdt"])
-        self._rewarm = set(state.get("warm") or ())
+        from ..basic import Booster
+        self.__init__(Booster(model_str=state["model_str"])._gbdt)
+        if state.get("warm"):
+            # the restored forest is a LOADED model (threshold-index
+            # space, no training mappers), so warmth must cover the
+            # pack family it will actually serve from — the same
+            # translation Booster.__setstate__ applies
+            self.mark_rewarm()
 
     def mark_rewarm(self, names=("insession", "contrib", "loaded")) -> None:
         """Treat ``names`` as warm for cold-row gating until their packs

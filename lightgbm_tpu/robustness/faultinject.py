@@ -1,7 +1,7 @@
 """Deterministic fault injection for testing the robustness runtime.
 
 Test-only: nothing here is imported on the hot path unless an injection
-is armed (`is_active()` is a plain module-bool check).  Three fault
+is armed (`is_active()` is a plain module-bool check).  Six fault
 classes cover the runtime's failure surface:
 
   * ``kill_at_iteration=k`` — raise ``TrainingKilled`` at the top of
@@ -12,7 +12,18 @@ classes cover the runtime's failure surface:
     every ``nonfinite_policy``;
   * ``fail_bootstrap_attempts=n`` — fail the first n distributed
     bootstrap attempts with a retriable connection error, exercising
-    the backoff path in ``parallel/network.py``.
+    the backoff path in ``parallel/network.py``;
+  * ``fail_predict_model=name, fail_predict_times=n`` — the next n
+    serve-plane dispatches of model ``name`` (any model when name is
+    None) raise ``InjectedPredictError``: drives circuit-breaker trip
+    / half-open probe / recovery drills;
+  * ``slow_predict_model=name, slow_predict_seconds=s,
+    slow_predict_times=n`` — the next n dispatches of ``name`` stall
+    ``s`` seconds ON THE INJECTED CLOCK (drills pair a ManualClock so
+    the stall is virtual — deadline-shed drills never sleep);
+  * ``flood_tenant=t, flood_requests=n`` — a one-shot queue-flood spec
+    the serve drill harness consumes (``take_flood``) to submit a
+    deterministic burst that overruns the tenant's bounded queue.
 
 Injections are process-local and explicit (no env vars): tests call
 ``inject(...)`` / ``clear()``, or use the ``injected(...)`` context
@@ -22,7 +33,7 @@ manager which always clears.
 from __future__ import annotations
 
 import contextlib
-from typing import Optional
+from typing import Optional, Tuple
 
 _active = False
 _kill_at: Optional[int] = None
@@ -30,6 +41,12 @@ _corrupt_at: Optional[int] = None
 _corrupt_rows = 16
 _fail_bootstrap_remaining = 0
 bootstrap_attempts_seen = 0
+_fail_predict_model: Optional[str] = None
+_fail_predict_remaining = 0
+_slow_predict_model: Optional[str] = None
+_slow_predict_seconds = 0.0
+_slow_predict_remaining = 0
+_flood: Optional[Tuple[str, int]] = None
 
 
 class TrainingKilled(RuntimeError):
@@ -40,29 +57,61 @@ class InjectedBootstrapError(ConnectionError):
     """Retriable injected failure of a distributed bootstrap attempt."""
 
 
+class InjectedPredictError(RuntimeError):
+    """Injected failure of a serve-plane model dispatch (fault
+    injection only; drives the circuit-breaker drills)."""
+
+
 def inject(kill_at_iteration: Optional[int] = None,
            corrupt_gradients_at: Optional[int] = None,
            corrupt_rows: int = 16,
-           fail_bootstrap_attempts: int = 0) -> None:
+           fail_bootstrap_attempts: int = 0,
+           fail_predict_model: Optional[str] = None,
+           fail_predict_times: int = 0,
+           slow_predict_model: Optional[str] = None,
+           slow_predict_seconds: float = 0.0,
+           slow_predict_times: int = 0,
+           flood_tenant: Optional[str] = None,
+           flood_requests: int = 0) -> None:
     """Arm one or more fault injections (iteration indices are 0-based,
     matching ``GBDT.iter`` at the top of the iteration)."""
     global _active, _kill_at, _corrupt_at, _corrupt_rows
     global _fail_bootstrap_remaining, bootstrap_attempts_seen
+    global _fail_predict_model, _fail_predict_remaining
+    global _slow_predict_model, _slow_predict_seconds
+    global _slow_predict_remaining, _flood
     _kill_at = kill_at_iteration
     _corrupt_at = corrupt_gradients_at
     _corrupt_rows = int(corrupt_rows)
     _fail_bootstrap_remaining = int(fail_bootstrap_attempts)
     bootstrap_attempts_seen = 0
+    _fail_predict_model = fail_predict_model
+    _fail_predict_remaining = int(fail_predict_times)
+    _slow_predict_model = slow_predict_model
+    _slow_predict_seconds = float(slow_predict_seconds)
+    _slow_predict_remaining = int(slow_predict_times)
+    _flood = ((str(flood_tenant), int(flood_requests))
+              if flood_requests > 0 else None)
     _active = (_kill_at is not None or _corrupt_at is not None
-               or _fail_bootstrap_remaining > 0)
+               or _fail_bootstrap_remaining > 0
+               or _fail_predict_remaining > 0
+               or _slow_predict_remaining > 0
+               or _flood is not None)
 
 
 def clear() -> None:
     global _active, _kill_at, _corrupt_at, _fail_bootstrap_remaining
+    global _fail_predict_model, _fail_predict_remaining
+    global _slow_predict_model, _slow_predict_remaining, _flood
     _active = False
     _kill_at = None
     _corrupt_at = None
     _fail_bootstrap_remaining = 0
+    _fail_predict_model = None
+    _fail_predict_remaining = 0
+    _slow_predict_model = None
+    _slow_predict_remaining = 0
+    _flood = None
 
 
 def is_active() -> bool:
@@ -106,3 +155,41 @@ def maybe_fail_bootstrap() -> None:
         raise InjectedBootstrapError(
             "fault injection: bootstrap attempt failed "
             f"({_fail_bootstrap_remaining} injected failures remaining)")
+
+
+def maybe_fail_predict(model: str) -> None:
+    """Raise ``InjectedPredictError`` when a failing-model injection is
+    armed for ``model`` (or for any model)."""
+    global _fail_predict_remaining
+    if not (_active and _fail_predict_remaining > 0):
+        return
+    if _fail_predict_model is not None and _fail_predict_model != model:
+        return
+    _fail_predict_remaining -= 1
+    raise InjectedPredictError(
+        f"fault injection: predict failed for model {model!r} "
+        f"({_fail_predict_remaining} injected failures remaining)")
+
+
+def maybe_slow_predict(model: str) -> float:
+    """Seconds of injected stall for this dispatch of ``model`` (0.0
+    when no slow-predict injection matches).  The CALLER advances its
+    clock — with a ManualClock the stall is virtual, never a sleep."""
+    global _slow_predict_remaining
+    if not (_active and _slow_predict_remaining > 0):
+        return 0.0
+    if _slow_predict_model is not None and _slow_predict_model != model:
+        return 0.0
+    _slow_predict_remaining -= 1
+    return _slow_predict_seconds
+
+
+def take_flood() -> Optional[Tuple[str, int]]:
+    """One-shot (tenant, request_count) queue-flood spec, or None.
+    Consumed by the serve drill harness, which submits the burst —
+    keeping the injector host-only and the service path clean."""
+    global _flood
+    if not _active or _flood is None:
+        return None
+    spec, _flood = _flood, None
+    return spec

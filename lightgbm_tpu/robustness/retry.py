@@ -49,18 +49,31 @@ class ManualClock:
 
 def backoff_schedule(attempts: int, base_delay: float = 1.0,
                      max_delay: float = 30.0, jitter: float = 0.0,
-                     seed: int = 0) -> List[float]:
+                     seed: int = 0,
+                     deadline: Optional[float] = None) -> List[float]:
     """The exact delay sequence a :func:`retry_with_backoff` call will
     use: capped exponential, times ``1 + jitter * u_i`` with ``u_i``
     drawn from ``random.Random(seed)``.  A pure function of its
     arguments — two calls with the same arguments return the same
-    floats, which is what makes kill+resume fault drills replayable."""
+    floats, which is what makes kill+resume fault drills replayable.
+
+    ``deadline`` is an overall retry budget in seconds: the schedule
+    truncates at the first delay whose CUMULATIVE sleep time would
+    cross it, so ``len(schedule)`` reports how many retry sleeps the
+    budget affords (the consumer makes ``len(schedule) + 1`` attempts
+    at most).  Jitter draws stay positionally identical with or
+    without a deadline — truncation never re-rolls the stream, so
+    tightening a budget cannot silently change the surviving delays."""
     rnd = random.Random(int(seed))
-    out = []
+    out: List[float] = []
+    total = 0.0
     for attempt in range(1, max(int(attempts), 1) + 1):
         d = min(base_delay * (2.0 ** (attempt - 1)), max_delay)
         if jitter > 0.0:
             d *= 1.0 + float(jitter) * rnd.random()
+        if deadline is not None and total + d > float(deadline):
+            break
+        total += d
         out.append(d)
     return out
 
@@ -90,8 +103,12 @@ def retry_with_backoff(fn: Callable,
     seed) sleeps the identical sequence.  Returns ``fn()``'s result;
     raises ``LightGBMError`` on exhaustion with the last underlying
     error chained."""
+    # the deadline prunes the schedule STATICALLY (how many sleeps the
+    # budget affords at all) and is re-checked DYNAMICALLY below
+    # (attempt bodies consume budget the schedule cannot know about)
     delays = backoff_schedule(attempts, base_delay, max_delay,
-                              jitter=jitter, seed=seed)
+                              jitter=jitter, seed=seed,
+                              deadline=deadline)
     start = clock()
     last: Optional[BaseException] = None
     attempt = 0
@@ -103,9 +120,11 @@ def retry_with_backoff(fn: Callable,
                 raise
             last = exc
             elapsed = clock() - start
-            delay = delays[attempt - 1]
-            out_of_budget = attempt >= attempts or (
-                deadline is not None and elapsed + delay > deadline)
+            out_of_budget = attempt >= attempts or attempt > len(delays)
+            if not out_of_budget:
+                delay = delays[attempt - 1]
+                out_of_budget = (deadline is not None
+                                 and elapsed + delay > deadline)
             if out_of_budget:
                 break
             log.warning("%s failed (attempt %d/%d, %.1fs elapsed): %s; "
@@ -115,4 +134,6 @@ def retry_with_backoff(fn: Callable,
     elapsed = clock() - start
     raise LightGBMError(
         f"{describe} failed after {attempt} attempt(s) over "
-        f"{elapsed:.1f}s: {last}") from last
+        f"{elapsed:.1f}s (deadline "
+        f"{'none' if deadline is None else f'{deadline:.1f}s'}): "
+        f"{last}") from last

@@ -1,0 +1,48 @@
+"""Production serving plane over the device ServingEngine.
+
+``models/serving.py`` solved the hard compilation problem — one
+compiled program per (pred kind, bucket, forest signature), mutation-
+counter invalidation, per-range sub-pack LRU — but nothing fronted it:
+no request queue, no tenancy, no deadlines, and a single slow or
+poisoned model could stall every caller.  This package is the queueing
+discipline on top (the Booster accelerator paper, arXiv:2011.02022,
+shows GBDT inference is a short-request/high-QPS workload where the
+queue, not the kernel, sets p99; LLM serving on TPU won its latency
+numbers the same way — continuous batching plus strict admission,
+cf. the Gemma serving comparison, arXiv:2605.25645):
+
+* :mod:`.batcher` — the coalescing micro-batcher: concurrent
+  single-row/small requests merge into the engine's existing
+  power-of-two buckets, flushed by size-or-deadline, so N concurrent
+  clients cost exactly the compile counts ``test_predict_engine.py``
+  pins and one dispatch per flushed bucket;
+* :mod:`.registry` — N resident forests with versioned hot-swap/
+  rollback (the PR 6 candidate-gate warm-up: at most one compile per
+  (kind, bucket) per swap, zero retraces for in-flight traffic) and
+  pack eviction by memory budget via the PR 7 HBM ledger;
+* :mod:`.admission` — per-tenant bounded queues with backpressure,
+  token-bucket rate limits, deadline budgets (expired work is shed
+  BEFORE dispatch, never after), a per-model circuit breaker with a
+  seeded ``robustness/retry.py`` backoff probe, and the degradation
+  ladder (shed ``pred_contrib`` before raw; fall back to the last-good
+  model version on a tripped breaker);
+* :mod:`.service` — the deterministic core tying them together: an
+  injectable clock, a synchronous ``pump()`` the async shell and the
+  drill harness both drive, per-(model, kind) latency histograms;
+* :mod:`.httpd` — the ``lightgbm_tpu serve`` stdlib-HTTP front end;
+* :mod:`.drill` — deterministic fault drills (breaker trip, deadline
+  shed, queue flood, swap-under-load) on injected clocks: same seed,
+  identical trip ticks / shed counts / recovery sequence.
+"""
+
+from .admission import AdmissionController, CircuitBreaker, TokenBucket
+from .batcher import CoalescingBatcher
+from .drill import run_serve_drill
+from .registry import ModelRegistry
+from .service import ServeTicket, ServingService
+
+__all__ = [
+    "AdmissionController", "CircuitBreaker", "TokenBucket",
+    "CoalescingBatcher", "ModelRegistry", "ServeTicket",
+    "ServingService", "run_serve_drill",
+]

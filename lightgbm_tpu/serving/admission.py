@@ -1,0 +1,327 @@
+"""Admission control: who gets to enqueue work, and what gets shed.
+
+Three mechanisms, all deterministic under an injected clock (the same
+contract as :mod:`lightgbm_tpu.robustness.retry`: every source of
+nondeterminism is threaded explicitly so fault drills replay
+bit-for-bit):
+
+* :class:`TokenBucket` — per-tenant rate limiting.  Tokens refill
+  continuously from the injected clock; an empty bucket sheds the
+  request at submit time with ``ratelimit`` (cheapest possible reject:
+  no queue slot, no batch state).
+* :class:`TenantQueue` — a bounded per-tenant queue.  A full queue
+  backpressures: the DEGRADATION LADDER sheds the lowest class of
+  pending work first (``pred_contrib`` before ``leaf`` before ``raw``,
+  oldest first within a class — deterministic ordering, pinned by the
+  queue-flood drill) to admit higher-class work; an incoming request
+  that is itself the lowest class is rejected outright.
+* :class:`CircuitBreaker` — per-model fail-fast.  ``threshold``
+  consecutive dispatch failures trip it OPEN; while open, requests
+  fail fast (or fall back to the last-good model version — the
+  registry's side of the ladder).  Recovery follows the seeded
+  :func:`lightgbm_tpu.robustness.retry.backoff_schedule`: after each
+  scheduled delay ONE probe request passes through (half-open); a
+  probe success closes the breaker, a failure re-opens it at the next
+  backoff step.  Jitter is seeded, never wall-clock, so a drill's trip
+  and recovery ticks replay identically.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict, deque
+from typing import Any, Callable, Deque, Dict, List, Optional
+
+from ..robustness.retry import backoff_schedule
+
+# degradation ladder: under pressure the expensive explanatory kinds
+# are shed before the cheap decision-path kinds — a contrib request
+# costs ~100x a raw request through the SHAP kernel and its absence
+# degrades a dashboard, not a decision
+KIND_PRIORITY = {"raw": 0, "leaf": 1, "contrib": 2}
+
+
+def kind_priority(kind: str) -> int:
+    return KIND_PRIORITY.get(kind, len(KIND_PRIORITY))
+
+
+class TokenBucket:
+    """Continuous-refill token bucket on an injectable clock.
+
+    ``rate`` tokens/second refill up to ``burst``; ``rate <= 0``
+    disables limiting (always allows).  Refill is computed from clock
+    deltas, not a background thread, so a ManualClock drill replays
+    the exact same admit/shed sequence."""
+
+    def __init__(self, rate: float, burst: float,
+                 clock: Callable[[], float] = time.monotonic):
+        self.rate = float(rate)
+        self.burst = max(float(burst), 1.0)
+        self._clock = clock
+        self._tokens = self.burst
+        self._last = clock()
+
+    def allow(self, cost: float = 1.0) -> bool:
+        if self.rate <= 0.0:
+            return True
+        now = self._clock()
+        self._tokens = min(self.burst,
+                           self._tokens + (now - self._last) * self.rate)
+        self._last = now
+        if self._tokens >= cost:
+            self._tokens -= cost
+            return True
+        return False
+
+    def is_full(self, now: float) -> bool:
+        """True when dropping this bucket loses no rate-limit state (a
+        recreated bucket starts at ``burst``, which equals a bucket
+        that has refilled completely)."""
+        if self.rate <= 0.0:
+            return True
+        return (self._tokens
+                + (now - self._last) * self.rate) >= self.burst
+
+
+class TenantQueue:
+    """Bounded FIFO with ladder-ordered shedding.
+
+    ``depth`` bounds the number of queued requests (never exceeded —
+    the queue-flood drill asserts ``max_depth_seen <= depth``).  On
+    overflow, :meth:`offer` sheds deterministically: the pending
+    request of the LOWEST class (highest ``kind_priority``), oldest
+    first, is evicted to admit a higher-class arrival; an arrival that
+    is itself lowest-class (or ties the worst pending) is rejected."""
+
+    def __init__(self, depth: int):
+        self.depth = max(int(depth), 1)
+        self._q: "OrderedDict[int, Any]" = OrderedDict()
+        self.max_depth_seen = 0
+        self.shed_count = 0
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    def offer(self, req) -> Optional[Any]:
+        """Enqueue ``req``.  Returns the request that was SHED to make
+        room (the caller fails its ticket), ``req`` itself when the
+        arrival is rejected, or None when nothing was shed."""
+        shed = None
+        if len(self._q) >= self.depth:
+            victim = self._worst()
+            if victim is not None and (kind_priority(victim.kind)
+                                       > kind_priority(req.kind)):
+                del self._q[victim.rid]
+                shed = victim
+            else:
+                self.shed_count += 1
+                return req
+            self.shed_count += 1
+        self._q[req.rid] = req
+        self.max_depth_seen = max(self.max_depth_seen, len(self._q))
+        return shed
+
+    def _worst(self):
+        worst = None
+        for req in self._q.values():       # insertion (arrival) order
+            if worst is None or kind_priority(req.kind) > kind_priority(
+                    worst.kind):
+                worst = req
+        return worst
+
+    def take(self, rid: int) -> Optional[Any]:
+        return self._q.pop(rid, None)
+
+    def drain(self) -> List[Any]:
+        out = list(self._q.values())
+        self._q.clear()
+        return out
+
+
+class CircuitBreaker:
+    """Per-model consecutive-failure breaker with seeded backoff probes.
+
+    States: ``closed`` (traffic flows) -> ``open`` (fail fast) ->
+    ``half-open`` (one probe per backoff step) -> ``closed`` on probe
+    success.  The probe delays are ``backoff_schedule(attempts, base,
+    max_delay, jitter, seed)`` — a pure function, so two drills with
+    the same seed trip and recover at identical ticks.  Past the last
+    scheduled step the final delay repeats (a dead model keeps being
+    probed at the capped cadence, never abandoned)."""
+
+    def __init__(self, threshold: int = 5, attempts: int = 6,
+                 base_delay: float = 0.05, max_delay: float = 30.0,
+                 jitter: float = 0.0, seed: int = 0,
+                 deadline: Optional[float] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.threshold = max(int(threshold), 1)
+        # ``deadline`` caps the CUMULATIVE scheduled probe delay (the
+        # retry.py budget contract); the final surviving delay then
+        # repeats, so a capped ladder probes at a steady cadence
+        # instead of backing off forever
+        self._delays = backoff_schedule(attempts, base_delay, max_delay,
+                                        jitter=jitter, seed=seed,
+                                        deadline=deadline) \
+            or [float(base_delay)]
+        self._clock = clock
+        self.state = "closed"
+        self.consecutive_failures = 0
+        self.trip_count = 0
+        self._step = 0
+        self._probe_at = 0.0
+        self._probe_out = False
+        # drill/ops-readable history, bounded: a dead model is probed
+        # forever at the capped cadence and must not leak memory
+        self.events: Deque[Dict[str, Any]] = deque(maxlen=256)
+
+    def _emit(self, what: str) -> None:
+        self.events.append({"event": what, "t": self._clock(),
+                            "state": self.state,
+                            "failures": self.consecutive_failures})
+
+    def allow(self) -> str:
+        """``"closed"`` — dispatch normally; ``"probe"`` — dispatch as
+        the half-open probe (caller MUST report the outcome);
+        ``"open"`` — fail fast / degrade."""
+        if self.state == "closed":
+            return "closed"
+        now = self._clock()
+        if not self._probe_out and now >= self._probe_at:
+            self._probe_out = True
+            self.state = "half-open"
+            self._emit("probe")
+            return "probe"
+        return "open"
+
+    def probe_inconclusive(self) -> None:
+        """The in-flight probe carried no evidence about the model
+        (e.g. the probe batch itself was malformed): return the token
+        so a later dispatch can probe again — without this, the
+        breaker would wait forever on an outcome that never arrives."""
+        if self._probe_out:
+            self._probe_out = False
+            self.state = "open"
+            self._emit("probe_inconclusive")
+
+    def record_success(self) -> None:
+        self.consecutive_failures = 0
+        if self.state != "closed":
+            self.state = "closed"
+            self._step = 0
+            self._probe_out = False
+            self._emit("recovered")
+
+    def record_failure(self) -> None:
+        self.consecutive_failures += 1
+        if self.state == "closed":
+            if self.consecutive_failures >= self.threshold:
+                self._trip()
+        else:                               # failed half-open probe
+            self._probe_out = False
+            self._step = min(self._step + 1, len(self._delays) - 1)
+            self.state = "open"
+            self._probe_at = self._clock() + self._delays[self._step]
+            self._emit("reopened")
+
+    def _trip(self) -> None:
+        self.state = "open"
+        self.trip_count += 1
+        self._step = 0
+        self._probe_out = False
+        self._probe_at = self._clock() + self._delays[0]
+        self._emit("tripped")
+
+
+class AdmissionController:
+    """Submit-time gate: rate limit, queue bound, ladder shedding.
+
+    One :class:`TenantQueue` + :class:`TokenBucket` pair per tenant,
+    created lazily with shared policy parameters.  Deadline shedding
+    happens later, at dispatch time (:meth:`expired`): a request that
+    sat out its budget in the queue is dropped BEFORE it joins a
+    batch, never after device work was spent on it."""
+
+    def __init__(self, queue_depth: int = 256, rate: float = 0.0,
+                 burst: float = 64.0, max_tenants: int = 1024,
+                 clock: Callable[[], float] = time.monotonic):
+        self.queue_depth = int(queue_depth)
+        self.rate = float(rate)
+        self.burst = float(burst)
+        # tenant names are CLIENT-supplied: without a cap, rotating
+        # names mints a fresh empty queue per burst and total queued
+        # memory (and the stats surface) grows without bound
+        self.max_tenants = max(int(max_tenants), 1)
+        self._clock = clock
+        self.queues: Dict[str, TenantQueue] = {}
+        self.buckets: Dict[str, TokenBucket] = {}
+        self.shed: Dict[str, int] = {}       # reason -> count
+
+    def _shed(self, reason: str) -> None:
+        self.shed[reason] = self.shed.get(reason, 0) + 1
+
+    def queue_for(self, tenant: str) -> TenantQueue:
+        q = self.queues.get(tenant)
+        if q is None:
+            q = self.queues[tenant] = TenantQueue(self.queue_depth)
+        return q
+
+    def bucket_for(self, tenant: str) -> TokenBucket:
+        b = self.buckets.get(tenant)
+        if b is None:
+            b = self.buckets[tenant] = TokenBucket(self.rate, self.burst,
+                                                  self._clock)
+        return b
+
+    def _prune_idle_tenants(self) -> None:
+        """Drop EMPTY tenant queues so legitimate tenant churn stays
+        under ``max_tenants`` while total queued rows remain bounded
+        by max_tenants * queue_depth.  A tenant's token bucket only
+        goes with it once fully refilled — dropping a part-empty
+        bucket would hand the tenant a fresh full burst and defeat the
+        rate limit."""
+        now = self._clock()
+        for t in [t for t, q in self.queues.items() if len(q) == 0]:
+            b = self.buckets.get(t)
+            if b is not None and not b.is_full(now):
+                continue
+            del self.queues[t]
+            self.buckets.pop(t, None)
+
+    def admit(self, req):
+        """Admit ``req`` to its tenant queue.  Returns ``(shed,
+        reason)``: ``(None, None)`` on clean admission; ``(req,
+        "ratelimit"|"queue_full"|"tenant_limit")`` when the arrival
+        itself is rejected; ``(victim, "degraded")`` when the ladder
+        evicted a pending lower-class request to make room."""
+        if req.tenant not in self.queues \
+                and len(self.queues) >= self.max_tenants:
+            self._prune_idle_tenants()
+            if len(self.queues) >= self.max_tenants:
+                self._shed("tenant_limit")
+                return req, "tenant_limit"
+        if not self.bucket_for(req.tenant).allow(req.cost):
+            self._shed("ratelimit")
+            return req, "ratelimit"
+        victim = self.queue_for(req.tenant).offer(req)
+        if victim is None:
+            return None, None
+        reason = "queue_full" if victim is req else "degraded"
+        self._shed(reason)
+        return victim, reason
+
+    def expired(self, req, now: float) -> bool:
+        if req.deadline is not None and now > req.deadline:
+            self._shed("deadline")
+            return True
+        return False
+
+    def stats(self) -> Dict[str, Any]:
+        # dict(...) snapshots: stats readers race with submit threads
+        # creating first-seen tenants
+        return {
+            "tenants": {
+                t: {"depth": len(q), "max_depth_seen": q.max_depth_seen,
+                    "shed": q.shed_count}
+                for t, q in sorted(dict(self.queues).items())},
+            "shed": dict(sorted(dict(self.shed).items())),
+        }

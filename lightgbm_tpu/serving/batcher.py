@@ -1,0 +1,194 @@
+"""The coalescing micro-batcher: many small requests, one dispatch.
+
+Serving-shaped traffic is dominated by single-row and few-row requests;
+dispatching each alone would pay one padded-bucket device round trip
+per request (a 1-row request costs the full ``MIN_BUCKET`` bucket).
+The batcher is the tree-model analog of an LLM serving stack's
+continuous batcher: concurrent requests for the same (model, kind,
+iteration range) COALESCE into one matrix that the engine pads into
+its existing power-of-two buckets (``models/serving.py bucket_rows``)
+— so N concurrent clients cost exactly the per-(kind, bucket) compile
+counts ``test_predict_engine.py`` already pins, and one dispatch per
+flushed bucket.
+
+Flush policy is size-OR-deadline:
+
+* **size** — a lane reaching ``flush_rows`` pending rows flushes
+  immediately (``flush_rows`` should be one of the engine's buckets;
+  the coalesced matrix then pads to exactly that bucket);
+* **deadline** — the lane flushes once its oldest request has waited
+  ``max_delay`` seconds, so a lone request is never held hostage for
+  a batch that isn't coming; a request whose own deadline budget would
+  expire inside the wait flushes the lane early.
+
+The batcher holds NO thread of its own and reads only the injected
+clock: the service's pump (or a drill) asks :meth:`due` and drains —
+which is what makes flood/deadline drills bit-reproducible.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+# a lane is the unit of coalescing: requests only merge when one
+# engine call can serve them all — including the row WIDTH, so two
+# clients sending different feature counts can never concatenate into
+# one (crashing) batch
+LaneKey = Tuple[str, str, int, int, int]  # (model, kind, start, num, F)
+
+
+def _lane_key(req) -> LaneKey:
+    return (req.model, req.kind, req.start_iteration,
+            req.num_iteration, int(req.rows.shape[1]))
+
+
+class _Lane:
+    __slots__ = ("reqs", "rows", "oldest_t", "earliest_deadline")
+
+    def __init__(self):
+        self.reqs: List[Any] = []
+        self.rows = 0
+        self.oldest_t: Optional[float] = None
+        self.earliest_deadline: Optional[float] = None
+
+
+class CoalescingBatcher:
+    """Accumulate requests per lane; flush by size or deadline."""
+
+    def __init__(self, flush_rows: int = 256, max_delay: float = 0.002,
+                 clock: Callable[[], float] = time.monotonic):
+        self.flush_rows = max(int(flush_rows), 1)
+        self.max_delay = float(max_delay)
+        self._clock = clock
+        self._lanes: "OrderedDict[LaneKey, _Lane]" = OrderedDict()
+        self.coalesced_sizes: Dict[int, int] = {}   # batch rows -> count
+
+    def __len__(self) -> int:
+        # list(...) snapshot: stats readers race the pump's del/insert
+        return sum(len(lane.reqs) for lane in list(self._lanes.values()))
+
+    def add(self, req) -> bool:
+        """Queue ``req`` on its lane; True when the lane is now
+        size-due (the caller should pump without waiting)."""
+        key = _lane_key(req)
+        lane = self._lanes.get(key)
+        if lane is None:
+            lane = self._lanes[key] = _Lane()
+        lane.reqs.append(req)
+        lane.rows += req.rows.shape[0]
+        if lane.oldest_t is None:
+            lane.oldest_t = req.t_submit
+        if req.deadline is not None:
+            lane.earliest_deadline = (
+                req.deadline if lane.earliest_deadline is None
+                else min(lane.earliest_deadline, req.deadline))
+        return lane.rows >= self.flush_rows
+
+    def _lane_due(self, lane: _Lane, now: float) -> bool:
+        if lane.rows >= self.flush_rows:
+            return True
+        if lane.oldest_t is not None \
+                and now - lane.oldest_t >= self.max_delay:
+            return True
+        # a request that cannot survive the remaining coalescing wait
+        # flushes the lane now — holding it for stragglers would turn
+        # the batcher itself into the deadline killer
+        if lane.earliest_deadline is not None \
+                and lane.earliest_deadline <= now + self.max_delay:
+            return True
+        return False
+
+    def due(self, now: Optional[float] = None,
+            force: bool = False) -> List[LaneKey]:
+        """Lane keys ready to flush, in lane-creation order (the order
+        requests first arrived — deterministic under one clock)."""
+        if now is None:
+            now = self._clock()
+        return [key for key, lane in self._lanes.items()
+                if force or self._lane_due(lane, now)]
+
+    def next_due_at(self) -> Optional[float]:
+        """Earliest clock time any current lane becomes deadline-due
+        (None when empty): the async pump sleeps until then instead of
+        polling."""
+        out = None
+        for lane in self._lanes.values():
+            if lane.rows >= self.flush_rows:
+                return self._clock()
+            cands = []
+            if lane.oldest_t is not None:
+                cands.append(lane.oldest_t + self.max_delay)
+            if lane.earliest_deadline is not None:
+                cands.append(lane.earliest_deadline)
+            for c in cands:
+                out = c if out is None else min(out, c)
+        return out
+
+    def drain(self, key: LaneKey,
+              max_rows: Optional[int] = None) -> List[Any]:
+        """Remove and return the lane's requests (arrival order).
+        ``max_rows`` caps the flushed batch at the bucket size: a lane
+        that grew past ``flush_rows`` between pumps dispatches in
+        bucket-sized slices (one dispatch per flushed bucket — a
+        350-row pileup must not pad to the 512 bucket and trace a
+        program the serial path never compiles); a single request
+        larger than the cap still dispatches alone."""
+        lane = self._lanes.get(key)
+        if lane is None:
+            return []
+        if max_rows is None or lane.rows <= max_rows:
+            del self._lanes[key]
+            out, rows = lane.reqs, lane.rows
+        else:
+            taken, rows = 0, 0
+            while taken < len(lane.reqs) and (
+                    taken == 0 or
+                    rows + lane.reqs[taken].rows.shape[0] <= max_rows):
+                rows += lane.reqs[taken].rows.shape[0]
+                taken += 1
+            # one slice, not per-request pop(0) shifts — a post-stall
+            # pileup must not turn the flush into quadratic host work
+            out = lane.reqs[:taken]
+            lane.reqs = lane.reqs[taken:]
+            lane.rows -= rows
+            if not lane.reqs:
+                del self._lanes[key]
+            else:
+                # the remainder keeps waiting: re-derive the aggregates
+                # the taken head carried
+                lane.oldest_t = lane.reqs[0].t_submit
+                dls = [r.deadline for r in lane.reqs
+                       if r.deadline is not None]
+                lane.earliest_deadline = min(dls) if dls else None
+        self.coalesced_sizes[rows] = \
+            self.coalesced_sizes.get(rows, 0) + 1
+        return out
+
+    def remove(self, req) -> bool:
+        """Drop one request (a ladder eviction) from its lane, keeping
+        the lane's aggregates consistent."""
+        key = _lane_key(req)
+        lane = self._lanes.get(key)
+        if lane is None or req not in lane.reqs:
+            return False
+        lane.reqs.remove(req)
+        lane.rows -= req.rows.shape[0]
+        if not lane.reqs:
+            del self._lanes[key]
+        else:
+            # the victim may have carried the lane's oldest arrival or
+            # earliest deadline; a stale aggregate would flush the
+            # survivors early in an undersized batch
+            lane.oldest_t = min(r.t_submit for r in lane.reqs)
+            dls = [r.deadline for r in lane.reqs
+                   if r.deadline is not None]
+            lane.earliest_deadline = min(dls) if dls else None
+        return True
+
+    def stats(self) -> Dict[str, Any]:
+        return {"pending": len(self),
+                "lanes": len(self._lanes),
+                "coalesced_sizes": dict(sorted(
+                    dict(self.coalesced_sizes).items()))}

@@ -1,0 +1,359 @@
+"""``lightgbm_tpu serve`` — the stdlib-HTTP front end.
+
+No framework, no dependency: a ``ThreadingHTTPServer`` whose handler
+threads submit into the :class:`~lightgbm_tpu.serving.service.
+ServingService` and block on their tickets while the service's pump
+coalesces across them — which is exactly the concurrency shape the
+micro-batcher exists for (N handler threads, one device dispatch per
+flushed bucket).
+
+Endpoints::
+
+    POST /v1/predict        {"model": "default", "tenant": "t",
+                             "rows": [[...], ...], "kind": "raw",
+                             "deadline_ms": 50, "start_iteration": 0,
+                             "num_iteration": -1}
+    GET  /healthz           liveness + per-model breaker states
+    GET  /stats             full service stats (counters, shed rates,
+                            latency histograms, registry, tenants)
+    POST /v1/models/<name>/publish   {"model_file": "path"} hot-swap
+    POST /v1/models/<name>/rollback  restore the pre-swap version
+
+Shed responses map to 429 (rate limit / queue full / deadline /
+degraded), a tripped breaker with no fallback to 503, an unknown model
+to 404 — the client can tell "back off" from "give up".
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+from ..utils import log
+from .registry import ModelRegistry, register_ledger
+from .service import ServingService
+
+_SHED_STATUS = {"ratelimit": 429, "queue_full": 429, "degraded": 429,
+                "deadline": 429}
+
+
+class _BodyTooLarge(ValueError):
+    pass
+
+
+def build_from_config(cfg) -> Tuple[ModelRegistry, ServingService]:
+    """Registry + service wired from the ``serve_*`` config family."""
+    budget = int(float(cfg.serve_pack_budget_mb) * 1e6) or None
+    registry = ModelRegistry(pack_budget_bytes=budget)
+    register_ledger(registry)
+    service = ServingService(
+        registry,
+        flush_rows=int(cfg.serve_flush_rows),
+        max_delay=float(cfg.serve_flush_ms) / 1e3,
+        queue_depth=int(cfg.serve_queue_depth),
+        rate=float(cfg.serve_rate_limit),
+        burst=float(cfg.serve_burst),
+        breaker_threshold=int(cfg.serve_breaker_threshold),
+        breaker_base=float(cfg.serve_breaker_base),
+        breaker_jitter=float(cfg.serve_breaker_jitter),
+        seed=int(cfg.seed),
+        default_deadline=(float(cfg.serve_default_deadline_ms) / 1e3
+                          if float(cfg.serve_default_deadline_ms) > 0
+                          else None),
+        max_request_rows=int(cfg.serve_max_request_rows))
+    return registry, service
+
+
+def load_models_from_config(registry: ModelRegistry, cfg) -> None:
+    """Resident models at startup: ``serve_models=name=path[,...]``,
+    else ``input_model=`` as ``default``."""
+    from ..basic import Booster
+    specs = []
+    if cfg.serve_models:
+        for item in str(cfg.serve_models).split(","):
+            item = item.strip()
+            if not item:
+                continue
+            if "=" not in item:
+                log.fatal("serve_models entries must be name=path "
+                          "(got %r)", item)
+            name, path = item.split("=", 1)
+            specs.append((name.strip(), path.strip()))
+    elif cfg.input_model:
+        specs.append(("default", cfg.input_model))
+    if not specs:
+        log.fatal("task=serve needs serve_models=name=path[,...] or "
+                  "input_model=")
+    for name, path in specs:
+        bst = Booster(model_file=path)
+        nf = bst.num_feature()
+        # warm with a serving-shaped zero batch so the first real
+        # request is already compiled
+        registry.publish(name, bst,
+                         gate_rows=np.zeros((1, nf), np.float64))
+        log.info("serve: loaded %s from %s (%d features)", name, path,
+                 nf)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    service: ServingService = None          # set by make_server
+    request_timeout_s: float = 30.0
+    admin_token: str = ""
+    # body-size ceiling: admission control cannot protect the process
+    # from a body it already buffered — an oversized POST answers 413
+    # before a byte of it is read
+    max_body_bytes: int = 32 << 20
+
+    def _admin_allowed(self) -> bool:
+        """Operator endpoints (publish/rollback) load server-side file
+        paths and change what every tenant is served: with a
+        configured token, the request must present it (constant-time
+        compare — the token is a credential); without one, only
+        loopback clients qualify."""
+        if self.admin_token:
+            import hmac
+            got = self.headers.get("X-Admin-Token") or ""
+            return hmac.compare_digest(got, self.admin_token)
+        # the server is AF_INET (IPv4): loopback is exactly 127.0.0.1
+        return self.client_address[0] == "127.0.0.1"
+
+    def log_message(self, fmt, *args):       # route through our logger
+        log.debug("serve-http: " + fmt, *args)
+
+    def _reply(self, code: int, doc: Dict[str, Any]) -> None:
+        body = json.dumps(doc).encode("utf-8")
+        try:
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            # the client hung up mid-reply; its deadline already shed
+            # the answer — never let one dead socket kill the handler
+            pass
+
+    def _body(self) -> Dict[str, Any]:
+        n = int(self.headers.get("Content-Length") or 0)
+        if n <= 0:
+            return {}
+        if n > self.max_body_bytes:
+            raise _BodyTooLarge(n)
+        doc = json.loads(self.rfile.read(n).decode("utf-8"))
+        if not isinstance(doc, dict):
+            # a bare array/string/number is valid JSON but not a valid
+            # request: it must read 400, not crash the handler
+            raise ValueError("request body must be a JSON object, "
+                             f"got {type(doc).__name__}")
+        return doc
+
+    # -- GET -------------------------------------------------------------
+    def do_GET(self):                        # noqa: N802 (stdlib name)
+        svc = self.service
+        if self.path == "/healthz":
+            # liveness stays open; the model/breaker inventory is
+            # operator detail (same gate as /stats)
+            doc: Dict[str, Any] = {"ok": True}
+            if self._admin_allowed():
+                doc["models"] = svc.registry.names()
+                doc["breakers"] = {m: br.state for m, br
+                                   in dict(svc.breakers).items()}
+            self._reply(200, doc)
+        elif self.path == "/stats":
+            if not self._admin_allowed():
+                # per-tenant queue/shed stats enumerate OTHER tenants'
+                # identifiers and traffic — operator surface only
+                self._reply(403, {"error": "operator endpoint: set "
+                                  "serve_admin_token and send "
+                                  "X-Admin-Token, or call from "
+                                  "loopback"})
+                return
+            self._reply(200, svc.stats())
+        else:
+            self._reply(404, {"error": f"no route {self.path}"})
+
+    # -- POST ------------------------------------------------------------
+    def do_POST(self):                       # noqa: N802
+        try:
+            doc = self._body()
+        except _BodyTooLarge as exc:
+            self._reply(413, {"error": "request body exceeds "
+                              f"{self.max_body_bytes} bytes "
+                              f"(got {exc.args[0]})"})
+            return
+        except (ValueError, OSError) as exc:
+            self._reply(400, {"error": f"bad request body: {exc}"})
+            return
+        if self.path == "/v1/predict":
+            self._predict(doc)
+            return
+        parts = self.path.strip("/").split("/")
+        if len(parts) == 4 and parts[:2] == ["v1", "models"]:
+            name, action = parts[2], parts[3]
+            if action in ("publish", "rollback") \
+                    and not self._admin_allowed():
+                self._reply(403, {"error": "operator endpoint: set "
+                                  "serve_admin_token and send "
+                                  "X-Admin-Token, or call from "
+                                  "loopback"})
+                return
+            if action == "publish":
+                self._publish(name, doc)
+                return
+            if action == "rollback":
+                ok = self.service.registry.rollback(name)
+                self._reply(200 if ok else 409, {
+                    "rolled_back": ok,
+                    "version": self.service.registry.version(name)})
+                return
+        self._reply(404, {"error": f"no route {self.path}"})
+
+    def _publish(self, name: str, doc: Dict[str, Any]) -> None:
+        from ..basic import Booster
+        path = doc.get("model_file")
+        if not path:
+            self._reply(400, {"error": "publish needs model_file"})
+            return
+        try:
+            bst = Booster(model_file=path)
+            gate = np.zeros((1, bst.num_feature()), np.float64)
+            rep = self.service.registry.publish(name, bst,
+                                                gate_rows=gate)
+        except Exception as exc:             # noqa: BLE001
+            # the raw error (paths, parse details) belongs in the
+            # server log, not the response body
+            log.warning("serve: publish of %s from %s failed: %s",
+                        name, path, exc)
+            self._reply(500, {"error": "publish failed "
+                              "(see server log)"})
+            return
+        self._reply(200, {
+            "published": name, "version": rep["version"],
+            "warm_traces": {f"{k[0]}@{k[1]}": v
+                            for k, v in rep["warm_traces"].items()}})
+
+    def _predict(self, doc: Dict[str, Any]) -> None:
+        rows = doc.get("rows")
+        if rows is None:
+            self._reply(400, {"error": "predict needs rows"})
+            return
+        try:
+            rows = np.asarray(rows, dtype=np.float64)
+        except (TypeError, ValueError) as exc:
+            self._reply(400, {"error": f"bad rows: {exc}"})
+            return
+        from ..utils.log import LightGBMError
+        try:
+            deadline_ms = doc.get("deadline_ms")
+            ticket = self.service.submit(
+                rows,
+                model=str(doc.get("model", "default")),
+                tenant=str(doc.get("tenant", "default")),
+                kind=str(doc.get("kind", "raw")),
+                start_iteration=int(doc.get("start_iteration", 0)),
+                num_iteration=int(doc.get("num_iteration", -1)),
+                # <= 0 means "no deadline", matching the documented
+                # serve_default_deadline_ms convention — a literal 0
+                # budget would shed 100% of the client's traffic.
+                # Deadline-less HTTP requests get the handler timeout
+                # as their budget: once this handler answers 504,
+                # nobody reads the result, so the queue must not keep
+                # the request alive past that
+                deadline_s=(float(deadline_ms) / 1e3
+                            if deadline_ms is not None
+                            and float(deadline_ms) > 0
+                            else self.request_timeout_s))
+        except (LightGBMError, TypeError, ValueError) as exc:
+            # an unknown kind / non-numeric field is the CLIENT's bug:
+            # it must read a 400, not a dropped connection
+            self._reply(400, {"error": str(exc)})
+            return
+        if not ticket.wait(self.request_timeout_s):
+            self._reply(504, {"status": "timeout"})
+            return
+        if ticket.status == "ok":
+            self._reply(200, {
+                "status": "ok",
+                "fallback": ticket.reason == "fallback",
+                "latency_ms": round(1e3 * (ticket.latency_s or 0.0), 3),
+                "predictions": np.asarray(ticket.result).tolist()})
+        elif ticket.status == "shed":
+            self._reply(_SHED_STATUS.get(ticket.reason, 429), {
+                "status": "shed", "reason": ticket.reason})
+        else:
+            reason = ticket.reason or "error"
+            code = (404 if reason == "unknown_model"
+                    else 503 if reason == "breaker_open"
+                    # dispatch-time client faults (e.g. a width
+                    # mismatch against a just-swapped model) are the
+                    # CLIENT's 400, not a retriable server error
+                    else 400 if reason.startswith("bad_request")
+                    else 500)
+            self._reply(code, {"status": "error", "reason": reason})
+
+
+class _Server(ThreadingHTTPServer):
+    # the stdlib default backlog (5) resets connections under exactly
+    # the concurrent-client load the micro-batcher exists for
+    request_queue_size = 128
+    daemon_threads = True
+
+
+def make_server(service: ServingService, host: str = "127.0.0.1",
+                port: int = 8080, request_timeout_s: float = 30.0,
+                admin_token: str = "") -> ThreadingHTTPServer:
+    """A bound (not yet serving) HTTP server over ``service``; port 0
+    binds an ephemeral port (tests read ``server.server_address``)."""
+    handler = type("BoundHandler", (_Handler,), {
+        "service": service, "request_timeout_s": request_timeout_s,
+        "admin_token": str(admin_token or ""),
+        # socket read/write timeout (BaseHTTPRequestHandler honors the
+        # `timeout` attribute in setup()): a client that withholds its
+        # declared body must not pin a handler thread forever
+        "timeout": float(request_timeout_s)})
+    return _Server((host, int(port)), handler)
+
+
+def run_serve_task(cfg) -> None:
+    """The CLI ``task=serve`` body: build, load, pump, serve forever."""
+    if str(cfg.serve_host) not in ("127.0.0.1", "localhost") \
+            and not cfg.serve_admin_token:
+        # a non-local bind with token-less operator endpoints would
+        # also trust loopback SOURCE addresses — which any same-host
+        # reverse proxy forges for every remote client
+        log.fatal("serve_host=%s is non-loopback: set serve_admin_token "
+                  "(operator endpoints must not trust source addresses "
+                  "behind a proxy)", cfg.serve_host)
+    registry, service = build_from_config(cfg)
+    load_models_from_config(registry, cfg)
+    service.start()
+    server = make_server(service, host=cfg.serve_host,
+                         port=int(cfg.serve_port),
+                         admin_token=cfg.serve_admin_token)
+    host, port = server.server_address[:2]
+    log.info("serve: listening on http://%s:%d (models: %s)", host,
+             port, ", ".join(registry.names()))
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        log.info("serve: shutting down")
+    finally:
+        server.server_close()
+        service.stop()
+
+
+def serve_in_background(service: ServingService, host: str = "127.0.0.1",
+                        port: int = 0) -> Tuple[ThreadingHTTPServer,
+                                                threading.Thread]:
+    """Test/tool helper: worker pump + HTTP server on a daemon thread;
+    returns (server, thread) — the caller owns shutdown."""
+    service.start()
+    server = make_server(service, host=host, port=port)
+    t = threading.Thread(target=server.serve_forever, daemon=True,
+                         name="lightgbm-tpu-serve-http")
+    t.start()
+    return server, t

@@ -1,0 +1,281 @@
+"""Multi-model registry: N resident forests, versioned hot-swap,
+rollback, and pack eviction by memory budget.
+
+The registry owns WHICH booster serves a name; the engines own how.
+Three invariants, all inherited from machinery that already exists:
+
+* **Swap is one reference flip.**  ``publish`` warms the incoming
+  booster FIRST (the PR 6 candidate-gate trick: the warm-up predict
+  doubles as the pack build, at most ONE compile per (kind, bucket)
+  per swap), then installs it with a single dict assignment — a
+  concurrent reader holds either the old booster or the new one, never
+  a mix, and in-flight traffic on the old booster keeps its own packs
+  (zero retraces: engine packs are keyed by each model's own mutation
+  counter, so nothing the swap does can invalidate the old program).
+* **Rollback is bit-identical.**  The previous version is retained
+  after every swap; ``rollback`` flips the reference back to a booster
+  whose engine still holds its own packs keyed by its own signature —
+  post-rollback predictions are bit-identical to pre-swap ones.
+* **Eviction frees packs, not models.**  When the summed pack bytes
+  (the same arrays the PR 7 HBM ledger attributes to
+  ``serving.packs``) exceed ``pack_budget_bytes``, the least-recently-
+  used models' engines are invalidated.  The model stays resident and
+  re-warms lazily on its next request — a re-pack (one host gather +
+  transfer), ZERO new compiles (the engine's jit cache survives
+  invalidation; only the device arrays drop).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..models.serving import _pack_memory_arrays
+from ..obs import memory as obs_memory
+from ..utils import log
+from ..utils.log import LightGBMError
+
+
+def pack_bytes(engine) -> int:
+    """Bytes of every pack payload the engine keeps resident (the
+    ledger's ``serving.packs`` provider, summed host-side from array
+    metadata — never a device sync)."""
+    try:
+        import jax
+        leaves = jax.tree_util.tree_leaves(_pack_memory_arrays(engine))
+    except Exception:
+        return 0
+    return int(sum(getattr(a, "nbytes", 0) or 0 for a in leaves))
+
+
+class _Entry:
+    __slots__ = ("name", "active", "previous", "version", "last_used",
+                 "swap_count", "rollback_count")
+
+    def __init__(self, name: str, booster, now: float):
+        self.name = name
+        self.active = booster
+        self.previous = None
+        self.version = 1
+        self.last_used = now
+        self.swap_count = 0
+        self.rollback_count = 0
+
+
+class ModelRegistry:
+    """Name -> versioned resident booster, with a pack-memory budget."""
+
+    def __init__(self, pack_budget_bytes: Optional[int] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self._lock = threading.RLock()
+        self._entries: Dict[str, _Entry] = {}
+        self.pack_budget_bytes = pack_budget_bytes
+        self._clock = clock
+        self.evictions = 0
+        self._version_listeners: List[Callable[[str], None]] = []
+
+    def subscribe_version_change(self,
+                                 cb: Callable[[str], None]) -> None:
+        """``cb(name)`` fires after every publish/rollback — the
+        service uses it to retire the old version's circuit-breaker
+        history (a fixed model must serve immediately, not wait out
+        the broken version's backoff ladder)."""
+        self._version_listeners.append(cb)
+
+    def _notify_version_change(self, name: str) -> None:
+        for cb in list(self._version_listeners):
+            try:
+                cb(name)
+            except Exception:   # a listener must never sink a publish
+                pass
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._entries
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._entries)
+
+    # -- publish / resolve / rollback -----------------------------------
+    def _warm(self, booster, gate_rows) -> Dict[Any, int]:
+        """Warm the incoming booster's serving packs BEFORE it takes
+        traffic; returns the per-(kind, bucket) traces the warm-up
+        cost (the swap-under-load drill asserts each is <= 1)."""
+        g = booster._gbdt
+        g._flush_pending()
+        eng = g.serving
+        eng.mark_rewarm(("insession", "loaded"))
+        snap = eng.trace_snapshot()
+        if gate_rows is not None:
+            booster.predict(np.asarray(gate_rows), raw_score=True)
+        return eng.new_traces_since(snap)
+
+    def publish(self, name: str, booster, gate_rows=None
+                ) -> Dict[str, Any]:
+        """Install ``booster`` as the serving version of ``name``
+        (hot-swap when the name exists).  ``gate_rows`` (optional
+        serving-shaped sample) drives the warm-up predict so the first
+        real request after the swap is already hot."""
+        warm_traces = self._warm(booster, gate_rows)
+        with self._lock:
+            now = self._clock()
+            ent = self._entries.get(name)
+            if ent is None:
+                ent = self._entries[name] = _Entry(name, booster, now)
+            else:
+                ent.previous = ent.active
+                ent.active = booster       # the atomic step
+                ent.version += 1
+                ent.swap_count += 1
+                ent.last_used = now
+            self._enforce_budget(keep=name)
+        log.info("registry: published %s v%d (warm traces: %s)",
+                 name, ent.version,
+                 {f"{k[0]}@{k[1]}": v for k, v in warm_traces.items()})
+        self._notify_version_change(name)
+        return {"name": name, "version": ent.version,
+                "warm_traces": warm_traces}
+
+    def get(self, name: str):
+        """The serving booster for ``name`` (bumps its LRU clock)."""
+        with self._lock:
+            ent = self._entries.get(name)
+            if ent is None:
+                raise LightGBMError(f"no model named {name!r} in the "
+                                    "serving registry")
+            ent.last_used = self._clock()
+            return ent.active
+
+    def peek(self, name: str):
+        """The serving booster without touching the LRU clock — for
+        cheap pre-admission checks (shed traffic must not refresh a
+        model's eviction priority)."""
+        with self._lock:
+            ent = self._entries.get(name)
+            return ent.active if ent is not None else None
+
+    def last_good(self, name: str):
+        """The previous version (the breaker's fallback target), or
+        None when the name has never been swapped."""
+        with self._lock:
+            ent = self._entries.get(name)
+            return ent.previous if ent is not None else None
+
+    def version(self, name: str) -> int:
+        with self._lock:
+            ent = self._entries.get(name)
+            return ent.version if ent is not None else 0
+
+    def rollback(self, name: str) -> bool:
+        """Flip ``name`` back to its previous version (bit-identical:
+        the restored booster's engine kept its own packs)."""
+        with self._lock:
+            ent = self._entries.get(name)
+            if ent is None or ent.previous is None:
+                return False
+            ent.active, ent.previous = ent.previous, None
+            ent.version += 1
+            ent.rollback_count += 1
+            ent.last_used = self._clock()
+        log.warning("registry: rolled back %s to the pre-swap version "
+                    "(now v%d)", name, ent.version)
+        self._notify_version_change(name)
+        return True
+
+    def remove(self, name: str) -> bool:
+        with self._lock:
+            return self._entries.pop(name, None) is not None
+
+    # -- pack-memory budget ---------------------------------------------
+    @staticmethod
+    def _entry_bytes(ent: "_Entry") -> int:
+        """Resident pack bytes of one entry (active + retained previous
+        version — the rollback guarantee is memory the budget must
+        see)."""
+        n = pack_bytes(ent.active._gbdt.serving)
+        if ent.previous is not None:
+            n += pack_bytes(ent.previous._gbdt.serving)
+        return n
+
+    def pack_usage(self) -> Dict[str, int]:
+        """Per-model resident pack bytes (lock-held: ``ent.previous``
+        races a concurrent rollback otherwise; the walk reads only
+        host-side array metadata, never a device sync)."""
+        with self._lock:
+            return {ent.name: self._entry_bytes(ent)
+                    for ent in self._entries.values()}
+
+    def _enforce_budget(self, keep: Optional[str] = None) -> int:
+        """Evict (invalidate packs of) least-recently-used models until
+        the summed pack bytes fit the budget; ``keep`` is never
+        evicted (it is the model being published/served right now).
+        Returns the number of models evicted.  Caller holds the lock."""
+        budget = self.pack_budget_bytes
+        if not budget or budget <= 0:
+            return 0
+        usage = {ent.name: self._entry_bytes(ent)
+                 for ent in self._entries.values()}
+        total = sum(usage.values())
+        evicted = 0
+        victims = sorted((e for e in self._entries.values()
+                          if e.name != keep),
+                         key=lambda e: e.last_used)
+        for ent in victims:
+            if total <= budget:
+                break
+            if usage.get(ent.name, 0) <= 0:
+                continue
+            for bst in (ent.active, ent.previous):
+                if bst is None:
+                    continue
+                eng = bst._gbdt.serving
+                eng.invalidate()
+                # next use re-packs without the cold-row gate (an
+                # evicted model was serving small batches; eviction
+                # must not silently demote it to the host path)
+                eng.mark_rewarm(("insession", "loaded"))
+            total -= usage[ent.name]
+            evicted += 1
+            self.evictions += 1
+            log.info("registry: evicted packs of %s (%d bytes) to meet "
+                     "the %d-byte budget", ent.name, usage[ent.name],
+                     budget)
+        return evicted
+
+    def enforce_budget(self, keep: Optional[str] = None) -> int:
+        with self._lock:
+            return self._enforce_budget(keep=keep)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "models": {
+                    e.name: {"version": e.version,
+                             "swaps": e.swap_count,
+                             "rollbacks": e.rollback_count,
+                             "has_previous": e.previous is not None}
+                    for e in self._entries.values()},
+                "pack_budget_bytes": self.pack_budget_bytes,
+                "evictions": self.evictions,
+            }
+
+
+def _registry_arrays(reg: ModelRegistry):
+    """Telemetry memory provider: every resident version's packs."""
+    out = []
+    for ent in list(reg._entries.values()):
+        for bst in (ent.active, ent.previous):
+            if bst is not None:
+                out.append(_pack_memory_arrays(bst._gbdt.serving))
+    return out
+
+
+def register_ledger(reg: ModelRegistry) -> None:
+    """Attribute the registry's resident packs in the HBM ledger under
+    their own owner name (each engine also self-registers under
+    ``serving.packs``; the registry track answers "how much is the
+    REGISTRY holding resident" across models)."""
+    obs_memory.register("serving.registry", reg, _registry_arrays)

@@ -1,0 +1,498 @@
+"""The serving service core: submit -> admit -> coalesce -> dispatch.
+
+Deliberately a synchronous state machine over an injectable clock.
+``submit`` admits and queues a request; ``pump`` flushes due lanes,
+sheds expired work, runs the circuit-breaker/degradation policy and
+dispatches coalesced batches through the registry's boosters.  The
+async shell (:meth:`ServingService.start` worker thread, the HTTP
+front end) and the deterministic drill harness both drive exactly this
+machine — which is why breaker trips, deadline sheds and swap-under-
+load replay bit-for-bit under a ManualClock with no sleeps.
+
+Failure policy (the teeth):
+
+* an expired deadline sheds BEFORE dispatch, never after — device
+  work is never spent on an answer nobody is waiting for;
+* a dispatch failure counts against the model's breaker; a tripped
+  breaker fails fast, and when the registry holds a last-good previous
+  version the batch degrades to it instead of erroring (the
+  model-level rung of the degradation ladder — the queue-level rung,
+  shedding ``pred_contrib`` before raw, lives in admission);
+* every failure mode is injectable (``robustness/faultinject.py``
+  slow-predict / failing-model injectors) so tier-1 replays them
+  deterministically.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..obs import telemetry as obs
+from ..obs.telemetry import Histogram
+from ..robustness import faultinject
+from ..utils import log
+from ..utils.log import LightGBMError
+from .admission import AdmissionController, CircuitBreaker
+from .batcher import CoalescingBatcher
+from .registry import ModelRegistry
+
+
+class ServeTicket:
+    """A caller's handle on one submitted request."""
+
+    __slots__ = ("status", "result", "reason", "latency_s", "_event")
+
+    def __init__(self):
+        self.status = "pending"      # pending | ok | shed | error
+        self.result = None
+        self.reason: Optional[str] = None
+        self.latency_s: Optional[float] = None
+        self._event = threading.Event()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._event.wait(timeout)
+
+    def _finish(self, status: str, result=None, reason=None,
+                latency=None) -> None:
+        self.status = status
+        self.result = result
+        self.reason = reason
+        self.latency_s = latency
+        self._event.set()
+
+
+class _Request:
+    __slots__ = ("rid", "tenant", "model", "kind", "rows",
+                 "start_iteration", "num_iteration", "deadline",
+                 "t_submit", "ticket", "cost")
+
+    def __init__(self, rid, tenant, model, kind, rows, start, num,
+                 deadline, t_submit, ticket):
+        self.rid = rid
+        self.tenant = tenant
+        self.model = model
+        self.kind = kind
+        self.rows = rows
+        self.start_iteration = start
+        self.num_iteration = num
+        self.deadline = deadline
+        self.t_submit = t_submit
+        self.ticket = ticket
+        # the token bucket meters REQUESTS (serve_rate_limit is
+        # documented as requests/s): a batch request must not be
+        # permanently unpayable because its row count exceeds burst
+        self.cost = 1.0
+
+
+_KINDS = ("raw", "leaf", "contrib")
+
+
+class ServingService:
+    """See the module docstring.  All policy knobs mirror the
+    ``serve_*`` config parameters (config.py); ``clock`` is the single
+    time source for queues, deadlines, breakers and latency stats."""
+
+    def __init__(self, registry: ModelRegistry, *,
+                 flush_rows: int = 256, max_delay: float = 0.002,
+                 queue_depth: int = 256, rate: float = 0.0,
+                 burst: float = 64.0, breaker_threshold: int = 5,
+                 breaker_attempts: int = 6, breaker_base: float = 0.05,
+                 breaker_max_delay: float = 30.0,
+                 breaker_jitter: float = 0.0, seed: int = 0,
+                 default_deadline: Optional[float] = None,
+                 max_request_rows: int = 65536,
+                 clock: Callable[[], float] = time.monotonic):
+        self.registry = registry
+        self._clock = clock
+        self._lock = threading.RLock()
+        self._cv = threading.Condition(self._lock)
+        self._pump_lock = threading.Lock()
+        self.admission = AdmissionController(queue_depth=queue_depth,
+                                             rate=rate, burst=burst,
+                                             clock=clock)
+        self.batcher = CoalescingBatcher(flush_rows=flush_rows,
+                                         max_delay=max_delay,
+                                         clock=clock)
+        self._breaker_kw = dict(threshold=breaker_threshold,
+                                attempts=breaker_attempts,
+                                base_delay=breaker_base,
+                                max_delay=breaker_max_delay,
+                                jitter=breaker_jitter)
+        self._seed = int(seed)
+        self.breakers: Dict[str, CircuitBreaker] = {}
+        self.default_deadline = default_deadline
+        self.max_request_rows = int(max_request_rows)
+        self._budget_checked_at = float("-inf")
+        self._rid = 0
+        self.counters: Dict[str, int] = {
+            "submitted": 0, "served": 0, "shed": 0, "errors": 0,
+            "dispatches": 0, "dispatch_failures": 0,
+            "fallback_served": 0}
+        self.latency: Dict[str, Histogram] = {}
+        self._worker: Optional[threading.Thread] = None
+        self._running = False
+        # a publish/rollback installs a DIFFERENT forest: the old
+        # version's consecutive-failure history (and an open breaker's
+        # backoff ladder) must not gate the fresh one — without this, a
+        # fixed model keeps serving the stale fallback until the broken
+        # version's next scheduled probe
+        registry.subscribe_version_change(
+            lambda name: self.breakers.pop(name, None))
+
+    # -- submit ----------------------------------------------------------
+    def submit(self, rows, *, model: str = "default",
+               tenant: str = "default", kind: str = "raw",
+               start_iteration: int = 0, num_iteration: int = -1,
+               deadline_s: Optional[float] = None) -> ServeTicket:
+        """Admit one request; returns immediately with a ticket the
+        caller waits on.  ``deadline_s`` is a RELATIVE budget from now
+        (``serve_default_deadline_ms`` when omitted); the request is
+        shed unanswered once it expires un-dispatched."""
+        if kind not in _KINDS:
+            raise LightGBMError(f"unknown serve kind {kind!r} "
+                                f"(want one of {_KINDS})")
+        rows = np.asarray(rows, dtype=np.float64)
+        if rows.ndim == 1:
+            rows = rows.reshape(1, -1)
+        if rows.ndim != 2 or rows.shape[0] == 0:
+            # reject at the door (the HTTP layer maps this to 400): a
+            # 3-d array would bypass predict's ndim==2 feature-count
+            # check and charge ITS failure to the model's breaker
+            raise LightGBMError("serve rows must be a non-empty 2-d "
+                                f"(n, F) matrix; got shape {rows.shape}")
+        if rows.shape[0] > self.max_request_rows:
+            # the rate limiter meters requests: without this cap a
+            # single huge-row request buys unbounded device work for
+            # one token (serve_max_request_rows)
+            raise LightGBMError(
+                f"serve request of {rows.shape[0]} rows exceeds "
+                f"serve_max_request_rows={self.max_request_rows}; "
+                "split the batch")
+        # peek, not get: a request that may yet be rate-limited must
+        # not bump the model's LRU clock (pack-eviction priority)
+        bst = self.registry.peek(model)
+        expected = bst.num_feature() if bst is not None else None
+        if expected is not None and rows.shape[1] != expected:
+            # structural width check at the door: a wrong-width tenant
+            # reads a 400 and can never charge the model's breaker
+            # (_client_fault stays as the dispatch-time backstop)
+            raise LightGBMError(
+                f"serve rows have {rows.shape[1]} features but model "
+                f"{model!r} expects {expected}")
+        ticket = ServeTicket()
+        if deadline_s is None:
+            deadline_s = self.default_deadline
+        with self._cv:
+            self._rid += 1
+            now = self._clock()
+            req = _Request(self._rid, str(tenant), str(model), kind,
+                           rows, int(start_iteration),
+                           int(num_iteration),
+                           None if deadline_s is None
+                           else now + float(deadline_s),
+                           now, ticket)
+            self.counters["submitted"] += 1
+            victim, reason = self.admission.admit(req)
+            if victim is not None:
+                if victim is not req:
+                    # ladder eviction: the victim was already queued on
+                    # a lane — pull it out before failing its ticket
+                    self.batcher.remove(victim)
+                self.counters["shed"] += 1
+                victim.ticket._finish("shed", reason=reason)
+                if victim is req:
+                    return ticket
+            self.batcher.add(req)
+            self._cv.notify_all()
+        return ticket
+
+    # -- pump ------------------------------------------------------------
+    def pump(self, force: bool = False) -> int:
+        """Flush every due lane (all lanes when ``force``); returns the
+        number of dispatched batches.  Safe to call from any thread;
+        one pump runs at a time.  Lanes flush one at a time with a
+        FRESH deadline check immediately before each dispatch — a
+        stall in batch k must expire batch k+1's overdue requests
+        BEFORE device work is spent on them, never after."""
+        dispatched = 0
+        with self._pump_lock:
+            while True:
+                with self._lock:
+                    # one scan yields the whole due wave; each lane
+                    # still gets a FRESH pre-dispatch deadline check
+                    # below (due-ness is monotone in time, so a lane
+                    # due at scan time is still due at drain time)
+                    keys = self.batcher.due(self._clock(), force=force)
+                if not keys:
+                    break
+                for key in keys:
+                    with self._lock:
+                        t = self._clock()
+                        live = []
+                        for req in self.batcher.drain(
+                                key, max_rows=self.batcher.flush_rows):
+                            self.admission.queue_for(
+                                req.tenant).take(req.rid)
+                            # deadline shed BEFORE dispatch, never after
+                            if self.admission.expired(req, t):
+                                self.counters["shed"] += 1
+                                req.ticket._finish("shed",
+                                                   reason="deadline")
+                                continue
+                            live.append(req)
+                    self._dispatch_guarded(key, live)
+                    if live:
+                        dispatched += 1
+            if dispatched and self.registry.pack_budget_bytes:
+                # evicted models lazily re-pack when traffic returns,
+                # so the budget must be re-enforced between publishes
+                # — but the walk over every resident pack's metadata
+                # is throttled (it holds the registry lock the
+                # publish/get paths also need)
+                t = self._clock()
+                if t - self._budget_checked_at >= 5.0:
+                    self._budget_checked_at = t
+                    self.registry.enforce_budget()
+        return dispatched
+
+    def _dispatch_guarded(self, key, live: List[_Request]) -> None:
+        if not live:
+            return
+        try:
+            self._dispatch(key, live)
+        except Exception as exc:  # noqa: BLE001 — an unexpected
+            # dispatch-layer fault must answer the tickets, not strand
+            # their callers at the HTTP timeout — and must hand back
+            # any half-open probe token this dispatch was carrying
+            # (idempotent when none is out)
+            self._fail_all(live, f"dispatch_error: {exc}")
+            br = self.breakers.get(key[0])
+            if br is not None:
+                br.probe_inconclusive()
+
+    def _breaker(self, model: str) -> CircuitBreaker:
+        br = self.breakers.get(model)
+        if br is None:
+            # per-model seed offset from a STABLE name hash (not dict
+            # size, which shifts as breakers are minted/retired): two
+            # models' jittered probe schedules must not be forced into
+            # lockstep, and re-minting after a version change must
+            # reproduce the same schedule
+            import zlib
+            br = self.breakers[model] = CircuitBreaker(
+                seed=self._seed + (zlib.crc32(model.encode()) & 0xffff),
+                clock=self._clock, **self._breaker_kw)
+        return br
+
+    def _hist(self, model: str, kind: str) -> Histogram:
+        key = f"{model}.{kind}"
+        h = self.latency.get(key)
+        if h is None:
+            h = self.latency[key] = Histogram()
+        return h
+
+    # -- dispatch --------------------------------------------------------
+    def _predict(self, booster, kind: str, X: np.ndarray, start: int,
+                 num: int, inject_model: Optional[str] = None):
+        if inject_model is not None:
+            faultinject.maybe_fail_predict(inject_model)
+            slow = faultinject.maybe_slow_predict(inject_model)
+            if slow > 0.0:
+                # a planted slow model advances the INJECTED clock
+                # (drills pair a ManualClock whose sleep is virtual);
+                # under the real clock the injection is a real stall
+                sleep = getattr(self._clock, "sleep", None)
+                (sleep or time.sleep)(slow)
+        if kind == "raw":
+            return np.asarray(booster.predict(
+                X, raw_score=True, start_iteration=start,
+                num_iteration=num))
+        if kind == "leaf":
+            return np.asarray(booster.predict(
+                X, pred_leaf=True, start_iteration=start,
+                num_iteration=num))
+        return np.asarray(booster.predict(
+            X, pred_contrib=True, start_iteration=start,
+            num_iteration=num))
+
+    def _fail_all(self, reqs, reason: str) -> None:
+        self.counters["errors"] += len(reqs)
+        for req in reqs:
+            req.ticket._finish("error", reason=reason)
+
+    @staticmethod
+    def _client_fault(exc: BaseException) -> bool:
+        """A failure the REQUEST caused (wrong feature count), not the
+        model: it must answer 400-shaped, and must not count toward
+        the model's breaker — one misbehaving tenant cannot be allowed
+        to trip every tenant's traffic onto the fallback."""
+        return isinstance(exc, LightGBMError) and \
+            "number of features in data" in str(exc)
+
+    def _dispatch(self, key, reqs: List[_Request]) -> None:
+        model, kind, start, num = key[:4]
+        if model not in self.registry:
+            # reject BEFORE minting a breaker: model names are
+            # client-supplied, and a breaker (with its event ring) per
+            # unique bogus name would grow without bound
+            self._fail_all(reqs, "unknown_model")
+            return
+        breaker = self._breaker(model)
+        gate = breaker.allow()
+        fallback = False
+        if gate == "open":
+            # model-level degradation rung: a tripped breaker serves
+            # from the last-good previous version when one exists,
+            # fails fast otherwise — never blocks the queue
+            booster = self.registry.last_good(model)
+            if booster is None:
+                self._fail_all(reqs, "breaker_open")
+                return
+            fallback = True
+        else:
+            try:
+                booster = self.registry.get(model)
+            except LightGBMError:
+                if gate == "probe":
+                    # the model vanished under the probe: count it as
+                    # failed or the breaker waits on an outcome that
+                    # can never arrive
+                    breaker.record_failure()
+                self._fail_all(reqs, "unknown_model")
+                return
+        X = (reqs[0].rows if len(reqs) == 1
+             else np.concatenate([r.rows for r in reqs], axis=0))
+        self.counters["dispatches"] += 1
+        try:
+            with (obs.span(f"serve.dispatch.{kind}",
+                           model=model, rows=int(X.shape[0]))
+                  if obs.enabled() else obs.NULL):
+                out = self._predict(booster, kind, X, start, num,
+                                    inject_model=None if fallback
+                                    else model)
+        except Exception as exc:   # noqa: BLE001 — any model fault
+            self.counters["dispatch_failures"] += 1
+            # fallback dispatches never blame the client: its rows
+            # passed the door check against the ACTIVE version — a
+            # width mismatch here means the SERVER chose an
+            # incompatible last-good version
+            if not fallback and self._client_fault(exc):
+                if gate == "probe":
+                    # the probe batch itself was malformed: no verdict
+                    # on the model — hand the probe token back or the
+                    # breaker waits forever on an outcome that never
+                    # arrives
+                    breaker.probe_inconclusive()
+                self._fail_all(reqs, f"bad_request: {exc}")
+                return
+            if not fallback:
+                breaker.record_failure()
+                if breaker.state == "open":
+                    # the failure that TRIPPED it: this batch still
+                    # degrades instead of dying with the model
+                    prev = self.registry.last_good(model)
+                    if prev is not None:
+                        try:
+                            out = self._predict(prev, kind, X, start,
+                                                num)
+                            self._complete(reqs, out, model, kind,
+                                           fallback=True)
+                            return
+                        except Exception:
+                            pass
+            self._fail_all(reqs, f"model_error: {exc}")
+            return
+        if not fallback and gate in ("closed", "probe"):
+            breaker.record_success()
+        self._complete(reqs, out, model, kind, fallback=fallback)
+
+    def _complete(self, reqs, out: np.ndarray, model: str, kind: str,
+                  fallback: bool = False) -> None:
+        now = self._clock()
+        hist = self._hist(model, kind)
+        pos = 0
+        # per-request copies, not views: a view would pin the WHOLE
+        # coalesced batch output for as long as any one ticket lives
+        split = len(reqs) > 1
+        for req in reqs:
+            n = req.rows.shape[0]
+            res = out[pos:pos + n].copy() if split else out[pos:pos + n]
+            pos += n
+            lat = now - req.t_submit
+            hist.observe(lat)
+            self.counters["served"] += 1
+            if fallback:
+                self.counters["fallback_served"] += 1
+            req.ticket._finish("ok", result=res,
+                               reason="fallback" if fallback else None,
+                               latency=lat)
+
+    # -- async shell -----------------------------------------------------
+    def start(self, poll_s: Optional[float] = None) -> None:
+        """Run the pump on a daemon worker: wakes on submit, sleeps
+        until the next size/deadline flush is due."""
+        if self._worker is not None:
+            return
+        self._running = True
+        poll = poll_s if poll_s is not None \
+            else max(self.batcher.max_delay / 2.0, 1e-4)
+
+        def loop():
+            while self._running:
+                try:
+                    self.pump()
+                except Exception as exc:   # noqa: BLE001 — never die:
+                    # a dead pump thread would strand every queued and
+                    # future request across all tenants
+                    log.warning("serve: pump error: %s", exc)
+                with self._cv:
+                    if not self._running:
+                        break
+                    due_at = self.batcher.next_due_at()
+                    if due_at is None:
+                        self._cv.wait(timeout=0.2)
+                    else:
+                        wait = due_at - self._clock()
+                        if wait > 0:
+                            self._cv.wait(timeout=min(wait, poll))
+
+        self._worker = threading.Thread(target=loop, daemon=True,
+                                        name="lightgbm-tpu-serve-pump")
+        self._worker.start()
+
+    def stop(self, drain: bool = True) -> None:
+        self._running = False
+        with self._cv:
+            self._cv.notify_all()
+        if self._worker is not None:
+            self._worker.join(timeout=5.0)
+            self._worker = None
+        if drain:
+            self.pump(force=True)
+
+    # -- stats -----------------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        shed_rate = (self.counters["shed"]
+                     / max(self.counters["submitted"], 1))
+        return {
+            "counters": dict(self.counters),
+            "shed_rate": round(shed_rate, 6),
+            "admission": self.admission.stats(),
+            "batcher": self.batcher.stats(),
+            # dict(...) snapshots are GIL-atomic: handler threads read
+            # stats while the pump inserts first-seen models/keys
+            "breakers": {
+                m: {"state": br.state, "trips": br.trip_count,
+                    "consecutive_failures": br.consecutive_failures}
+                for m, br in sorted(dict(self.breakers).items())},
+            "latency": {k: h.to_json()
+                        for k, h in sorted(dict(self.latency).items())},
+            "registry": self.registry.stats(),
+        }

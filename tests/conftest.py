@@ -118,12 +118,27 @@ def pytest_sessionfinish(session, exitstatus):
         metrics = {"wall_s": doc["wall_s"] or 0.0}
         metrics.update({f + "_s": v["seconds"]
                         for f, v in _DURATIONS.items()})
+        # a failed or cut-short session (pytest -x, ctrl-C, collection
+        # errors) has fast-but-bogus wall numbers: record it aborted so
+        # the detector excludes it (regress.py contract), same as every
+        # abort_guard producer
         regress.append_entry(
             "pytest", metrics,
             config={"files": len(_DURATIONS), "tests": n_tests},
-            rows=n_tests, path=hist_out)
+            rows=n_tests, aborted=bool(exitstatus), path=hist_out)
     except Exception:
         pass                  # a failed append must never fail the run
+
+
+def pytest_configure(config):
+    # the tier-1 runner deselects with -m 'not slow' (ROADMAP);
+    # registering the marker kills the per-test unknown-mark warning
+    # and lets --strict-markers catch a typo'd trim mark that would
+    # silently keep a slow test inside the 870 s window
+    config.addinivalue_line(
+        "markers",
+        "slow: out-of-window lanes (tier-1 runs -m 'not slow'); each "
+        "trim keeps a named fast in-window representative")
 
 
 @pytest.fixture

@@ -17,6 +17,10 @@ def _bin_data(rng, n=1200, f=6):
     return X, y.astype(np.float64)
 
 
+@pytest.mark.slow  # 7.5 s: tier-1 window trim (PR 12, per
+# test_durations.json); test_engine.py keeps the fast in-window
+# training-metric representative (is_provide_training_metric) and
+# test_cv_fpreproc_applied_per_fold keeps the cv-series shape cover
 def test_cv_eval_train_metric(rng):
     """eval_train_metric=True adds `train <metric>-mean` series
     (reference: engine.py cv eval_train_metric arm)."""
@@ -52,6 +56,9 @@ def test_cv_fpreproc_applied_per_fold(rng):
         assert bst.config.learning_rate == pytest.approx(0.5)
 
 
+@pytest.mark.slow  # 7.1 s: tier-1 window trim (PR 12, per
+# test_durations.json); test_cv_sklearn_groupkfold_ranking keeps the
+# fast in-window sklearn-splitter representative
 def test_cv_sklearn_splitter_folds(rng):
     """A scikit-learn splitter object drives the folds
     (reference: engine.py:507-517 hasattr(folds, 'split'))."""

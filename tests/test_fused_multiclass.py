@@ -106,6 +106,10 @@ def test_fused_multiclass_many_classes(rng):
     assert np.allclose(p.sum(axis=1), 1.0, atol=1e-5)
 
 
+@pytest.mark.slow  # 8.1 s: tier-1 window trim (PR 12, per
+# test_durations.json); test_fused_multiclass_weighted keeps a fast
+# in-window fused-multiclass-with-valid representative and bagging is
+# covered across test_engine/test_frontier lanes
 def test_fused_multiclass_bagging_and_valid(mc_data):
     X, y = mc_data
     params = dict(BASE, bagging_fraction=0.6, bagging_freq=2)

@@ -59,6 +59,10 @@ def test_unconstrained_violates():
     assert not is_increasing(bst, X, 0, +1)
 
 
+@pytest.mark.slow  # 7.7 + 10.1 s: tier-1 window trim (PR 12, per
+# test_durations.json); test_advanced_mode_enforces and
+# test_advanced_finds_split_intermediate_clamps keep fast in-window
+# representatives of both constraint methods
 @pytest.mark.parametrize("method", ["intermediate", "advanced"])
 def test_monotone_intermediate_enforced(method):
     """Region-exact intermediate mode keeps the constraint AND fits at

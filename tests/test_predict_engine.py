@@ -419,3 +419,46 @@ def test_deepcopy_round_trip_predicts(reg_model):
     clone = copy.deepcopy(bst)
     np.testing.assert_allclose(clone.predict(X[:100]), ref,
                                rtol=1e-6, atol=1e-6)
+
+
+def test_standalone_engine_pickle_rewarm(reg_model):
+    """A STANDALONE ServingEngine pickle (a registry snapshot, a
+    worker shipping one engine — not riding a Booster) used to crash
+    on the GBDT's jitted closures (PR-3 note).  It now snapshots the
+    forest to its model string: warm pack names survive the round
+    trip and the restored copy's first predict re-packs + traces once
+    per (kind, bucket) — never per-call cold traces."""
+    import copy
+    import pickle
+    bst, X = reg_model
+    g = bst._gbdt
+    bst.predict(X, raw_score=True)            # ensure warm
+    ref = np.asarray(bst.predict(X[:300], raw_score=True)).reshape(-1)
+    total = len(g.models) // g.num_tree_per_iteration
+    for clone in (pickle.loads(pickle.dumps(g.serving)),
+                  copy.deepcopy(g.serving)):
+        assert clone.trace_counts == {}, "restored engine starts cold"
+        # SMALL batch: warmth survived, so the device path engages
+        # immediately (the restored forest is a loaded model — no
+        # training mappers — so it serves from the loaded pack family)
+        out = clone.raw_loaded(X[:300], 0, total)
+        assert out is not None, "re-warm hint must lift the cold gate"
+        np.testing.assert_allclose(np.asarray(out).reshape(-1), ref,
+                                   rtol=1e-6, atol=1e-6)
+        traced = dict(clone.trace_counts)
+        assert traced and all(v == 1 for v in traced.values()), traced
+        clone.raw_loaded(X[:290], 0, total)   # same bucket: no trace
+        assert dict(clone.trace_counts) == traced
+
+
+def test_standalone_engine_pickle_never_warm_stays_cold():
+    import pickle
+    rng = np.random.RandomState(19)
+    X = rng.normal(size=(400, 5))
+    y = X[:, 0] + 0.1 * rng.normal(size=400)
+    bst = lgb.train(dict(BASE, objective="regression", num_leaves=7),
+                    lgb.Dataset(X, label=y), num_boost_round=3)
+    bst._gbdt._flush_pending()
+    eng2 = pickle.loads(pickle.dumps(bst._gbdt.serving))
+    assert eng2.raw_loaded(X[:32], 0, 3) is None, \
+        "tiny batch on a never-warm standalone copy stays on the host"

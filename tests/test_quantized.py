@@ -15,7 +15,13 @@ def _make_binary(n=1500, f=12, seed=5):
     return X, y
 
 
-@pytest.mark.parametrize("renew", [False, True])
+@pytest.mark.parametrize(
+    "renew",
+    [False,
+     pytest.param(True, marks=pytest.mark.slow)])  # 14 s: tier-1
+# window trim (PR 12, per test_durations.json); renew=False keeps the
+# fast in-window close-to-fp representative and
+# test_quant_renew_device_matches_host_oracle covers the renew path
 def test_quantized_binary_close_to_fp(renew):
     X, y = _make_binary()
     base = {"objective": "binary", "num_leaves": 31, "min_data_in_leaf": 5,

@@ -414,6 +414,92 @@ def test_retry_with_backoff_does_not_retry_fatal():
     assert len(calls) == 1
 
 
+def test_backoff_schedule_deadline_truncates():
+    """The deadline prunes the schedule where the CUMULATIVE sleep
+    budget runs out (len(schedule) = retry sleeps afforded), and the
+    seeded jitter stream stays positionally identical with or without
+    it — tightening a budget never re-rolls surviving delays."""
+    from lightgbm_tpu.robustness.retry import backoff_schedule
+    full = backoff_schedule(5, base_delay=1.0)
+    assert full == [1.0, 2.0, 4.0, 8.0, 16.0]
+    cut = backoff_schedule(5, base_delay=1.0, deadline=10.0)
+    assert cut == [1.0, 2.0, 4.0]          # +8 would cross 10
+    assert backoff_schedule(5, base_delay=1.0, deadline=0.5) == []
+    jf = backoff_schedule(5, base_delay=1.0, jitter=0.3, seed=9)
+    jc = backoff_schedule(5, base_delay=1.0, jitter=0.3, seed=9,
+                          deadline=sum(jf[:2]) + 0.01)
+    assert jc == jf[:2]
+
+
+def test_retry_deadline_stops_and_reports_attempts():
+    """retry_with_backoff under a deadline: attempts stop when the
+    budget is exhausted (never sleeping past it), the terminal error
+    reports attempts-used and the budget, and the ManualClock replay
+    contract holds — virtual time at exhaustion equals the truncated
+    schedule exactly."""
+    from lightgbm_tpu.robustness.retry import (ManualClock,
+                                               retry_with_backoff)
+    clock = ManualClock()
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise RuntimeError("flaky")
+
+    with pytest.raises(LightGBMError) as ei:
+        retry_with_backoff(fn, attempts=5, base_delay=1.0,
+                           deadline=10.0, sleep=clock.sleep,
+                           clock=clock, describe="op")
+    # schedule [1, 2, 4]: 4 attempts (3 sleeps), stop before the 8s
+    # sleep that would cross the 10s budget
+    assert len(calls) == 4
+    assert clock.now == pytest.approx(7.0)
+    assert "4 attempt(s)" in str(ei.value)
+    assert "deadline 10.0s" in str(ei.value)
+    # without a deadline the same policy runs all 5 attempts
+    clock2 = ManualClock()
+    calls.clear()
+    with pytest.raises(LightGBMError):
+        retry_with_backoff(fn, attempts=5, base_delay=1.0,
+                           sleep=clock2.sleep, clock=clock2)
+    assert len(calls) == 5 and clock2.now == pytest.approx(15.0)
+
+
+def test_continual_retrain_consumes_deadline(rng):
+    """The continual retrain loop passes continual_retrain_deadline
+    through to the retry policy: a deadline too small for any retry
+    sleep degrades to last-good after the attempts the budget affords,
+    at the virtual time the truncated schedule predicts."""
+    from lightgbm_tpu.continual import ContinualBooster
+    from lightgbm_tpu.robustness.retry import ManualClock
+    X = rng.normal(size=(200, 4))
+    y = X[:, 0] + 0.05 * rng.normal(size=200)
+    clock = ManualClock()
+    cb = ContinualBooster(
+        {"objective": "regression", "num_leaves": 5, "verbosity": -1,
+         "metric": "", "num_iterations": 3, "min_data_in_leaf": 5,
+         "continual_window": 1, "continual_cooldown": 0,
+         "continual_retrain_attempts": 4,
+         "continual_backoff_base": 1.0,
+         "continual_backoff_jitter": 0.0,
+         "continual_retrain_deadline": 2.5},
+        X, y, sleep=clock.sleep, clock=clock)
+    # poison retraining itself so every attempt dies retriably
+    cb._retrain_once = lambda *a, **k: (_ for _ in ()).throw(
+        RuntimeError("injected retrain failure"))
+    # two ticks of wildly regressed labels trip detection
+    r = None
+    for tick in range(4):
+        r = cb.tick(X[:64], y[:64] + 100.0 * (tick >= 1))
+        if r.retrain_failed:
+            break
+    assert r is not None and r.retrain_failed and r.degraded
+    # base 1.0 under a 2.5s deadline affords ONE retry sleep
+    # (schedule [1]; +2 would cross): 2 attempts, 1.0 virtual seconds
+    # — not the 4 attempts / 7.0s the deadline-less policy would run
+    assert clock.now == pytest.approx(1.0)
+
+
 # ---------------------------------------------------------------------------
 # satellites riding this PR
 # ---------------------------------------------------------------------------

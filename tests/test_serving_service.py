@@ -1,0 +1,603 @@
+"""Production serving plane (lightgbm_tpu/serving/).
+
+The PR-12 acceptance gates: (1) concurrent single-row clients coalesce
+into the engine's existing power-of-two buckets with EXACTLY the
+per-(kind, bucket) compile counts the serial path produces — including
+during a hot-swap under load — and with no interleaved-pack corruption
+(every ticket's rows answer with that row's own prediction); (2) the
+breaker / deadline / queue-flood drills are deterministic under
+injected clocks: same seed, identical trip ticks, shed counts and
+recovery sequence; (3) registry rollback is bit-identical and pack
+eviction by memory budget costs a re-pack, never a re-compile.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.robustness import faultinject
+from lightgbm_tpu.robustness.retry import ManualClock
+from lightgbm_tpu.serving import (ModelRegistry, ServingService,
+                                  run_serve_drill)
+from lightgbm_tpu.serving.admission import TokenBucket
+from lightgbm_tpu.serving.drill import DRILL_SCENARIOS
+
+BASE = {"objective": "regression", "num_leaves": 7, "verbosity": -1,
+        "metric": "", "min_data_in_leaf": 5, "seed": 11}
+N, F = 500, 5
+
+
+def _train(seed=11, rounds=5):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(N, F))
+    y = X[:, 0] + 0.5 * np.sin(X[:, 1]) + 0.1 * rng.normal(size=N)
+    bst = lgb.train(dict(BASE, seed=seed), lgb.Dataset(X, label=y),
+                    num_boost_round=rounds)
+    bst._gbdt._flush_pending()
+    return bst, X
+
+
+# ---------------------------------------------------------------------------
+# Acceptance 1: coalesced concurrent traffic == serial compile counts
+# ---------------------------------------------------------------------------
+def test_coalesced_compile_counts_match_serial_path():
+    """32 threads of single-row clients through the micro-batcher must
+    trace exactly what ONE serial 256-row predict traces per (kind,
+    bucket) — no retrace storm — and every client gets its own row's
+    answer (no interleaved-pack corruption)."""
+    # two identical trainings (same seed -> same trees): one serves the
+    # serial baseline, one serves through the service
+    serial, X = _train()
+    served, _ = _train()
+    np.testing.assert_array_equal(
+        np.asarray(serial.predict(X, raw_score=True)),
+        np.asarray(served.predict(X, raw_score=True)))
+
+    # serial baseline: warmed exactly like a published model (the
+    # registry lifts the cold-row gate via mark_rewarm + gate predict)
+    eng_serial = serial._gbdt.serving
+    eng_serial.mark_rewarm(("insession", "loaded"))
+    serial.predict(X, raw_score=True)
+    base = dict(eng_serial.trace_counts)
+    serial.predict(X[:256], raw_score=True)
+    serial.predict(X[:256], pred_leaf=True)
+    serial.predict(X[:256], pred_contrib=True)
+    serial_traces = {k: v - base.get(k, 0)
+                     for k, v in eng_serial.trace_counts.items()
+                     if v - base.get(k, 0) > 0}
+
+    # service side: same warmth, then 32 threads x 8 single-row submits
+    reg = ModelRegistry()
+    svc = ServingService(reg, flush_rows=256, max_delay=10.0,
+                         queue_depth=1024)
+    reg.publish("m", served, gate_rows=X)     # same warm-up as baseline
+    eng = served._gbdt.serving
+    base_svc = dict(eng.trace_counts)
+    tickets = {}
+
+    def client(i):
+        mine = []
+        for j in range(8):
+            ridx = (i * 8 + j) % 256
+            for kind in ("raw", "leaf", "contrib"):
+                mine.append((ridx, kind,
+                             svc.submit(X[ridx].reshape(1, -1),
+                                        model="m", kind=kind,
+                                        tenant=f"t{i % 4}")))
+        tickets[i] = mine
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(32)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # all 256 rows per kind are pending; flush them bucket-by-bucket
+    svc.pump(force=True)
+    svc_traces = {k: v - base_svc.get(k, 0)
+                  for k, v in eng.trace_counts.items()
+                  if v - base_svc.get(k, 0) > 0}
+    assert svc_traces == serial_traces, (svc_traces, serial_traces)
+    # one dispatch per flushed bucket: 256 rows per kind at
+    # flush_rows=256 is exactly one batch per kind lane
+    assert svc.counters["dispatches"] == 3
+    assert svc.counters["shed"] == 0
+
+    # no interleaved-pack corruption: each ticket answers ITS row
+    want_raw = np.asarray(serial.predict(X[:256], raw_score=True))
+    want_leaf = np.asarray(serial.predict(X[:256], pred_leaf=True))
+    want_con = np.asarray(serial.predict(X[:256], pred_contrib=True))
+    for mine in tickets.values():
+        for ridx, kind, t in mine:
+            assert t.status == "ok", (t.status, t.reason)
+            got = np.asarray(t.result)
+            if kind == "raw":
+                np.testing.assert_allclose(
+                    got.reshape(-1), want_raw[ridx].reshape(-1),
+                    rtol=0, atol=0)
+            elif kind == "leaf":
+                np.testing.assert_array_equal(
+                    got.reshape(-1), want_leaf[ridx].reshape(-1))
+            else:
+                np.testing.assert_allclose(
+                    got.reshape(-1), want_con[ridx].reshape(-1),
+                    rtol=0, atol=1e-12)
+
+
+def test_live_worker_no_retrace_storm():
+    """With the async worker flushing by its own cadence, arbitrary
+    coalesced sizes must still land in at most the flush bucket's
+    power-of-two buckets, each traced exactly once."""
+    bst, X = _train(seed=23)
+    reg = ModelRegistry()
+    svc = ServingService(reg, flush_rows=256, max_delay=0.002,
+                         queue_depth=1024)
+    reg.publish("m", bst, gate_rows=X)
+    eng = bst._gbdt.serving
+    base = dict(eng.trace_counts)
+    svc.start()
+    try:
+        oks = []
+
+        def client(i):
+            ts = [svc.submit(X[(i * 16 + j) % N].reshape(1, -1),
+                             model="m") for j in range(16)]
+            for t in ts:
+                assert t.wait(30.0)
+            oks.append(all(t.status == "ok" for t in ts))
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        svc.stop()
+    assert all(oks)
+    new = {k: v - base.get(k, 0) for k, v in eng.trace_counts.items()
+           if v - base.get(k, 0) > 0}
+    assert set(k[1] for k in new) <= {128, 256}, new
+    assert all(v == 1 for v in new.values()), new
+
+
+def test_hot_swap_under_live_load_zero_retraces():
+    """A publish landing while threads hammer the name: in-flight
+    requests finish on whichever version they were dispatched against,
+    the outgoing engine never re-traces, the incoming engine warms with
+    at most one compile per (kind, bucket)."""
+    v1, X = _train(seed=31)
+    v2, _ = _train(seed=32, rounds=7)
+    reg = ModelRegistry()
+    svc = ServingService(reg, flush_rows=128, max_delay=0.002,
+                         queue_depth=4096)
+    reg.publish("m", v1, gate_rows=X[:128])
+    eng1 = v1._gbdt.serving
+    want1 = np.asarray(v1.predict(X, raw_score=True)).reshape(-1)
+    want2 = np.asarray(v2.predict(X, raw_score=True)).reshape(-1)
+    assert not np.allclose(want1, want2)
+    snap1 = dict(eng1.trace_counts)
+    svc.start()
+    stop = threading.Event()
+    bad = []
+
+    def client(i):
+        j = 0
+        while not stop.is_set() or j < 8:
+            ridx = (i * 37 + j) % N
+            t = svc.submit(X[ridx].reshape(1, -1), model="m")
+            if not t.wait(30.0) or t.status != "ok":
+                bad.append((i, j, t.status, t.reason))
+                break
+            got = float(np.asarray(t.result).reshape(-1)[0])
+            # f32 device accumulation vs the f64 host oracle: ~1e-7
+            if not (abs(got - want1[ridx]) < 1e-5
+                    or abs(got - want2[ridx]) < 1e-5):
+                bad.append((i, j, "corrupt", got))
+                break
+            j += 1
+            if j > 400:
+                break
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(6)]
+    try:
+        for t in threads:
+            t.start()
+        rep = reg.publish("m", v2, gate_rows=X[:128])   # swap mid-load
+        stop.set()
+        for t in threads:
+            t.join(60.0)
+    finally:
+        stop.set()
+        svc.stop()
+    assert not bad, bad[:5]
+    assert all(v <= 1 for v in rep["warm_traces"].values()), rep
+    # the outgoing engine served concurrent traffic from its existing
+    # programs throughout — including while the swap was landing
+    new1 = {k: v - snap1.get(k, 0) for k, v in eng1.trace_counts.items()
+            if v - snap1.get(k, 0) > 0}
+    assert new1 == {}, new1
+    eng2 = v2._gbdt.serving
+    assert all(v == 1 for v in eng2.trace_counts.values()), \
+        eng2.trace_counts
+
+
+# ---------------------------------------------------------------------------
+# Acceptance 2: deterministic drills (same seed -> identical reports)
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("scenario", DRILL_SCENARIOS)
+def test_drills_replay_bit_identically(scenario):
+    r1 = run_serve_drill(scenario, seed=3)
+    r2 = run_serve_drill(scenario, seed=3)
+    assert json.dumps(r1, sort_keys=True, default=str) == \
+        json.dumps(r2, sort_keys=True, default=str)
+
+
+def test_breaker_drill_trip_and_recovery_sequence():
+    r = run_serve_drill("breaker", seed=3)
+    assert r["trip_tick"] is not None
+    assert r["recovery_tick"] is not None
+    assert r["recovery_tick"] > r["trip_tick"]
+    assert r["trip_count"] == 1
+    assert r["final_state"] == "closed"
+    # fail-fast never happened silently: while open, traffic degraded
+    # to the last-good version instead of erroring
+    assert r["fallback_served"] > 0
+    # pre-trip consecutive failures error; the trip itself degrades
+    assert r["errors"] == 2
+    # the breaker's own event log tells the whole story, in order
+    assert [e["event"] for e in r["breaker_events"]] == \
+        ["tripped", "probe", "reopened", "probe", "recovered"]
+
+
+def test_deadline_drill_sheds_before_dispatch_never_after():
+    r = run_serve_drill("deadline", seed=5)
+    assert r["shed"] == 2 and r["shed_reasons"] == {"deadline": 2}
+    assert r["served"] == 3
+    # the invariant with teeth: nothing served outlived its budget
+    assert r["dispatched_expired"] == 0
+    statuses = [t["status"] for t in r["tickets"]]
+    assert statuses == ["ok", "shed", "ok", "shed", "ok"]
+
+
+def test_queue_flood_drill_bounded_depth_and_shed_order():
+    r = run_serve_drill("flood", seed=7)
+    assert r["bounded"] and r["max_depth_seen"] <= r["queue_depth"]
+    assert r["shed_total"] == r["flood"]["count"] - r["served"]
+    assert r["shed_order"], "a flood past the bound must shed"
+    # the ladder sheds explanatory kinds for decision kinds: no raw
+    # request was shed to make room (raw is the top class), and every
+    # ladder eviction removed a lower class than the arrival that
+    # caused it
+    assert all(reason in ("queue_full", "degraded")
+               for _, _, reason in r["shed_order"])
+    assert "contrib" not in r["survivor_kinds"] or \
+        all(k == "contrib" for _, k, _ in r["shed_order"])
+
+
+# ---------------------------------------------------------------------------
+# registry: rollback bit-identity, pack eviction by budget
+# ---------------------------------------------------------------------------
+def test_registry_rollback_bit_identical_and_versions():
+    v1, X = _train(seed=41)
+    v2, _ = _train(seed=42, rounds=6)
+    reg = ModelRegistry()
+    reg.publish("m", v1, gate_rows=X[:128])
+    p1 = np.asarray(reg.get("m").predict(X, raw_score=True))
+    reg.publish("m", v2, gate_rows=X[:128])
+    p2 = np.asarray(reg.get("m").predict(X, raw_score=True))
+    assert not np.allclose(p1, p2)
+    assert reg.version("m") == 2
+    assert reg.rollback("m")
+    p1b = np.asarray(reg.get("m").predict(X, raw_score=True))
+    np.testing.assert_array_equal(p1b, p1)   # bit-identical
+    assert reg.version("m") == 3
+    assert not reg.rollback("m"), "previous was consumed by rollback"
+
+
+def test_registry_pack_budget_evicts_lru_without_recompiling():
+    v1, X = _train(seed=51)
+    v2, _ = _train(seed=52)
+    reg = ModelRegistry(pack_budget_bytes=1)     # everything over budget
+    reg.publish("a", v1, gate_rows=X[:128])
+    ref = np.asarray(reg.get("a").predict(X[:100], raw_score=True))
+    eng1 = v1._gbdt.serving
+    traces = dict(eng1.trace_counts)
+    assert eng1.stats()["packs"], "publish must warm packs"
+    reg.publish("b", v2, gate_rows=X[:128])      # a is now LRU: evicted
+    assert reg.evictions >= 1
+    assert eng1.stats()["packs"] == [], "a's packs must be evicted"
+    # next use re-packs lazily and answers identically with ZERO new
+    # compiles (the engine's jit cache survives invalidation)
+    out = np.asarray(reg.get("a").predict(X[:100], raw_score=True))
+    np.testing.assert_array_equal(out, ref)
+    assert dict(eng1.trace_counts) == traces
+    assert eng1.stats()["packs"], "re-pack must have happened"
+
+
+# ---------------------------------------------------------------------------
+# admission primitives
+# ---------------------------------------------------------------------------
+def test_publish_resets_a_tripped_breaker():
+    """A hot-swap installs a DIFFERENT forest: the broken version's
+    open breaker (and its climbing backoff ladder) must not keep the
+    fixed model on the stale fallback until the next scheduled
+    probe."""
+    clock = ManualClock()
+    v1, X = _train(seed=97)
+    v2, _ = _train(seed=98, rounds=6)
+    reg = ModelRegistry(clock=clock)
+    svc = ServingService(reg, flush_rows=64, max_delay=10.0,
+                         breaker_threshold=2, breaker_base=100.0,
+                         clock=clock)
+    reg.publish("m", v1, gate_rows=X[:64])
+    with faultinject.injected(fail_predict_model="m",
+                              fail_predict_times=2):
+        for i in range(2):
+            svc.submit(X[i].reshape(1, -1), model="m")
+            svc.pump(force=True)
+    assert svc.breakers["m"].state == "open"
+    # operator publishes the fixed version: served immediately — no
+    # waiting out the 100s backoff, no stale-fallback traffic
+    reg.publish("m", v2, gate_rows=X[:64])
+    t = svc.submit(X[0].reshape(1, -1), model="m")
+    svc.pump(force=True)
+    assert t.status == "ok" and t.reason is None
+    assert svc.breakers["m"].state == "closed"
+
+
+def test_breaker_probe_inconclusive_returns_the_token():
+    """A malformed probe batch carries no verdict on the model: the
+    probe token must come back so a later dispatch can probe again —
+    otherwise the breaker waits forever on an outcome that never
+    arrives."""
+    from lightgbm_tpu.serving.admission import CircuitBreaker
+    clock = ManualClock()
+    br = CircuitBreaker(threshold=1, base_delay=0.1, clock=clock)
+    br.record_failure()                      # trips
+    assert br.state == "open"
+    clock.sleep(0.2)
+    assert br.allow() == "probe"
+    br.probe_inconclusive()                  # malformed probe batch
+    assert br.state == "open"
+    assert br.allow() == "probe", "the token must be reissuable"
+    br.record_success()
+    assert br.state == "closed"
+
+
+def test_token_bucket_rate_limit_deterministic():
+    clock = ManualClock()
+    tb = TokenBucket(rate=1.0, burst=2.0, clock=clock)
+    assert tb.allow() and tb.allow() and not tb.allow()
+    clock.sleep(1.0)
+    assert tb.allow() and not tb.allow()
+
+
+def test_service_rate_limit_sheds_at_submit():
+    clock = ManualClock()
+    bst, X = _train(seed=61)
+    reg = ModelRegistry(clock=clock)
+    svc = ServingService(reg, flush_rows=64, max_delay=10.0,
+                         rate=1.0, burst=2.0, clock=clock)
+    reg.publish("m", bst, gate_rows=X[:64])
+    t1 = svc.submit(X[0].reshape(1, -1), model="m")
+    t2 = svc.submit(X[1].reshape(1, -1), model="m")
+    t3 = svc.submit(X[2].reshape(1, -1), model="m")
+    assert t3.status == "shed" and t3.reason == "ratelimit"
+    clock.sleep(2.0)
+    t4 = svc.submit(X[3].reshape(1, -1), model="m")
+    svc.pump(force=True)
+    assert t1.status == t2.status == t4.status == "ok"
+    assert svc.stats()["shed_rate"] == 0.25
+
+
+def test_unknown_model_and_kind_errors():
+    bst, X = _train(seed=71)
+    reg = ModelRegistry()
+    svc = ServingService(reg, flush_rows=64, max_delay=10.0)
+    reg.publish("m", bst, gate_rows=X[:64])
+    t = svc.submit(X[0].reshape(1, -1), model="nope")
+    svc.pump(force=True)
+    assert t.status == "error" and t.reason == "unknown_model"
+    with pytest.raises(lgb.LightGBMError):
+        svc.submit(X[0].reshape(1, -1), model="m", kind="banana")
+    # malformed shapes are rejected at the door (HTTP maps to 400),
+    # never dispatched — a 3-d array must not charge the breaker
+    with pytest.raises(lgb.LightGBMError, match="2-d"):
+        svc.submit(X[:2].reshape(2, F, 1), model="m")
+    with pytest.raises(lgb.LightGBMError, match="non-empty"):
+        svc.submit(np.zeros((0, F)), model="m")
+    svc.max_request_rows = 8
+    with pytest.raises(lgb.LightGBMError, match="serve_max_request_rows"):
+        svc.submit(X[:9], model="m")
+
+
+# ---------------------------------------------------------------------------
+# HTTP front end: one round trip through every endpoint
+# ---------------------------------------------------------------------------
+def test_http_endpoints_round_trip(tmp_path):
+    import urllib.error
+    import urllib.request
+
+    from lightgbm_tpu.serving.httpd import serve_in_background
+    v1, X = _train(seed=81)
+    v2, _ = _train(seed=82, rounds=6)
+    path2 = str(tmp_path / "v2.txt")
+    v2.save_model(path2)
+    reg = ModelRegistry()
+    svc = ServingService(reg, flush_rows=128, max_delay=0.002)
+    reg.publish("default", v1, gate_rows=X[:128])
+    server, _th = serve_in_background(svc, port=0)
+    host, port = server.server_address[:2]
+    url = f"http://{host}:{port}"
+
+    def post(route, doc):
+        req = urllib.request.Request(
+            url + route, data=json.dumps(doc).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return json.loads(r.read())
+
+    try:
+        out = post("/v1/predict", {"rows": [X[0].tolist()]})
+        assert out["status"] == "ok"
+        want = float(np.asarray(
+            v1.predict(X[0].reshape(1, -1),
+                       raw_score=True)).reshape(-1)[0])
+        assert abs(out["predictions"][0] - want) < 1e-9
+        with urllib.request.urlopen(url + "/healthz", timeout=10) as r:
+            h = json.loads(r.read())
+        assert h["ok"] and h["models"] == ["default"]
+        # hot-swap through the API, then roll it back
+        pub = post("/v1/models/default/publish", {"model_file": path2})
+        assert pub["version"] == 2
+        assert all(v <= 1 for v in pub["warm_traces"].values())
+        out2 = post("/v1/predict", {"rows": [X[0].tolist()]})
+        assert abs(out2["predictions"][0] - want) > 1e-12
+        rb = post("/v1/models/default/rollback", {})
+        assert rb["rolled_back"]
+        out3 = post("/v1/predict", {"rows": [X[0].tolist()]})
+        assert abs(out3["predictions"][0] - want) < 1e-9
+        with urllib.request.urlopen(url + "/stats", timeout=10) as r:
+            st = json.loads(r.read())
+        assert st["counters"]["served"] >= 3
+        assert "default.raw" in st["latency"]
+        # an unknown model 404s; a bad kind is the client's bug -> 400
+        try:
+            post("/v1/predict", {"rows": [X[0].tolist()],
+                                 "model": "nope"})
+            raise AssertionError("unknown model must 404")
+        except urllib.error.HTTPError as exc:
+            assert exc.code == 404
+        try:
+            post("/v1/predict", {"rows": [X[0].tolist()],
+                                 "kind": "banana"})
+            raise AssertionError("unknown kind must 400")
+        except urllib.error.HTTPError as exc:
+            assert exc.code == 400
+    finally:
+        server.shutdown()
+        server.server_close()
+        svc.stop()
+
+
+def test_http_admin_token_gates_operator_endpoints(tmp_path):
+    """With serve_admin_token configured, publish/rollback demand the
+    X-Admin-Token header — a reachable port is not an operator
+    credential."""
+    import urllib.error
+    import urllib.request
+
+    from lightgbm_tpu.serving.httpd import make_server
+    v1, X = _train(seed=83)
+    p1 = str(tmp_path / "m.txt")
+    v1.save_model(p1)
+    reg = ModelRegistry()
+    svc = ServingService(reg, flush_rows=128, max_delay=0.002)
+    reg.publish("default", v1, gate_rows=X[:128])
+    svc.start()
+    server = make_server(svc, port=0, admin_token="sesame")
+    import threading as _t
+    _t.Thread(target=server.serve_forever, daemon=True).start()
+    host, port = server.server_address[:2]
+    url = f"http://{host}:{port}/v1/models/default/publish"
+    body = json.dumps({"model_file": p1}).encode()
+    try:
+        try:
+            urllib.request.urlopen(urllib.request.Request(
+                url, data=body,
+                headers={"Content-Type": "application/json"}),
+                timeout=10)
+            raise AssertionError("tokenless publish must 403")
+        except urllib.error.HTTPError as exc:
+            assert exc.code == 403
+        with urllib.request.urlopen(urllib.request.Request(
+                url, data=body,
+                headers={"Content-Type": "application/json",
+                         "X-Admin-Token": "sesame"}), timeout=10) as r:
+            assert json.loads(r.read())["version"] == 2
+    finally:
+        server.shutdown()
+        server.server_close()
+        svc.stop()
+
+
+def test_wrong_width_requests_rejected_never_trip_breaker():
+    """A client sending the wrong feature count is rejected at submit
+    (structurally, against the model's num_feature — the HTTP layer
+    maps it to 400): it can neither crash a healthy coalesced batch
+    nor charge the model's breaker, and well-formed requests in the
+    same pump answer fine."""
+    bst, X = _train(seed=95)
+    reg = ModelRegistry()
+    svc = ServingService(reg, flush_rows=64, max_delay=10.0,
+                         breaker_threshold=1)
+    reg.publish("m", bst, gate_rows=X[:64])
+    good = [svc.submit(X[i].reshape(1, -1), model="m")
+            for i in range(3)]
+    for _ in range(3):
+        with pytest.raises(lgb.LightGBMError, match="features"):
+            svc.submit(np.zeros((1, F + 2)), model="m")
+    svc.pump(force=True)
+    assert all(t.status == "ok" for t in good), \
+        [(t.status, t.reason) for t in good]
+    # even at breaker_threshold=1, client faults never tripped it
+    assert svc.breakers["m"].state == "closed"
+    assert svc.breakers["m"].trip_count == 0
+
+
+def test_serve_config_wiring(tmp_path):
+    """The CLI task=serve path: serve_* params build the registry +
+    service, serve_models loads and warm-publishes each entry."""
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.serving.httpd import (build_from_config,
+                                            load_models_from_config)
+    v1, X = _train(seed=91)
+    p1 = str(tmp_path / "m1.txt")
+    v1.save_model(p1)
+    cfg = Config({"task": "serve", "serve_models": f"alpha={p1}",
+                  "serve_flush_rows": 128, "serve_flush_ms": 1.0,
+                  "serve_queue_depth": 32, "serve_rate_limit": 0,
+                  "serve_breaker_threshold": 2,
+                  "serve_default_deadline_ms": 500.0,
+                  "serve_pack_budget_mb": 64.0, "verbosity": -1})
+    reg, svc = build_from_config(cfg)
+    assert reg.pack_budget_bytes == 64_000_000
+    assert svc.batcher.flush_rows == 128
+    assert svc.default_deadline == 0.5
+    load_models_from_config(reg, cfg)
+    assert reg.names() == ["alpha"]
+    t = svc.submit(X[0].reshape(1, -1), model="alpha")
+    svc.pump(force=True)
+    assert t.status == "ok"
+    want = np.asarray(v1.predict(X[0].reshape(1, -1),
+                                 raw_score=True)).reshape(-1)
+    np.testing.assert_allclose(np.asarray(t.result).reshape(-1), want,
+                               rtol=1e-6, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# fault injectors (robustness/faultinject.py serve extensions)
+# ---------------------------------------------------------------------------
+def test_faultinject_serve_injectors_contract():
+    with faultinject.injected(fail_predict_model="m",
+                              fail_predict_times=2):
+        faultinject.maybe_fail_predict("other")     # no match: silent
+        with pytest.raises(faultinject.InjectedPredictError):
+            faultinject.maybe_fail_predict("m")
+        with pytest.raises(faultinject.InjectedPredictError):
+            faultinject.maybe_fail_predict("m")
+        faultinject.maybe_fail_predict("m")         # exhausted: silent
+    with faultinject.injected(slow_predict_model=None,
+                              slow_predict_seconds=0.5,
+                              slow_predict_times=1):
+        assert faultinject.maybe_slow_predict("anything") == 0.5
+        assert faultinject.maybe_slow_predict("anything") == 0.0
+    with faultinject.injected(flood_tenant="t", flood_requests=9):
+        assert faultinject.take_flood() == ("t", 9)
+        assert faultinject.take_flood() is None     # one-shot
+    assert faultinject.take_flood() is None         # cleared

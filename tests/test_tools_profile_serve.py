@@ -1,0 +1,56 @@
+"""Smoke for tools/profile_serve.py (PR-12 satellite): the serving-
+plane load harness runs at tiny sizes, emits parseable JSON with
+p50/p99/QPS/shed-rate, proves the concurrent compile-count invariant
+in its own output, and drops a valid BENCH_obs v3 artifact whose
+fingerprint_extra carries the tenant count + bucket grid.  In-process
+to share the session's jit caches (like the other tool smokes)."""
+
+import importlib.util
+import json
+import os
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool():
+    spec = importlib.util.spec_from_file_location(
+        "profile_serve", os.path.join(HERE, "tools", "profile_serve.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_profile_serve_smoke(capsys):
+    tool = _load_tool()
+    rc = tool.main(["--smoke", "--clients", "3", "--requests", "15",
+                    "--trees", "4", "--train-rows", "1200"])
+    assert rc == 0
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    payload = json.loads(line)
+    assert payload["metric"] == "serve_load"
+    d = payload["detail"]
+    assert d["multi_traced"] == {}, f"retrace under load: {d}"
+    assert d["served"] == d["submitted"] == 45
+    assert d["shed_rate"] == 0.0
+    assert d["p50_ms"] >= 0 and d["p99_ms"] >= d["p50_ms"]
+    assert d["req_per_s"] > 0
+    # coalescing actually happened: fewer dispatches than requests
+    assert d["dispatches"] < d["submitted"]
+    assert all(v == 1 for v in d["new_traces"].values())
+
+    # BENCH_obs v3 artifact: valid, fingerprinted with the tenant count
+    # + bucket grid extra (series identity), serve metrics present
+    from lightgbm_tpu.obs import benchio
+    with open(benchio.default_path()) as fh:
+        doc = json.load(fh)
+    assert benchio.validate_bench_obs(doc) == []
+    assert doc["tool"] == "profile_serve"
+    extra = doc["fingerprint"]["knobs"]["extra"]
+    assert extra["tenants"] == 3 and extra["flush_rows"] == 256
+    # the trajectory entry landed in the (session-scratch) store with
+    # gateable metric names
+    from lightgbm_tpu.obs import regress
+    entries, _ = regress.read_history()
+    mine = [e for e in entries if e["tool"] == "profile_serve"]
+    assert mine and {"req_per_s", "p50_ms", "p99_ms",
+                     "shed_rate"} <= set(mine[-1]["metrics"])

@@ -1,0 +1,184 @@
+"""Serving-plane load harness: p50/p99/QPS/shed-rate under concurrent
+tenants, through the full stack (admission -> micro-batcher -> device
+ServingEngine).
+
+Spawns ``--clients`` tenant threads, each firing ``--requests``
+requests of ``--rows-per-request`` rows at the in-process
+ServingService (client-side latency measured per request), then
+reports percentiles, throughput, shed rate, coalescing stats and the
+per-(kind, bucket) compile counts — the invariant: every traced key
+compiled EXACTLY once however many clients ran (non-zero exit
+otherwise, like profile_predict).
+
+Prints ONE JSON line (like bench.py):
+
+  {"metric": "serve_load", "value": ..., "unit": "req_per_s",
+   "detail": {...}}
+
+and drops a BENCH_obs v3 artifact + BENCH_history.jsonl trajectory
+entry whose fingerprint_extra carries the tenant count and bucket
+grid, so two differently-shaped load experiments never share a
+detector series.
+
+Usage:
+  python tools/profile_serve.py [--clients 8] [--requests 100]
+      [--rows-per-request 1] [--trees 50] [--features 10]
+      [--flush-rows 256] [--flush-ms 2.0] [--smoke]
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def _train(lgb, rng, n_train, features, trees):
+    X = rng.normal(size=(n_train, features))
+    y = X[:, 0] + 0.5 * X[:, 1] * X[:, 2] + 0.1 * rng.normal(size=n_train)
+    bst = lgb.train({"objective": "regression", "num_leaves": 31,
+                     "verbosity": -1, "metric": ""},
+                    lgb.Dataset(X, label=y), num_boost_round=trees)
+    bst._gbdt._flush_pending()
+    return bst, X
+
+
+def run(args):
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.serving import ModelRegistry, ServingService
+
+    rng = np.random.RandomState(7)
+    bst, X = _train(lgb, rng, min(args.train_rows, 20000),
+                    args.features, args.trees)
+    reg = ModelRegistry()
+    svc = ServingService(reg, flush_rows=args.flush_rows,
+                         max_delay=args.flush_ms / 1e3,
+                         queue_depth=args.queue_depth)
+    reg.publish("m", bst,
+                gate_rows=X[:min(args.flush_rows, len(X))])
+    eng = bst._gbdt.serving
+    base = dict(eng.trace_counts)
+    svc.start()
+    lat_ms = []
+    lat_lock = threading.Lock()
+    pool = rng.normal(size=(max(4096, 2 * args.rows_per_request),
+                            args.features))
+    span = len(pool) - args.rows_per_request + 1   # full-width slices
+
+    def client(i):
+        mine = []
+        for j in range(args.requests):
+            start = (i * args.requests + j) % span
+            rows = pool[start:start + args.rows_per_request]
+            t0 = time.perf_counter()
+            t = svc.submit(rows, model="m", tenant=f"t{i}")
+            t.wait(60.0)
+            if t.status == "ok":
+                mine.append(1e3 * (time.perf_counter() - t0))
+        with lat_lock:
+            lat_ms.extend(mine)
+
+    t_start = time.perf_counter()
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(args.clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t_start
+    svc.stop()
+    stats = svc.stats()
+    new_traces = {f"{k[0]}@{k[1]}": v - base.get(k, 0)
+                  for k, v in eng.trace_counts.items()
+                  if v - base.get(k, 0) > 0}
+    warm_keys = {f"{k[0]}@{k[1]}" for k in base}
+    # the invariant has two halves: a NEW key compiles exactly once,
+    # and a key the publish warm-up already compiled never compiles
+    # again — growth on a warm key is a retrace even at delta 1
+    multi = {k: v for k, v in new_traces.items()
+             if v != 1 or k in warm_keys}
+    total = args.clients * args.requests
+    served = len(lat_ms)
+    lat = np.asarray(lat_ms) if lat_ms else np.zeros(1)
+    buckets = sorted({k[1] for k in eng.trace_counts})
+    import jax
+    detail = {
+        "clients": args.clients, "requests_per_client": args.requests,
+        "rows_per_request": args.rows_per_request,
+        "trees": args.trees, "flush_rows": args.flush_rows,
+        "flush_ms": args.flush_ms,
+        "wall_s": round(wall, 4),
+        "served": served, "submitted": total,
+        "req_per_s": round(served / max(wall, 1e-9), 1),
+        "p50_ms": round(float(np.percentile(lat, 50)), 3),
+        "p99_ms": round(float(np.percentile(lat, 99)), 3),
+        "shed_rate": stats["shed_rate"],
+        "dispatches": stats["counters"]["dispatches"],
+        "coalesced_sizes": stats["batcher"]["coalesced_sizes"],
+        "rows_per_dispatch": round(
+            served * args.rows_per_request
+            / max(stats["counters"]["dispatches"], 1), 2),
+        "buckets": buckets,
+        "new_traces": new_traces, "multi_traced": multi,
+        "smoke": bool(args.smoke),
+        "device": jax.default_backend(),
+    }
+    return {"metric": "serve_load", "value": detail["req_per_s"],
+            "unit": "req_per_s", "detail": detail}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--clients", type=int, default=8,
+                    help="concurrent tenant threads")
+    ap.add_argument("--requests", type=int, default=100,
+                    help="requests per client")
+    ap.add_argument("--rows-per-request", type=int, default=1)
+    ap.add_argument("--train-rows", type=int, default=20000)
+    ap.add_argument("--trees", type=int, default=50)
+    ap.add_argument("--features", type=int, default=10)
+    ap.add_argument("--flush-rows", type=int, default=256)
+    ap.add_argument("--flush-ms", type=float, default=2.0)
+    ap.add_argument("--queue-depth", type=int, default=4096)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny run for the tier-1 smoke lane")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        args.clients = min(args.clients, 4)
+        args.requests = min(args.requests, 25)
+        args.trees = min(args.trees, 8)
+        args.train_rows = min(args.train_rows, 3000)
+    from lightgbm_tpu.obs import benchio
+    cfg = {"rows": args.train_rows, "trees": args.trees,
+           "features": args.features, "clients": args.clients,
+           "requests": args.requests, "smoke": bool(args.smoke)}
+    # export-on-failure + series identity: tenant count and the bucket
+    # grid fork the trajectory (a 4-client smoke must never gate an
+    # 8-client headline, nor flush_rows=256 a flush_rows=1024 run)
+    extra = {"tenants": args.clients,
+             "flush_rows": args.flush_rows,
+             "rows_per_request": args.rows_per_request}
+    with benchio.abort_guard("profile_serve", cfg) as guard:
+        out = run(args)
+        d = out["detail"]
+        guard.write(d,
+                    metrics={"req_per_s": d["req_per_s"],
+                             "p50_ms": d["p50_ms"],
+                             "p99_ms": d["p99_ms"],
+                             "shed_rate": d["shed_rate"]},
+                    rows=args.train_rows, features=args.features,
+                    fingerprint_extra=extra)
+    print(json.dumps(out))
+    # the compile-count invariant is the whole point: fail loudly when
+    # concurrent load traced any (kind, bucket) more than once
+    return 1 if out["detail"]["multi_traced"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
