@@ -16,6 +16,11 @@ would otherwise catch fail tier-1 instead:
 * ``serving.transfers`` — the compiled raw-serving program contains no
   host callbacks and stays under a copy/transfer op budget in its
   entry computation.
+* ``predict.layered`` — the layered dense predictor
+  (ops/forest_tensor.py) lowers with ZERO while loops (fixed trip
+  count, unrolled at trace time), no host callbacks and the pinned
+  transfer budget: the dataflow shape cannot silently regress to
+  data-dependent traversal.
 * ``train.donation`` — the fused train step is jitted with donated
   score/payload buffers (losing donation doubles the resident score
   footprint and adds a copy per iteration).
@@ -169,6 +174,39 @@ def check_serving_transfers() -> Dict[str, int]:
     return {"entry_copies": counts["copies"],
             "transfer_ops": transfers,
             "host_callbacks": callbacks}
+
+
+def check_predict_layered() -> Dict[str, int]:
+    """The layered dense predictor (ops/forest_tensor.py) is a
+    DATAFLOW program: the lowered raw-serving path must contain ZERO
+    while loops (the trip count is a pack-time host constant, unrolled
+    at trace time — any ``while`` means the data-dependent traversal
+    silently came back), no host callbacks, and the same pinned
+    transfer budget as the loop path."""
+    import jax.numpy as jnp
+    bst, X = _tiny_serving_booster()
+    eng = bst._gbdt.serving
+    pack = eng._pack("insession", eng._insession_pack)
+    assert pack is not None and pack.get("layers_depth") is not None, \
+        "tiny booster must be layered-eligible"
+    binned = eng._bin(X[:128], pack["has_cat"])
+    pk = pack["per_k"][0]
+    mask = eng._tree_mask(pack["T_k"], 0, pack["T_k"])
+    fn = eng._fn("raw_layered")
+    lowered = fn.lower(pk["layers"], pk["deltas"], mask,
+                       jnp.asarray(binned),
+                       max_depth=pack["layers_depth"])
+    txt = lowered.compile().as_text()
+    from .hlo import body_counts, entry_name
+    entry = entry_name(txt)
+    counts = body_counts(txt, body_name=entry) if entry else {
+        "copies": 0}
+    return {"whiles": len(re.findall(r"\bwhile\(", txt)),
+            "host_callbacks": len(re.findall(r"callback", txt)),
+            "transfer_ops": len(re.findall(
+                r"\b(?:copy-start|copy-done|send|recv|infeed|outfeed)\(",
+                txt)),
+            "entry_copies": counts["copies"]}
 
 
 # ---------------------------------------------------------------------------
@@ -459,6 +497,7 @@ CHECKS = {
     "frontier.body": check_while_body_frontier,
     "serving.compiles": check_serving_compiles,
     "serving.transfers": check_serving_transfers,
+    "predict.layered": check_predict_layered,
     "train.donation": check_train_donation,
     "shap.kernel": check_shap_kernel,
     "continual.tick": check_continual_tick,

@@ -254,6 +254,18 @@ _PARAMS: List[_Param] = [
     # model from an arbitrary server-side file path is an OPERATOR
     # action, never an open API)
     _p("serve_admin_token", "", str),
+    # multi-forest batched execution: when >= 2 tenant models' raw
+    # full-range lanes are due in the same pump wave, stack their
+    # forests into one padded (forest, tree, node) tensor and serve the
+    # whole cohort in ONE compiled dispatch (serving/registry.py cohort
+    # packs over ops/forest_tensor.py; compile counts stay pinned per
+    # (kind, bucket, cohort-signature)).  Ineligible models (categorical
+    # splits, loaded-only, breaker not closed) fall back to per-model
+    # dispatch
+    _p("serve_cohort", False, bool),
+    # minimum due models that form a cohort dispatch (below it the
+    # per-model path is already one dispatch each)
+    _p("serve_cohort_min", 2, int, (), ">=2"),
     _p("use_quantized_grad", False, bool),
     _p("num_grad_quant_bins", 4, int),
     _p("quant_train_renew_leaf", False, bool),
@@ -309,6 +321,20 @@ _PARAMS: List[_Param] = [
     _p("predict_contrib", False, bool,
        ("is_predict_contrib", "contrib")),
     _p("predict_disable_shape_check", False, bool),
+    # serving traversal kernel (models/serving.py / ops/forest_tensor.py):
+    # "layered" reformulates packed-forest traversal as per-depth dense
+    # gather+compare ops with a FIXED trip count (= max tree depth, a
+    # pack-time host constant) and quantized u8/u16 node planes — no
+    # data-dependent while_loop in the lowered program; "loop" is the
+    # stacked while-loop oracle (ops/predict.py); "auto" serves layered
+    # whenever the forest fits the quantized planes and unroll ceiling,
+    # falling back to the loop oracle otherwise.  The f32 layered path
+    # is bit-identical to the loop oracle (tests/test_forest_tensor.py)
+    _p("predict_kernel", "auto", str),
+    # store packed leaf-value planes in bf16 (accumulation stays f32):
+    # halves the leaf gather traffic at a ~3-decimal-digit leaf
+    # precision cost — opt-in, OFF keeps bit-parity with the oracle
+    _p("predict_bf16_leaves", False, bool),
     _p("pred_early_stop", False, bool),
     _p("pred_early_stop_freq", 10, int),
     _p("pred_early_stop_margin", 10.0, float),

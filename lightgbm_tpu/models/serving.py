@@ -49,8 +49,11 @@ import jax.numpy as jnp
 from ..obs import health as obs_health
 from ..obs import memory as obs_memory
 from ..obs import telemetry as obs
+from ..ops import forest_tensor
 from ..ops.predict import predict_leaf_binned, predict_leaf_thridx
 from ..ops.shap import leggauss_01, tree_shap_stacked
+from ..utils import log
+from ..utils.log import LightGBMError
 from .shap import _expected_value, tree_path_arrays
 from .tree import K_CATEGORICAL_MASK
 
@@ -265,7 +268,12 @@ class ServingEngine:
                 vals = new_values[k::K]
                 if name == "insession":
                     pk = dict(pack["per_k"][k])
-                    pk["deltas"] = stack(vals, int(pk["deltas"].shape[1]))
+                    # keep the pack's leaf dtype (a bf16 quantized
+                    # plane refreshed as f32 would change shapes/
+                    # dtypes and re-trace)
+                    pk["deltas"] = stack(
+                        vals, int(pk["deltas"].shape[1])).astype(
+                            pk["deltas"].dtype)
                     fresh["per_k"][k] = pk
                 else:
                     node, lv = pack["per_k"][k]
@@ -273,11 +281,41 @@ class ServingEngine:
                                                      int(lv.shape[1])))
             self._packs[name] = (self._sig(), fresh)
 
+    # -- kernel selection (predict_kernel = auto | layered | loop) ------
+    def _kernel_for(self, pack) -> str:
+        """Which traversal kernel serves this pack: the layered dense
+        path (ops/forest_tensor.py — fixed trip count, quantized
+        planes) or the stacked while-loop oracle (ops/predict.py).
+        ``auto`` prefers layered whenever the pack could build planes
+        (it falls back for over-deep or overflowing forests); ``loop``
+        forces the oracle; ``layered`` forces the dense path and warns
+        once when the pack cannot take it."""
+        choice = str(getattr(self.gbdt.config, "predict_kernel",
+                             "auto") or "auto")
+        if choice not in ("auto", "layered", "loop"):
+            raise LightGBMError(
+                f"predict_kernel={choice!r} must be one of "
+                "auto | layered | loop")
+        if choice == "loop":
+            return "loop"
+        if pack.get("layers_depth") is not None:
+            return "layered"
+        if choice == "layered" and not getattr(self, "_warned_layered",
+                                               False):
+            self._warned_layered = True
+            log.warning(
+                "predict_kernel=layered: this forest cannot take the "
+                "layered path (depth > %d or bin values overflow the "
+                "quantized planes); serving from the loop oracle",
+                forest_tensor.MAX_UNROLL_DEPTH)
+        return "loop"
+
     # -- jitted predictors (one per kind; jit caches per shape) ---------
     def _fn(self, kind: str):
         if kind in self._fns:
             return self._fns[kind]
         eng = self
+        static = ()
 
         if kind == "raw":
             def f(nodes, deltas, mask, binned):
@@ -286,11 +324,27 @@ class ServingEngine:
                     lambda nd: predict_leaf_binned(binned, nd))(nodes)
                 vals = jax.vmap(jnp.take)(deltas, leaves)      # (T, n)
                 return jnp.sum(vals * mask[:, None], axis=0)
+        elif kind == "raw_layered":
+            # same (kind, bucket) trace label as the loop path: the
+            # compile-count pins are kernel-agnostic
+            def f(layers, deltas, mask, binned, max_depth):
+                eng._count_trace("raw", binned.shape[0])
+                leaves = forest_tensor.predict_leaf_layered(
+                    binned, layers, max_depth)
+                return forest_tensor.raw_from_leaves(deltas, leaves,
+                                                     mask)
+            static = ("max_depth",)
         elif kind == "leaf":
             def f(nodes, binned):
                 eng._count_trace("leaf", binned.shape[0])
                 return jax.vmap(
                     lambda nd: predict_leaf_binned(binned, nd))(nodes)
+        elif kind == "leaf_layered":
+            def f(layers, binned, max_depth):
+                eng._count_trace("leaf", binned.shape[0])
+                return forest_tensor.predict_leaf_layered(
+                    binned, layers, max_depth)
+            static = ("max_depth",)
         elif kind.startswith("contrib"):
             def f(nodes, paths, mask, tq, om, col_iota, binned,
                   _kind=kind):
@@ -311,8 +365,26 @@ class ServingEngine:
                     lambda nd: predict_leaf_thridx(packed_vals, nd))(node)
         else:
             raise ValueError(kind)
-        self._fns[kind] = jax.jit(f)
+        self._fns[kind] = jax.jit(f, static_argnames=static) \
+            if static else jax.jit(f)
         return self._fns[kind]
+
+    def _run_raw(self, sub, mask, b) -> np.ndarray:
+        """One bucketed raw-score dispatch per class forest, through
+        whichever kernel ``predict_kernel`` selects (``sub`` is a full
+        pack or a per-range sub-pack; both carry ``layers_depth``)."""
+        bd = jnp.asarray(b)
+        if self._kernel_for(sub) == "layered":
+            fn = self._fn("raw_layered")
+            d = sub["layers_depth"]
+            return np.stack(
+                [np.asarray(fn(pk["layers"], pk["deltas"], mask, bd,
+                               max_depth=d))
+                 for pk in sub["per_k"]], axis=1)
+        fn = self._fn("raw")
+        return np.stack(
+            [np.asarray(fn(pk["nodes"], pk["deltas"], mask, bd))
+             for pk in sub["per_k"]], axis=1)
 
     # -- bucketed execution over row chunks -----------------------------
     def _chunks(self, n: int, max_bucket: Optional[int] = None):
@@ -409,15 +481,45 @@ class ServingEngine:
         # (per-tree jnp.stack dispatches hundreds of tiny tunnel ops)
         host = jax.device_get([(d["nodes"], d["leaf_value"])
                                for d in g.device_trees])
+        bf16 = bool(getattr(g.config, "predict_bf16_leaves", False))
+        # predict_kernel=loop forces the oracle: skip building (and
+        # uploading) layered planes the selected kernel can never read
+        # — they cost ~45% extra resident pack bytes per model.  A
+        # later knob flip to layered/auto takes effect at the next
+        # pack build (invalidate/update), matching how the pack
+        # already binds other config at build time.
+        want_layers = str(getattr(g.config, "predict_kernel", "auto")
+                          or "auto") != "loop"
         per_k = []
+        depth = 0
         for k in range(K):
             hk = host[k::K]
-            nodes = jax.tree.map(lambda *a: jnp.asarray(np.stack(a)),
-                                 *[h[0] for h in hk])
+            host_stacked = {name: np.stack([h[0][name] for h in hk])
+                            for name in hk[0][0]}
+            nodes = jax.tree.map(jnp.asarray, dict(host_stacked))
             deltas = jnp.asarray(np.stack([h[1] for h in hk]))
-            per_k.append({"nodes": nodes, "deltas": deltas})
+            if bf16:
+                # quantized leaf plane: half the gather traffic;
+                # accumulation stays f32 (ops/forest_tensor.py
+                # raw_from_leaves) so only the leaf representation
+                # loses precision.  Opt-in — the f32 default keeps
+                # bit-parity with the loop oracle.
+                deltas = deltas.astype(jnp.bfloat16)
+            layers = (forest_tensor.pack_layered(host_stacked)
+                      if want_layers else None)
+            if layers is not None:
+                depth = max(depth, layers.pop("max_depth"))
+            per_k.append({"nodes": nodes, "deltas": deltas,
+                          "layers": layers})
+        layered_ok = all(pk["layers"] is not None for pk in per_k)
         return {"per_k": per_k, "has_cat": has_cat, "K": K,
-                "T_k": len(g.models) // K}
+                "T_k": len(g.models) // K,
+                # ONE forest-wide unroll depth (max over classes):
+                # per-class depths would compile one program per
+                # distinct depth and break the pinned one-trace-per-
+                # (kind, bucket) counts; extra levels are settled-row
+                # no-ops
+                "layers_depth": depth if layered_ok else None}
 
     def _bin(self, data: np.ndarray, has_cat: bool):
         try:
@@ -464,7 +566,10 @@ class ServingEngine:
     def _slice_insession(pk, start: int, end: int):
         return {"nodes": jax.tree.map(lambda a: a[start:end],
                                       pk["nodes"]),
-                "deltas": pk["deltas"][start:end]}
+                "deltas": pk["deltas"][start:end],
+                "layers": (forest_tensor.slice_layered(
+                    pk["layers"], start, end)
+                    if pk.get("layers") is not None else None)}
 
     @staticmethod
     def _slice_loaded(pk, start: int, end: int):
@@ -512,14 +617,10 @@ class ServingEngine:
         sub = self._range_sub("insession", pack, start_iteration,
                               end_iter, self._slice_insession)
         mask = self._tree_mask(sub["T_k"], 0, sub["T_k"])
-        fn = self._fn("raw")
 
         def run(b):
             # one device put per chunk; the K class forests share it
-            bd = jnp.asarray(b)
-            return np.stack([np.asarray(fn(pk["nodes"], pk["deltas"],
-                                           mask, bd))
-                             for pk in sub["per_k"]], axis=1)
+            return self._run_raw(sub, mask, b)
 
         out = self._run_bucketed("raw", binned, run, K)
         # boost-from-average is folded into the first HOST tree only;
@@ -541,14 +642,18 @@ class ServingEngine:
         sub = self._range_sub("insession", pack, start_iteration,
                               end_iter, self._slice_insession)
         lo = start_iteration if sub is pack else 0
-        fn = self._fn("leaf")
+        layered = self._kernel_for(sub) == "layered"
+        fn = self._fn("leaf_layered" if layered else "leaf")
         width = (end_iter - start_iteration) * K
 
         def run(b):
             bd = jnp.asarray(b)
             cols = np.zeros((b.shape[0], width), dtype=np.int32)
             for k, pk in enumerate(sub["per_k"]):
-                allk = np.asarray(fn(pk["nodes"], bd)).T  # (bucket, T_sub)
+                allk = np.asarray(
+                    fn(pk["layers"], bd, max_depth=sub["layers_depth"])
+                    if layered else fn(pk["nodes"], bd)
+                ).T                                   # (bucket, T_sub)
                 cols[:, k::K] = allk[:, lo:lo + width // K]
             return cols
 
@@ -683,7 +788,6 @@ class ServingEngine:
             return None
         n, pack, binned = ready
         K = pack["K"]
-        fn = self._fn("raw")
         out = np.zeros((n, K), dtype=np.float64)
         # boost-from-average is folded into the first HOST tree, so the
         # host loop's margins include it from iteration 0 — seed it
@@ -708,10 +812,7 @@ class ServingEngine:
             sub = binned[active]
 
             def run(b, mask=mask):
-                bd = jnp.asarray(b)
-                return np.stack([np.asarray(fn(pk["nodes"],
-                                               pk["deltas"], mask, bd))
-                                 for pk in pack["per_k"]], axis=1)
+                return self._run_raw(pack, mask, b)
 
             out[active] += self._run_bucketed("raw", sub, run, K,
                                               observe=False)

@@ -50,7 +50,8 @@ from typing import Any, Dict, List, Optional
 
 __all__ = [
     "MODES", "Telemetry", "get", "enabled", "configure_from_config",
-    "span", "counter", "gauge", "compile_event", "instant", "NULL",
+    "span", "counter", "gauge", "compile_event", "instant",
+    "observe_span", "NULL",
 ]
 
 MODES = ("off", "counters", "trace")
@@ -245,6 +246,18 @@ class Telemetry:
             return NULL
         return _Span(self, name, args)
 
+    def observe_span(self, name: str, seconds: float, **args) -> None:
+        """Record an ALREADY-measured duration into ``name``'s span
+        histogram (the serving plane's per-tenant latency: the service
+        measures one submit->complete latency per request and folds it
+        in here, so per-tenant p50/p99 ride the same report/Prometheus
+        path as real spans).  Host bookkeeping only — same zero-HLO /
+        zero-sync contract as ``span``."""
+        if self.mode == "off":
+            return
+        self._record_span(name, time.perf_counter() - seconds,
+                          float(seconds), args)
+
     def _record_span(self, name: str, t0: float, dur: float,
                      args: Dict[str, Any]) -> None:
         with self._lock:
@@ -419,6 +432,12 @@ def counter(name: str, inc: int = 1) -> None:
     if _SESSION.mode == "off":
         return
     _SESSION.counter(name, inc)
+
+
+def observe_span(name: str, seconds: float, **args) -> None:
+    if _SESSION.mode == "off":
+        return
+    _SESSION.observe_span(name, seconds, **args)
 
 
 def gauge(name: str, value: float) -> None:

@@ -1,0 +1,342 @@
+"""Forest-as-tensor inference: layered dense traversal kernels.
+
+``ops/predict.py`` walks the packed forest with a per-depth stacked
+``while_loop`` — correct everywhere, but the loop's trip count is
+data-dependent (``jnp.any(c >= 0)``), so every level pays the loop
+plumbing and the lowered program keeps a ``while`` whose body XLA
+cannot pipeline across levels.  The Booster accelerator paper
+(arXiv:2011.02022) shows GBDT inference wants a *dataflow* layout of
+dense per-level ops, and the GPU tree-boosting playbook
+(arXiv:1706.08359) batches all (row, tree) pairs into wide vector ops.
+This module is that reformulation for the serving hot path:
+
+* **Layered traversal** — the maximum root-to-leaf depth ``D`` is a
+  *pack-time host constant* (``tree_depths``), so traversal is ``D``
+  statically-unrolled level steps: each level is ONE gather of the
+  per-node planes for every (row, tree) pair plus one vectorized
+  compare, no data-dependent ``while_loop`` anywhere in the lowered
+  program (pinned by the ``predict.layered`` jaxlint tier-B budget).
+  Rows that reach their leaf early hold a negative ~leaf code and pass
+  through the remaining levels unchanged, exactly like the loop path —
+  the layered leaves are INTEGER-identical to the loop oracle's, and
+  the f32 accumulation uses the oracle's reduction order, so raw
+  scores are bit-identical.
+* **Quantized node planes** — serving inputs are already binned
+  integers, so the per-node scalars pack into the narrowest planes
+  that hold them: one u8 flags plane (missing type, default-left,
+  bundled, categorical), one u16 bin plane (column, bin start, bin
+  count, default bin, threshold) and one i16/i32 child plane.  Each
+  level gathers three small typed planes instead of one wide i32
+  stack — 2-4x less gather traffic — and every compare is still
+  integer-exact (values promote to i32 *after* the gather).
+* **Multi-forest batched execution** — ``stack_forests`` pads N small
+  forests into one (forest, tree, node) tensor and
+  ``predict_leaf_layered_forests`` traverses all of them over
+  per-forest row blocks in ONE compiled program, so a tenant cohort's
+  same-bucket requests cost a single dispatch
+  (``serving/registry.py`` cohort packs).
+
+The loop path (``predict_leaf_binned``) stays the any-shape oracle;
+the serving engine picks a kernel per the ``predict_kernel`` config
+knob (``auto | layered | loop``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+# beyond this depth the unrolled program stops paying for itself (and
+# compile time grows linearly); the engine falls back to the loop
+# oracle.  Depth ~ log2(num_leaves) for balanced trees: 64 covers every
+# realistic serving forest including fully degenerate 64-leaf chains.
+MAX_UNROLL_DEPTH = 64
+
+# flags plane rows (u8)
+_F_BUNDLED, _F_MISSING, _F_DLEFT, _F_CAT = 0, 1, 2, 3
+# bin plane rows (u16, or i32 fallback when any value overflows u16)
+_B_COL, _B_START, _B_NUMBIN, _B_DEFBIN, _B_THRESH = 0, 1, 2, 3, 4
+
+
+def tree_depths(left: np.ndarray, right: np.ndarray,
+                num_nodes: np.ndarray) -> np.ndarray:
+    """(T,) max root-to-leaf depth (= level steps to settle every row)
+    per tree, from host (T, n_max) child arrays.  An empty tree (zero
+    nodes) needs 0 steps; a single-split tree needs 1."""
+    left = np.asarray(left)
+    right = np.asarray(right)
+    num_nodes = np.asarray(num_nodes).reshape(-1)
+    T = left.shape[0] if left.ndim == 2 else 1
+    left = left.reshape(T, -1)
+    right = right.reshape(T, -1)
+    out = np.zeros(T, np.int32)
+    for t in range(T):
+        nn = int(num_nodes[t])
+        if nn <= 0:
+            continue
+        depth = np.zeros(nn, np.int32)
+        frontier = [0]
+        d = 0
+        while frontier:
+            nxt = []
+            for nid in frontier:
+                depth[nid] = d
+                for c in (int(left[t, nid]), int(right[t, nid])):
+                    if 0 <= c < nn:
+                        nxt.append(c)
+            frontier = nxt
+            d += 1
+        # a row settles after traversing every internal node on its
+        # path: deepest internal node depth + 1 steps
+        out[t] = int(depth.max()) + 1
+    return out
+
+
+def pack_layered(node_host: Dict[str, np.ndarray]) -> Optional[Dict[str, Any]]:
+    """Quantized layered planes from HOST-stacked node arrays.
+
+    ``node_host`` holds the (T, n_max) arrays of
+    ``learner.node_arrays_for_predict`` stacked over trees (plus
+    ``num_nodes`` (T,) and optionally ``is_cat``/``cat_set``).
+    Returns a device pack ``{flags8, bins, kids, num_nodes, cat_set?,
+    max_depth}`` or None when the forest cannot take the layered path
+    (values overflow the plane dtypes, or depth exceeds the unroll
+    ceiling)."""
+    num_nodes = np.asarray(node_host["num_nodes"], np.int32).reshape(-1)
+    left = np.asarray(node_host["left"], np.int32)
+    right = np.asarray(node_host["right"], np.int32)
+    if left.ndim == 1:                       # single tree: add T axis
+        left, right = left[None], right[None]
+    depths = tree_depths(left, right, num_nodes)
+    max_depth = int(depths.max()) if depths.size else 0
+    if max_depth > MAX_UNROLL_DEPTH:
+        return None
+    T, n_max = left.shape
+
+    def a2(name):
+        a = np.asarray(node_host[name], np.int64)
+        return a.reshape(T, n_max)
+
+    col = a2("col")
+    bin_start = a2("bin_start")
+    num_bin = a2("num_bin")
+    default_bin = a2("default_bin")
+    threshold = a2("threshold")
+    bins = np.stack([col, bin_start, num_bin, default_bin, threshold])
+    if bins.min() < 0:
+        return None
+    # u16 quantized bin plane when every bin-space value fits; the i32
+    # fallback keeps the layered shape (still one plane) for exotic
+    # forests rather than abandoning the dataflow layout
+    bins = bins.astype(np.uint16 if bins.max() < (1 << 16) else np.int32)
+    flags = np.stack([
+        a2("is_bundled"),
+        a2("missing_type"),
+        a2("default_left"),
+        (a2("is_cat") if "is_cat" in node_host
+         else np.zeros((T, n_max), np.int64)),
+    ])
+    if flags.min() < 0 or flags.max() > 255:
+        return None
+    flags8 = flags.astype(np.uint8)
+    kids = np.stack([left, right]).astype(np.int64)
+    # children are node ids (< n_max) or ~leaf codes (>= -n_max - 1)
+    kdtype = np.int16 if (kids.min() >= np.iinfo(np.int16).min
+                          and kids.max() <= np.iinfo(np.int16).max) \
+        else np.int32
+    pack = {
+        "flags8": jnp.asarray(flags8),
+        "bins": jnp.asarray(bins),
+        "kids": jnp.asarray(kids.astype(kdtype)),
+        "num_nodes": jnp.asarray(num_nodes),
+        "max_depth": max_depth,
+    }
+    if "cat_set" in node_host and np.asarray(
+            node_host.get("is_cat", 0)).any():
+        pack["cat_set"] = jnp.asarray(
+            np.asarray(node_host["cat_set"]).reshape(T, n_max, -1))
+    return pack
+
+
+def slice_layered(pack: Dict[str, Any], start: int,
+                  end: int) -> Dict[str, Any]:
+    """Tree-range slice of a layered pack (the engine's per-range
+    sub-packs).  ``max_depth`` stays the full-forest value: extra
+    levels are settled-row no-ops, and keeping it avoids a new compile
+    per sub-range depth."""
+    out = dict(pack)
+    out["flags8"] = pack["flags8"][:, start:end]
+    out["bins"] = pack["bins"][:, start:end]
+    out["kids"] = pack["kids"][:, start:end]
+    out["num_nodes"] = pack["num_nodes"][start:end]
+    if "cat_set" in pack:
+        out["cat_set"] = pack["cat_set"][start:end]
+    return out
+
+
+def _gather_planes(pack: Dict[str, Any], nid: jnp.ndarray):
+    """One typed gather per plane for every (tree, row) pair: (P, T, n)
+    planes indexed by nid (T, n) along the node axis, promoted to i32
+    AFTER the narrow gather."""
+    idx = nid[None, :, :]
+    flags = jnp.take_along_axis(pack["flags8"], idx, axis=2).astype(
+        jnp.int32)
+    bins = jnp.take_along_axis(pack["bins"], idx, axis=2).astype(
+        jnp.int32)
+    kids = jnp.take_along_axis(pack["kids"], idx, axis=2).astype(
+        jnp.int32)
+    return flags, bins, kids
+
+
+def _level_step(cur: jnp.ndarray, binned_t: jnp.ndarray, g_iota,
+                pack: Dict[str, Any]) -> jnp.ndarray:
+    """One dense level: gather + vectorized compare over all
+    (tree, row) pairs.  Semantics are EXACTLY the while-body of
+    ``predict_leaf_binned`` (ops/predict.py) — integer decisions, so
+    the layered leaves match the loop oracle bit-for-bit."""
+    active = cur >= 0
+    nid = jnp.maximum(cur, 0)
+    flags, bins, kids = _gather_planes(pack, nid)
+    col = bins[_B_COL]
+    # per-(tree,row) feature read as a masked lane reduction over G
+    # (ops/predict.py's proven-fast pattern); exactly one group
+    # matches, so a max-reduce keeps the narrow row dtype
+    sel = g_iota[:, None, :] == col[None, :, :]          # (G, T, n)
+    gb = jnp.max(jnp.where(sel, binned_t[:, None, :], 0),
+                 axis=0).astype(jnp.int32)
+    nb = bins[_B_NUMBIN]
+    fb_raw = gb - bins[_B_START]
+    in_range = (fb_raw >= 1) & (fb_raw <= nb - 1)
+    fb = jnp.where(flags[_F_BUNDLED] == 1,
+                   jnp.where(in_range, fb_raw, bins[_B_DEFBIN]), gb)
+    # split_decision (ops/partition.py) inlined over the planes
+    missing_type = flags[_F_MISSING]
+    default_bin = bins[_B_DEFBIN]
+    is_missing = jnp.where(
+        missing_type == 1, fb == default_bin,
+        jnp.where(missing_type == 2, fb == nb - 1, False))
+    goes_left = jnp.where(is_missing, flags[_F_DLEFT] == 1,
+                          fb <= bins[_B_THRESH])
+    if "cat_set" in pack:
+        cat_rows = jnp.take_along_axis(
+            pack["cat_set"], nid[:, :, None], axis=1)    # (T, n, W)
+        member = jnp.take_along_axis(
+            cat_rows,
+            jnp.minimum(fb, cat_rows.shape[2] - 1)[:, :, None],
+            axis=2)[:, :, 0]
+        member = member & (fb <= nb - 1)
+        goes_left = jnp.where(flags[_F_CAT] == 1, member, goes_left)
+    nxt = jnp.where(goes_left, kids[0], kids[1])
+    # empty trees land on leaf 0 immediately (same guard as the loop
+    # path: padded cohort slots and zero-node trees must settle)
+    nxt = jnp.where(pack["num_nodes"][:, None] > 0, nxt, jnp.int32(-1))
+    return jnp.where(active, nxt, cur)
+
+
+def predict_leaf_layered(binned: jnp.ndarray, pack: Dict[str, Any],
+                         max_depth: int) -> jnp.ndarray:
+    """(T, n) leaf index for every (tree, row) pair of one forest.
+
+    ``max_depth`` is a static host int (the pack's), so the level loop
+    unrolls at trace time: the lowered program has NO while loop —
+    each level is a gather + compare XLA can fuse and pipeline."""
+    n = binned.shape[0]
+    T = pack["kids"].shape[1]
+    binned_t = binned.T                                  # (G, n)
+    g_iota = jax.lax.broadcasted_iota(jnp.int32, binned_t.shape, 0)
+    cur = jnp.zeros((T, n), dtype=jnp.int32)
+    for _ in range(max_depth):
+        cur = _level_step(cur, binned_t, g_iota, pack)
+    # rows of empty trees never entered a level (max_depth 0 forests):
+    # they sit at node 0, which decodes as leaf 0 via the same guard
+    cur = jnp.where(pack["num_nodes"][:, None] > 0, cur, jnp.int32(-1))
+    return -(jnp.minimum(cur, -1) + 1)
+
+
+def raw_from_leaves(deltas: jnp.ndarray, leaves: jnp.ndarray,
+                    mask: jnp.ndarray) -> jnp.ndarray:
+    """(n,) masked raw-score sum over trees — the EXACT reduction the
+    loop path uses (models/serving.py ``_fn("raw")``), so f32 layered
+    scores are bit-identical to the loop oracle's."""
+    vals = jax.vmap(jnp.take)(deltas, leaves)            # (T, n)
+    if deltas.dtype != jnp.float32:
+        # quantized (bf16) leaf planes accumulate in f32: the cast is
+        # the only precision loss, the reduction stays f32
+        vals = vals.astype(jnp.float32)
+    return jnp.sum(vals * mask[:, None], axis=0)
+
+
+# ---------------------------------------------------------------------------
+# multi-forest batched execution
+# ---------------------------------------------------------------------------
+def stack_forests(packs: List[Dict[str, Any]],
+                  deltas: List[np.ndarray]) -> Optional[Dict[str, Any]]:
+    """Pad N host-side layered packs into ONE (forest, tree, node)
+    tensor family.  ``packs`` are host dicts (np arrays, same keys as
+    :func:`pack_layered` output); ``deltas`` the per-forest (T_f, L_f)
+    leaf-value matrices.  Padded tree slots are zero-node trees whose
+    leaf 0 carries delta 0, so they are exact no-ops under any mask.
+    Categorical forests are not stackable (per-forest cat-set widths
+    would multiply the padding); callers fall back to per-forest
+    dispatch."""
+    if any("cat_set" in p for p in packs):
+        return None
+    Nf = len(packs)
+    T_max = max(p["kids"].shape[1] for p in packs)
+    n_max = max(p["kids"].shape[2] for p in packs)
+    L_max = max(d.shape[1] for d in deltas)
+    bins_dt = (np.int32 if any(p["bins"].dtype == np.int32 for p in packs)
+               else np.uint16)
+    kids_dt = (np.int32 if any(p["kids"].dtype == np.int32 for p in packs)
+               else np.int16)
+    flags8 = np.zeros((4, Nf, T_max, n_max), np.uint8)
+    bins = np.zeros((5, Nf, T_max, n_max), bins_dt)
+    kids = np.zeros((2, Nf, T_max, n_max), kids_dt)
+    num_nodes = np.zeros((Nf, T_max), np.int32)
+    dl = np.zeros((Nf, T_max, L_max), np.float32)
+    tree_mask = np.zeros((Nf, T_max), np.float32)
+    for f, (p, d) in enumerate(zip(packs, deltas)):
+        T, n = p["kids"].shape[1], p["kids"].shape[2]
+        flags8[:, f, :T, :n] = p["flags8"]
+        bins[:, f, :T, :n] = p["bins"]
+        kids[:, f, :T, :n] = p["kids"]
+        num_nodes[f, :T] = p["num_nodes"]
+        dl[f, :T, :d.shape[1]] = d
+        tree_mask[f, :T] = 1.0
+    return {
+        "flags8": jnp.asarray(flags8),
+        "bins": jnp.asarray(bins),
+        "kids": jnp.asarray(kids),
+        "num_nodes": jnp.asarray(num_nodes),
+        "deltas": jnp.asarray(dl),
+        "tree_mask": jnp.asarray(tree_mask),
+        "max_depth": max(int(p["max_depth"]) for p in packs),
+    }
+
+
+def predict_raw_layered_forests(binned_f: jnp.ndarray,
+                                stacked: Dict[str, Any],
+                                mask: jnp.ndarray,
+                                max_depth: int) -> jnp.ndarray:
+    """(Nf, n) raw scores for N stacked forests over per-forest row
+    blocks — ONE program, one dispatch for the whole cohort.
+
+    ``binned_f`` is (Nf, n, G_max) with each forest's rows binned by
+    its OWN mappers and zero-padded to the widest group count (padded
+    columns are never referenced: real nodes' column ids stay inside
+    their forest's true G).  ``mask`` is the (Nf, T_max) tree mask
+    (stacked pad mask x any iteration-range mask)."""
+
+    def one(rows, flags8, bins, kids, num_nodes, deltas, m):
+        pack = {"flags8": flags8, "bins": bins, "kids": kids,
+                "num_nodes": num_nodes}
+        leaves = predict_leaf_layered(rows, pack, max_depth)
+        return raw_from_leaves(deltas, leaves, m)
+
+    return jax.vmap(one, in_axes=(0, 1, 1, 1, 0, 0, 0))(
+        binned_f, stacked["flags8"], stacked["bins"], stacked["kids"],
+        stacked["num_nodes"], stacked["deltas"], mask)

@@ -171,6 +171,22 @@ def maybe_fail_predict(model: str) -> None:
         f"({_fail_predict_remaining} injected failures remaining)")
 
 
+def predict_fault_armed(model: str) -> bool:
+    """True when a fail- or slow-predict injection would fire for
+    ``model``, WITHOUT consuming the injection budget.  The serving
+    cohort fast path uses this to degrade a wave to the per-model
+    dispatch path — where the counted injection then fires exactly
+    once and breaker policy owns it — so arming N failures produces N
+    recorded failures whether or not cohort lanes are on."""
+    if not _active:
+        return False
+    if _fail_predict_remaining > 0 and (
+            _fail_predict_model is None or _fail_predict_model == model):
+        return True
+    return _slow_predict_remaining > 0 and (
+        _slow_predict_model is None or _slow_predict_model == model)
+
+
 def maybe_slow_predict(model: str) -> float:
     """Seconds of injected stall for this dispatch of ``model`` (0.0
     when no slow-predict injection matches).  The CALLER advances its

@@ -15,7 +15,11 @@ Endpoints::
                              "num_iteration": -1}
     GET  /healthz           liveness + per-model breaker states
     GET  /stats             full service stats (counters, shed rates,
-                            latency histograms, registry, tenants)
+                            latency histograms incl. per-tenant
+                            p50/p99, registry, tenants)
+    GET  /metrics           Prometheus exposition text (telemetry
+                            session; per-tenant span summaries when
+                            telemetry is on)
     POST /v1/models/<name>/publish   {"model_file": "path"} hot-swap
     POST /v1/models/<name>/rollback  restore the pre-swap version
 
@@ -64,7 +68,9 @@ def build_from_config(cfg) -> Tuple[ModelRegistry, ServingService]:
         default_deadline=(float(cfg.serve_default_deadline_ms) / 1e3
                           if float(cfg.serve_default_deadline_ms) > 0
                           else None),
-        max_request_rows=int(cfg.serve_max_request_rows))
+        max_request_rows=int(cfg.serve_max_request_rows),
+        cohort=bool(cfg.serve_cohort),
+        cohort_min=int(cfg.serve_cohort_min))
     return registry, service
 
 
@@ -173,6 +179,25 @@ class _Handler(BaseHTTPRequestHandler):
                                   "loopback"})
                 return
             self._reply(200, svc.stats())
+        elif self.path == "/metrics":
+            if not self._admin_allowed():
+                self._reply(403, {"error": "operator endpoint"})
+                return
+            # Prometheus exposition text of the process telemetry
+            # session — with telemetry on, the per-tenant
+            # `serve.tenant.<tenant>.<kind>` span summaries ride it
+            from ..obs import telemetry as obs
+            from ..obs.exporters import prometheus_text
+            body = prometheus_text(obs.get()).encode("utf-8")
+            try:
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            except (BrokenPipeError, ConnectionResetError):
+                pass
         else:
             self._reply(404, {"error": f"no route {self.path}"})
 

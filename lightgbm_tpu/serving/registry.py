@@ -1,8 +1,21 @@
 """Multi-model registry: N resident forests, versioned hot-swap,
-rollback, and pack eviction by memory budget.
+rollback, pack eviction by memory budget — and cohort packs for
+multi-forest batched execution.
 
 The registry owns WHICH booster serves a name; the engines own how.
-Three invariants, all inherited from machinery that already exists:
+**Cohort packs** (:class:`CohortPack`) stack N resident tenant forests
+into one padded (forest, tree, node) tensor family
+(``ops/forest_tensor.py stack_forests``) so the service can dispatch a
+whole cohort's same-bucket raw requests as ONE compiled program — the
+ROADMAP item-1d/6 "one dispatch per tenant cohort" path.  Cohort
+compile counts are pinned per (kind, bucket, cohort-signature): the
+stacked shapes key the jit cache, so repeated same-cohort waves never
+re-trace (``cohort_traces``), and the member-version cache key makes a
+stale cohort pack impossible (any member publish/rollback bumps its
+model version and the pack rebuilds).
+
+Three older invariants, all inherited from machinery that already
+exists:
 
 * **Swap is one reference flip.**  ``publish`` warms the incoming
   booster FIRST (the PR 6 candidate-gate trick: the warm-up predict
@@ -29,12 +42,14 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..models.serving import _pack_memory_arrays
+from ..models.serving import K_EPSILON, _pack_memory_arrays, bucket_rows
 from ..obs import memory as obs_memory
+from ..obs import telemetry as obs
 from ..utils import log
 from ..utils.log import LightGBMError
 
@@ -65,8 +80,109 @@ class _Entry:
         self.rollback_count = 0
 
 
+class CohortPack:
+    """N tenant forests stacked into one padded (forest, tree, node)
+    tensor family, executed as ONE compiled program per
+    (bucket, cohort-signature).
+
+    Members flatten per class: a K-class member contributes K forests
+    sharing its row block (``model_of_forest`` routes each forest to
+    its member's rows inside the program).  Each member's rows are
+    binned with its OWN training mappers on the host and zero-padded
+    to the widest group count — padded columns are never referenced
+    (real nodes' column ids stay inside their forest's true G), and
+    padded tree slots are zero-node trees whose leaf 0 carries delta
+    0.  The f32 path reuses the layered kernel's oracle-order
+    reduction, so each member's cohort scores are bit-identical to its
+    own single-model dispatch."""
+
+    def __init__(self, names: List[str], members: List[Any],
+                 registry: "ModelRegistry"):
+        from ..ops import forest_tensor
+        self.names = list(names)
+        self._registry = registry
+        self._members = []            # (booster, engine, K, G, init)
+        host_packs, deltas = [], []
+        self.model_of_forest = []
+        for mi, bst in enumerate(members):
+            g = bst._gbdt
+            eng = g.serving
+            pack = eng._pack("insession", eng._insession_pack)
+            if (pack is None or pack.get("layers_depth") is None
+                    or pack["has_cat"]
+                    or getattr(g, "average_output", False)):
+                raise LightGBMError("cohort-ineligible member")
+            G = eng._bin(np.zeros((1, bst.num_feature())),
+                         False).shape[1]
+            self._members.append((bst, eng, pack["K"], G,
+                                  np.asarray(g.init_scores,
+                                             np.float64)))
+            for pk in pack["per_k"]:
+                hp = {k: np.asarray(v)
+                      for k, v in pk["layers"].items()}
+                hp["max_depth"] = pack["layers_depth"]
+                host_packs.append(hp)
+                deltas.append(np.asarray(pk["deltas"],
+                                         np.float32))
+                self.model_of_forest.append(mi)
+        stacked = forest_tensor.stack_forests(host_packs, deltas)
+        if stacked is None:
+            raise LightGBMError("cohort members not stackable")
+        self.max_depth = stacked.pop("max_depth")
+        self.stacked = stacked
+        self.G_max = max(m[3] for m in self._members)
+        self._model_idx = np.asarray(self.model_of_forest, np.int32)
+
+    def _jit(self):
+        # ONE registry-wide jitted program (its cache keys on the
+        # stacked shapes = the cohort signature): a rebuilt same-shape
+        # cohort pack, or a second cohort with the same padded shapes,
+        # costs ZERO new compiles
+        return self._registry._cohort_fn()
+
+    def predict_raw(self, rows_by_member: List[np.ndarray]
+                    ) -> List[np.ndarray]:
+        """One cohort dispatch: ``rows_by_member[i]`` is member i's
+        (n_i, F_i) float matrix; returns each member's raw scores in
+        its single-dispatch shape ((n_i,) for K=1, else (n_i, K))."""
+        import jax.numpy as jnp
+        assert len(rows_by_member) == len(self._members)
+        bucket = bucket_rows(max(r.shape[0] for r in rows_by_member))
+        binned = []
+        for (bst, eng, K, G, init), rows in zip(self._members,
+                                                rows_by_member):
+            b = eng._bin(np.asarray(rows, np.float64), False)
+            if b is None:
+                raise LightGBMError("cohort member failed to bin")
+            binned.append(b)
+        dt = np.result_type(*[b.dtype for b in binned])
+        binned_m = np.zeros((len(binned), bucket, self.G_max), dt)
+        for i, b in enumerate(binned):
+            binned_m[i, :b.shape[0], :b.shape[1]] = b
+        self._registry._count_cohort_call(bucket)
+        out = np.asarray(self._jit()(
+            self.stacked, jnp.asarray(self._model_idx),
+            jnp.asarray(binned_m), max_depth=self.max_depth))
+        res = []
+        off = 0
+        for (bst, eng, K, G, init), rows in zip(self._members,
+                                                rows_by_member):
+            n = rows.shape[0]
+            block = out[off:off + K, :n].T.astype(np.float64)  # (n, K)
+            off += K
+            # boost-from-average rides the first HOST tree only; the
+            # device deltas exclude it (same fold-in as raw_insession)
+            for k in range(K):
+                if abs(init[k]) > K_EPSILON:
+                    block[:, k] += init[k]
+            res.append(block[:, 0] if K == 1 else block)
+        return res
+
+
 class ModelRegistry:
     """Name -> versioned resident booster, with a pack-memory budget."""
+
+    COHORT_CACHE = 4                   # bounded LRU of cohort packs
 
     def __init__(self, pack_budget_bytes: Optional[int] = None,
                  clock: Callable[[], float] = time.monotonic):
@@ -76,6 +192,14 @@ class ModelRegistry:
         self._clock = clock
         self.evictions = 0
         self._version_listeners: List[Callable[[str], None]] = []
+        # cohort packs: built outside the registry lock (device work),
+        # cached per sorted member-name tuple and keyed by every
+        # member's model version so a stale stack is impossible
+        self._cohort_lock = threading.Lock()
+        self._cohorts: "OrderedDict[Tuple[str, ...], Any]" = \
+            OrderedDict()
+        self.cohort_traces: Dict[Any, int] = {}
+        self.cohort_calls: Dict[Any, int] = {}
 
     def subscribe_version_change(self,
                                  cb: Callable[[str], None]) -> None:
@@ -135,6 +259,7 @@ class ModelRegistry:
         log.info("registry: published %s v%d (warm traces: %s)",
                  name, ent.version,
                  {f"{k[0]}@{k[1]}": v for k, v in warm_traces.items()})
+        self._purge_cohorts(name)
         self._notify_version_change(name)
         return {"name": name, "version": ent.version,
                 "warm_traces": warm_traces}
@@ -182,12 +307,107 @@ class ModelRegistry:
             ent.last_used = self._clock()
         log.warning("registry: rolled back %s to the pre-swap version "
                     "(now v%d)", name, ent.version)
+        self._purge_cohorts(name)
         self._notify_version_change(name)
         return True
 
     def remove(self, name: str) -> bool:
         with self._lock:
-            return self._entries.pop(name, None) is not None
+            removed = self._entries.pop(name, None) is not None
+        if removed:
+            self._purge_cohorts(name)
+        return removed
+
+    # -- cohort packs (multi-forest batched execution) ------------------
+    def _purge_cohorts(self, name: str) -> None:
+        """Drop every cached cohort pack that stacks ``name``.  Called
+        on publish/rollback/remove: the version-keyed rebuild already
+        makes a stale stack impossible to SERVE, but without the purge
+        a cohort that never re-forms would keep the replaced (or
+        removed) booster and its stacked device tensors resident in
+        the LRU indefinitely."""
+        with self._cohort_lock:
+            for key in [k for k in self._cohorts if name in k]:
+                del self._cohorts[key]
+    def _cohort_fn(self):
+        fn = getattr(self, "_cohort_fn_cache", None)
+        if fn is None:
+            import jax
+            import jax.numpy as jnp
+
+            from ..ops import forest_tensor
+            reg = self
+
+            def f(stacked, model_idx, binned_m, max_depth):
+                reg._count_cohort_trace(int(binned_m.shape[1]))
+                # route each forest to its member's row block INSIDE
+                # the program: one dispatch covers the whole cohort
+                binned_f = jnp.take(binned_m, model_idx, axis=0)
+                return forest_tensor.predict_raw_layered_forests(
+                    binned_f, stacked, stacked["tree_mask"],
+                    max_depth)
+
+            fn = self._cohort_fn_cache = jax.jit(
+                f, static_argnames=("max_depth",))
+        return fn
+
+    def _count_cohort_trace(self, bucket: int) -> None:
+        k = ("cohort_raw", bucket)
+        with self._cohort_lock:
+            self.cohort_traces[k] = self.cohort_traces.get(k, 0) + 1
+        obs.compile_event(f"serving.cohort_raw@{bucket}")
+
+    def _count_cohort_call(self, bucket: int) -> None:
+        k = ("cohort_raw", bucket)
+        with self._cohort_lock:
+            self.cohort_calls[k] = self.cohort_calls.get(k, 0) + 1
+
+    def _cohort_versions(self, names) -> Optional[Tuple]:
+        with self._lock:
+            out = []
+            for n in names:
+                ent = self._entries.get(n)
+                if ent is None:
+                    return None
+                out.append((n, ent.version,
+                            ent.active._gbdt._model_version,
+                            len(ent.active._gbdt.models)))
+            return tuple(out)
+
+    def cohort_pack(self, names) -> Optional[CohortPack]:
+        """The (cached) stacked multi-forest pack serving ``names``'
+        current versions, or None when any member is absent or
+        cohort-ineligible (categorical splits, loaded-only, over-deep
+        forest).  Built OUTSIDE the registry lock — pack construction
+        is host padding + one device transfer — and keyed by every
+        member's model version, so publish/rollback can never leave a
+        stale stack serving."""
+        names = tuple(sorted(names))
+        if len(names) < 2:
+            return None
+        vers = self._cohort_versions(names)
+        if vers is None:
+            return None
+        with self._cohort_lock:
+            hit = self._cohorts.get(names)
+            if hit is not None and hit[0] == vers:
+                self._cohorts.move_to_end(names)
+                return hit[1]
+        members = [self.peek(n) for n in names]
+        try:
+            pack = CohortPack(list(names), members, self)
+        except Exception:  # noqa: BLE001 — ineligible members raise
+            # LightGBMError; a concurrently-removed member surfaces as
+            # peek()=None AttributeError.  Either way the caller falls
+            # back to per-model dispatch; never propagate from the
+            # fast path.
+            return None
+        with self._cohort_lock:
+            self._cohorts[names] = (vers, pack)
+            self._cohorts.move_to_end(names)
+            while len(self._cohorts) > self.COHORT_CACHE:
+                self._cohorts.popitem(last=False)
+        return pack
 
     # -- pack-memory budget ---------------------------------------------
     @staticmethod
@@ -260,16 +480,34 @@ class ModelRegistry:
                     for e in self._entries.values()},
                 "pack_budget_bytes": self.pack_budget_bytes,
                 "evictions": self.evictions,
+                "cohorts": self._cohort_stats(),
+            }
+
+    def _cohort_stats(self) -> Dict[str, Any]:
+        # cohort structures are guarded by _cohort_lock, NOT the
+        # registry lock: snapshot under the right one so a /stats read
+        # can never race a pump thread's pack build/eviction
+        with self._cohort_lock:
+            return {
+                "resident": [list(k) for k in self._cohorts],
+                "traces": {f"{k[0]}@{k[1]}": v
+                           for k, v in self.cohort_traces.items()},
+                "calls": {f"{k[0]}@{k[1]}": v
+                          for k, v in self.cohort_calls.items()},
             }
 
 
 def _registry_arrays(reg: ModelRegistry):
-    """Telemetry memory provider: every resident version's packs."""
+    """Telemetry memory provider: every resident version's packs plus
+    the stacked cohort tensors."""
     out = []
     for ent in list(reg._entries.values()):
         for bst in (ent.active, ent.previous):
             if bst is not None:
                 out.append(_pack_memory_arrays(bst._gbdt.serving))
+    with reg._cohort_lock:
+        cohorts = [pack.stacked for _, pack in reg._cohorts.values()]
+    out.extend(cohorts)
     return out
 
 

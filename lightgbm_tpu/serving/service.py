@@ -99,6 +99,11 @@ class ServingService:
     ``serve_*`` config parameters (config.py); ``clock`` is the single
     time source for queues, deadlines, breakers and latency stats."""
 
+    # distinct tenant ids tracked in per-tenant latency (and the
+    # telemetry span names they mint); later tenants fold into
+    # "~other" so a client rotating ids cannot grow memory unbounded
+    TENANT_MAX = 256
+
     def __init__(self, registry: ModelRegistry, *,
                  flush_rows: int = 256, max_delay: float = 0.002,
                  queue_depth: int = 256, rate: float = 0.0,
@@ -108,6 +113,7 @@ class ServingService:
                  breaker_jitter: float = 0.0, seed: int = 0,
                  default_deadline: Optional[float] = None,
                  max_request_rows: int = 65536,
+                 cohort: bool = False, cohort_min: int = 2,
                  clock: Callable[[], float] = time.monotonic):
         self.registry = registry
         self._clock = clock
@@ -131,11 +137,23 @@ class ServingService:
         self.max_request_rows = int(max_request_rows)
         self._budget_checked_at = float("-inf")
         self._rid = 0
+        # multi-forest batched execution: a pump wave whose due raw
+        # full-range lanes span >= cohort_min registry models dispatches
+        # them all as ONE compiled program (registry cohort packs)
+        self.cohort = bool(cohort)
+        self.cohort_min = max(int(cohort_min), 2)
         self.counters: Dict[str, int] = {
             "submitted": 0, "served": 0, "shed": 0, "errors": 0,
             "dispatches": 0, "dispatch_failures": 0,
-            "fallback_served": 0}
+            "fallback_served": 0, "cohort_dispatches": 0,
+            "cohort_models": 0}
         self.latency: Dict[str, Histogram] = {}
+        # per-tenant submit->complete latency (the admission layer's
+        # tenant id): p50/p99 per tenant readable from /stats even with
+        # telemetry off; with a telemetry session on, the same samples
+        # also feed `serve.tenant.<tenant>.<kind>` span histograms so
+        # the Prometheus export carries them
+        self.tenant_latency: Dict[str, Histogram] = {}
         self._worker: Optional[threading.Thread] = None
         self._running = False
         # a publish/rollback installs a DIFFERENT forest: the old
@@ -232,21 +250,12 @@ class ServingService:
                     keys = self.batcher.due(self._clock(), force=force)
                 if not keys:
                     break
+                cohort_keys = self._cohort_wave(keys)
+                if cohort_keys:
+                    dispatched += self._pump_cohort(cohort_keys)
+                    keys = [k for k in keys if k not in cohort_keys]
                 for key in keys:
-                    with self._lock:
-                        t = self._clock()
-                        live = []
-                        for req in self.batcher.drain(
-                                key, max_rows=self.batcher.flush_rows):
-                            self.admission.queue_for(
-                                req.tenant).take(req.rid)
-                            # deadline shed BEFORE dispatch, never after
-                            if self.admission.expired(req, t):
-                                self.counters["shed"] += 1
-                                req.ticket._finish("shed",
-                                                   reason="deadline")
-                                continue
-                            live.append(req)
+                    live = self._drain_live(key)
                     self._dispatch_guarded(key, live)
                     if live:
                         dispatched += 1
@@ -261,6 +270,122 @@ class ServingService:
                     self._budget_checked_at = t
                     self.registry.enforce_budget()
         return dispatched
+
+    def _drain_live(self, key) -> List[_Request]:
+        """Drain one lane (bucket-capped) with the pre-dispatch
+        deadline shed: expired requests answer "shed" before any
+        device work is spent on them."""
+        with self._lock:
+            t = self._clock()
+            live = []
+            for req in self.batcher.drain(
+                    key, max_rows=self.batcher.flush_rows):
+                self.admission.queue_for(req.tenant).take(req.rid)
+                # deadline shed BEFORE dispatch, never after
+                if self.admission.expired(req, t):
+                    self.counters["shed"] += 1
+                    req.ticket._finish("shed", reason="deadline")
+                    continue
+                live.append(req)
+        return live
+
+    # -- cohort lanes (multi-forest batched execution) -------------------
+    def _cohort_wave(self, keys) -> List[Any]:
+        """The subset of a due wave eligible for ONE cohort dispatch:
+        raw full-range lanes of >= cohort_min DISTINCT registry models
+        whose breakers are closed.  Anything else (sliced ranges,
+        leaf/contrib kinds, tripped models) keeps the per-model path —
+        the cohort is a fast path, never a change in failure policy."""
+        if not self.cohort:
+            return []
+        by_model: Dict[str, Any] = {}
+        for k in keys:
+            model, kind, start, num = k[0], k[1], k[2], k[3]
+            if kind != "raw" or start != 0 or num != -1:
+                continue
+            if model in by_model:       # two widths for one model: a
+                by_model[model] = None  # malformed lane — skip both
+                continue
+            if model not in self.registry:
+                continue
+            br = self.breakers.get(model)
+            if br is not None and br.state != "closed":
+                continue
+            by_model[model] = k
+        out = [k for k in by_model.values() if k is not None]
+        return out if len(out) >= self.cohort_min else []
+
+    def _pump_cohort(self, cohort_keys) -> int:
+        """Dispatch a cohort wave as ONE compiled program; falls back
+        to per-model dispatch when the pack can't build or the
+        dispatch fails (injected faults and ineligible members keep
+        their normal per-model semantics)."""
+        live_by_key = [(k, self._drain_live(k)) for k in cohort_keys]
+        live_by_key = [(k, live) for k, live in live_by_key if live]
+
+        def singles():
+            n = 0
+            for k, live in live_by_key:
+                self._dispatch_guarded(k, live)
+                n += 1
+            return n
+
+        if len(live_by_key) < self.cohort_min:
+            return singles()
+        try:
+            # planted faults (drills) degrade the wave to the
+            # per-model path WITHOUT spending the counted injection
+            # budget: the per-model dispatch then fires the injection
+            # exactly once and breaker policy owns it, so arming N
+            # failures records N failures whether cohort lanes are on
+            # or off
+            if any(faultinject.predict_fault_armed(k[0])
+                   for k, _ in live_by_key):
+                return singles()
+            pack = self.registry.cohort_pack(
+                [k[0] for k, _ in live_by_key])
+            if pack is None:
+                return singles()
+            reqs_by_model = {k[0]: live for k, live in live_by_key}
+            Xs, total = [], 0
+            for name in pack.names:
+                reqs = reqs_by_model[name]
+                self.registry.get(name)      # bump the LRU clock
+                X = (reqs[0].rows if len(reqs) == 1
+                     else np.concatenate([r.rows for r in reqs],
+                                         axis=0))
+                Xs.append(X)
+                total += X.shape[0]
+            with (obs.span("serve.dispatch.cohort",
+                           models=",".join(pack.names), rows=total)
+                  if obs.enabled() else obs.NULL):
+                outs = pack.predict_raw(Xs)
+        except Exception as exc:  # noqa: BLE001 — the cohort is an
+            # optimization: ANY failure between the drain and the
+            # dispatch (a concurrently removed member, a pack that
+            # cannot build, a member fault) degrades the WAVE to the
+            # per-model path, whose breaker/fallback policy then
+            # attributes the failure to the model that owns it.
+            # Nothing before this point completes a ticket, so the
+            # fallback can never double-answer and drained requests
+            # are never stranded.
+            log.warning("serve: cohort dispatch failed (%s); "
+                        "falling back to per-model dispatch", exc)
+            return singles()
+        self.counters["dispatches"] += 1
+        self.counters["cohort_dispatches"] += 1
+        self.counters["cohort_models"] += len(pack.names)
+        for name, out in zip(pack.names, outs):
+            # a cohort dispatch IS a successful serve of the member:
+            # reset its consecutive-failure count like the per-model
+            # path does, else stray failures accumulate across an
+            # arbitrarily long window of cohort successes and trip a
+            # "consecutive"-failure breaker
+            br = self.breakers.get(name)
+            if br is not None:
+                br.record_success()
+            self._complete(reqs_by_model[name], out, name, "raw")
+        return 1
 
     def _dispatch_guarded(self, key, live: List[_Request]) -> None:
         if not live:
@@ -370,9 +495,15 @@ class ServingService:
         X = (reqs[0].rows if len(reqs) == 1
              else np.concatenate([r.rows for r in reqs], axis=0))
         self.counters["dispatches"] += 1
+        # the tenant id the admission layer already knows rides the
+        # dispatch span (coalesced multi-tenant batches tag "multi" —
+        # per-tenant latency is exact in _complete either way)
+        tenants = {r.tenant for r in reqs}
+        tenant = tenants.pop() if len(tenants) == 1 else "multi"
         try:
             with (obs.span(f"serve.dispatch.{kind}",
-                           model=model, rows=int(X.shape[0]))
+                           model=model, tenant=tenant,
+                           rows=int(X.shape[0]))
                   if obs.enabled() else obs.NULL):
                 out = self._predict(booster, kind, X, start, num,
                                     inject_model=None if fallback
@@ -421,12 +552,32 @@ class ServingService:
         # per-request copies, not views: a view would pin the WHOLE
         # coalesced batch output for as long as any one ticket lives
         split = len(reqs) > 1
+        tel = obs.enabled()
         for req in reqs:
             n = req.rows.shape[0]
             res = out[pos:pos + n].copy() if split else out[pos:pos + n]
             pos += n
             lat = now - req.t_submit
             hist.observe(lat)
+            # tenant is a client-supplied string: bound the per-tenant
+            # map (same hazard as client-supplied model names — an id
+            # rotator would otherwise grow service memory AND the
+            # Prometheus exposition without bound); overflow tenants
+            # fold into one "~other" bucket
+            tkey = req.tenant
+            th = self.tenant_latency.get(tkey)
+            if th is None:
+                if len(self.tenant_latency) >= self.TENANT_MAX:
+                    tkey = "~other"
+                th = self.tenant_latency.get(tkey)
+                if th is None:
+                    th = self.tenant_latency[tkey] = Histogram()
+            th.observe(lat)
+            if tel:
+                # same sample into the telemetry session so the
+                # Prometheus export carries per-tenant p50/p99
+                obs.observe_span(f"serve.tenant.{tkey}.{kind}",
+                                 lat, model=model)
             self.counters["served"] += 1
             if fallback:
                 self.counters["fallback_served"] += 1
@@ -494,5 +645,12 @@ class ServingService:
                 for m, br in sorted(dict(self.breakers).items())},
             "latency": {k: h.to_json()
                         for k, h in sorted(dict(self.latency).items())},
+            # per-tenant p50/p99 from the admission layer's tenant id
+            # (ROADMAP item 1a): readable straight from /stats
+            "tenant_latency": {
+                t: {"count": h.count,
+                    "p50_s": round(h.quantile(0.5), 6),
+                    "p99_s": round(h.quantile(0.99), 6)}
+                for t, h in sorted(dict(self.tenant_latency).items())},
             "registry": self.registry.stats(),
         }

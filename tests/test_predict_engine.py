@@ -462,3 +462,147 @@ def test_standalone_engine_pickle_never_warm_stays_cold():
     eng2 = pickle.loads(pickle.dumps(bst._gbdt.serving))
     assert eng2.raw_loaded(X[:32], 0, 3) is None, \
         "tiny batch on a never-warm standalone copy stays on the host"
+
+
+# ---------------------------------------------------------------------------
+# multi-forest cohort dispatch (serving/registry.py CohortPack +
+# serving/service.py cohort lanes over ops/forest_tensor.py)
+# ---------------------------------------------------------------------------
+def _tenant_booster(seed, rounds=5):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(500, 5))
+    y = X[:, 0] + 0.5 * np.sin(X[:, 1]) + 0.1 * rng.normal(size=500)
+    bst = lgb.train(dict(BASE, objective="regression", num_leaves=7,
+                         min_data_in_leaf=5, seed=seed),
+                    lgb.Dataset(X, label=y), num_boost_round=rounds)
+    bst._gbdt._flush_pending()
+    return bst, X
+
+
+def test_cohort_wave_is_one_dispatch_with_pinned_compiles():
+    """The acceptance gate: an N-tenant same-bucket raw wave serves in
+    ONE dispatch (compile/dispatch counters under concurrent clients),
+    repeated waves never re-trace the cohort program, and every
+    tenant's cohort answers are bit-identical to its own single-model
+    dispatch."""
+    import threading
+
+    from lightgbm_tpu.serving import ModelRegistry, ServingService
+
+    boosters = {f"m{i}": _tenant_booster(20 + i) for i in range(3)}
+    reg = ModelRegistry()
+    svc = ServingService(reg, flush_rows=64, max_delay=10.0,
+                         queue_depth=1024, cohort=True)
+    for name, (bst, X) in boosters.items():
+        reg.publish(name, bst, gate_rows=X)
+    want = {name: np.asarray(bst.predict(X[:40], raw_score=True))
+            for name, (bst, X) in boosters.items()}
+
+    tickets = {}
+
+    def client(name):
+        _, X = boosters[name]
+        tickets[name] = [svc.submit(X[i].reshape(1, -1), model=name,
+                                    kind="raw", tenant=name)
+                         for i in range(40)]
+
+    threads = [threading.Thread(target=client, args=(n,))
+               for n in boosters]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert svc.pump(force=True) == 1, "one cohort dispatch for the wave"
+    assert svc.counters["dispatches"] == 1
+    assert svc.counters["cohort_dispatches"] == 1
+    assert svc.counters["cohort_models"] == 3
+    for name, ts in tickets.items():
+        got = np.asarray([t.result for t in ts]).reshape(-1)
+        np.testing.assert_array_equal(got, want[name].reshape(-1))
+
+    # repeated same-cohort waves: calls accumulate, traces stay pinned
+    for _ in range(2):
+        for name, (bst, X) in boosters.items():
+            svc.submit(X[:40], model=name, kind="raw", tenant=name)
+        svc.pump(force=True)
+    assert svc.counters["cohort_dispatches"] == 3
+    traces = dict(reg.cohort_traces)
+    assert traces == {("cohort_raw", 128): 1}, traces
+    assert reg.cohort_calls[("cohort_raw", 128)] == 3
+
+    # a member publish bumps its version: the stale pack is impossible
+    # (rebuild) but the SAME padded shapes hit the jit cache — zero new
+    # compiles
+    bst2, X2 = _tenant_booster(77)
+    reg.publish("m1", bst2, gate_rows=X2)
+    want2 = np.asarray(bst2.predict(X2[:40], raw_score=True))
+    t2 = svc.submit(X2[:40], model="m1", kind="raw", tenant="m1")
+    for name in ("m0", "m2"):
+        bst, X = boosters[name]
+        svc.submit(X[:40], model=name, kind="raw", tenant=name)
+    svc.pump(force=True)
+    np.testing.assert_array_equal(
+        np.asarray(t2.result).reshape(-1), want2.reshape(-1))
+    assert dict(reg.cohort_traces) == {("cohort_raw", 128): 1}
+    assert svc.counters["cohort_dispatches"] == 4
+
+
+def test_cohort_ineligible_members_fall_back_per_model(mc_model):
+    """Sliced ranges, non-raw kinds and categorical (cohort-ineligible)
+    members keep the per-model path; eligible pairs still cohort."""
+    from lightgbm_tpu.serving import ModelRegistry, ServingService
+
+    mc, Xmc = mc_model
+    (b0, X0), (b1, X1) = _tenant_booster(31), _tenant_booster(33)
+    reg = ModelRegistry()
+    svc = ServingService(reg, flush_rows=64, max_delay=10.0,
+                         queue_depth=1024, cohort=True)
+    reg.publish("a", b0, gate_rows=X0)
+    reg.publish("b", b1, gate_rows=X1)
+    reg.publish("cat", mc, gate_rows=Xmc)
+    # a sliced lane and a leaf lane never join a cohort wave
+    ta = svc.submit(X0[:8], model="a", kind="raw", num_iteration=2)
+    tb = svc.submit(X1[:8], model="b", kind="leaf")
+    svc.pump(force=True)
+    assert svc.counters["cohort_dispatches"] == 0
+    assert svc.counters["dispatches"] == 2
+    assert ta.status == "ok" and tb.status == "ok"
+    # a categorical member degrades the WAVE to per-model dispatch
+    # (cohort_pack returns None), but every ticket still answers
+    svc.submit(X0[:8], model="a", kind="raw")
+    svc.submit(X1[:8], model="b", kind="raw")
+    tc = svc.submit(Xmc[:8], model="cat", kind="raw")
+    svc.pump(force=True)
+    assert svc.counters["cohort_dispatches"] == 0
+    assert tc.status == "ok"
+    np.testing.assert_allclose(
+        np.asarray(tc.result),
+        np.asarray(mc.predict(Xmc[:8], raw_score=True)),
+        rtol=0, atol=0)
+
+
+def test_cohort_pack_purged_on_publish_and_remove():
+    """publish/rollback/remove purge cached cohort packs stacking the
+    name: a cohort that never re-forms must not pin the replaced (or
+    removed) booster's device tensors in the LRU."""
+    from lightgbm_tpu.serving import ModelRegistry, ServingService
+
+    boosters = {f"p{i}": _tenant_booster(50 + i) for i in range(2)}
+    reg = ModelRegistry()
+    svc = ServingService(reg, flush_rows=64, max_delay=10.0,
+                         queue_depth=1024, cohort=True)
+    for name, (bst, X) in boosters.items():
+        reg.publish(name, bst, gate_rows=X)
+    for name, (bst, X) in boosters.items():
+        svc.submit(X[:16], model=name, kind="raw")
+    assert svc.pump(force=True) == 1
+    assert len(reg._cohorts) == 1
+    bst2, X2 = _tenant_booster(59)
+    reg.publish("p0", bst2, gate_rows=X2)
+    assert len(reg._cohorts) == 0, "publish must purge member cohorts"
+    svc.submit(X2[:16], model="p0", kind="raw")
+    svc.submit(boosters["p1"][1][:16], model="p1", kind="raw")
+    assert svc.pump(force=True) == 1           # rebuilt, still 1 wave
+    assert len(reg._cohorts) == 1
+    reg.remove("p1")
+    assert len(reg._cohorts) == 0, "remove must purge member cohorts"

@@ -229,7 +229,14 @@ def test_hot_swap_under_live_load_zero_retraces():
 # ---------------------------------------------------------------------------
 # Acceptance 2: deterministic drills (same seed -> identical reports)
 # ---------------------------------------------------------------------------
-@pytest.mark.parametrize("scenario", DRILL_SCENARIOS)
+# tier-1 window trim (PR 13): replay-determinism is ONE property of
+# the shared ManualClock drill machinery — [flood] is the fast
+# in-window representative; the other scenarios' behavior keeps its
+# own dedicated in-window test below, and their replay lanes run in
+# the slow tier
+@pytest.mark.parametrize("scenario", [
+    pytest.param(s, marks=pytest.mark.slow) if s != "flood"
+    else s for s in DRILL_SCENARIOS])
 def test_drills_replay_bit_identically(scenario):
     r1 = run_serve_drill(scenario, seed=3)
     r2 = run_serve_drill(scenario, seed=3)
@@ -601,3 +608,92 @@ def test_faultinject_serve_injectors_contract():
         assert faultinject.take_flood() == ("t", 9)
         assert faultinject.take_flood() is None     # one-shot
     assert faultinject.take_flood() is None         # cleared
+
+
+def test_per_tenant_latency_in_stats_and_prometheus():
+    """ROADMAP item 1a slice: the admission layer's tenant id reaches
+    (1) /stats as exact per-tenant p50/p99 (telemetry may be off) and
+    (2) with a telemetry session on, the `serve.tenant.<t>.<kind>`
+    span histograms the Prometheus export carries."""
+    from lightgbm_tpu.obs import telemetry as obs
+    from lightgbm_tpu.obs.exporters import prometheus_text
+
+    bst, X = _train()
+    reg = ModelRegistry()
+    svc = ServingService(reg, flush_rows=64, max_delay=10.0,
+                         queue_depth=1024)
+    reg.publish("m", bst, gate_rows=X)
+    obs.get().reset(mode="counters")
+    try:
+        for tenant, lo in (("web", 0), ("app", 8)):
+            for i in range(4):
+                svc.submit(X[lo + i].reshape(1, -1), model="m",
+                           kind="raw", tenant=tenant)
+        svc.pump(force=True)
+        stats = svc.stats()
+        tl = stats["tenant_latency"]
+        assert set(tl) == {"web", "app"}
+        for t in ("web", "app"):
+            assert tl[t]["count"] == 4
+            assert tl[t]["p99_s"] >= tl[t]["p50_s"] >= 0.0
+        # the dispatch span carries the tenant when a lane is
+        # single-tenant... here both lanes coalesced into one batch:
+        # per-tenant exactness lives in the _complete samples
+        rep = obs.get().report()
+        spans = {k: v for k, v in rep["spans"].items()
+                 if k.startswith("serve.tenant.")}
+        assert set(spans) == {"serve.tenant.web.raw",
+                              "serve.tenant.app.raw"}, spans
+        assert all(v["count"] == 4 for v in spans.values())
+        text = prometheus_text(obs.get())
+        assert 'serve_tenant_web_raw' in text.replace(".", "_")
+    finally:
+        obs.get().reset(mode="off")
+
+
+def test_cohort_fault_degrades_without_spending_injection_budget():
+    """Review fix (PR 13): the cohort pre-check probes armed faults
+    NON-destructively (`predict_fault_armed`), so N armed failures
+    record N per-model breaker failures with cohort lanes on — the
+    wave degrades to the per-model path and the breaker trips after
+    exactly `threshold` waves, same as serve_cohort=False.  Every
+    drained ticket still answers (nothing stranded)."""
+    (b0, X0), (b1, X1) = _train(seed=41), _train(seed=43)
+    reg = ModelRegistry()
+    svc = ServingService(reg, flush_rows=64, max_delay=10.0,
+                         queue_depth=1024, cohort=True,
+                         breaker_threshold=2)
+    reg.publish("a", b0, gate_rows=X0)
+    reg.publish("b", b1, gate_rows=X1)
+    with faultinject.injected(fail_predict_model="a",
+                              fail_predict_times=2):
+        for _ in range(2):
+            ta = svc.submit(X0[:8], model="a", kind="raw", tenant="a")
+            tb = svc.submit(X1[:8], model="b", kind="raw", tenant="b")
+            svc.pump(force=True)
+            assert tb.status == "ok"
+            assert ta.status == "error", (ta.status, ta.reason)
+        assert svc.counters["cohort_dispatches"] == 0
+        assert svc.breakers["a"].state == "open"
+    # budget exhausted + breaker open: "a" is excluded from waves, a
+    # 1-model remainder is below cohort_min, so "b" serves per-model
+    tb = svc.submit(X1[:8], model="b", kind="raw", tenant="b")
+    svc.pump(force=True)
+    assert tb.status == "ok"
+
+    # successful cohort dispatches RESET consecutive-failure counts: a
+    # stray failure must not accumulate across cohort successes
+    svc2 = ServingService(reg, flush_rows=64, max_delay=10.0,
+                          queue_depth=1024, cohort=True,
+                          breaker_threshold=2)
+    with faultinject.injected(fail_predict_model="a",
+                              fail_predict_times=1):
+        svc2.submit(X0[:8], model="a", kind="raw")
+        svc2.submit(X1[:8], model="b", kind="raw")
+        svc2.pump(force=True)                 # one failure recorded
+    assert svc2.breakers["a"].consecutive_failures == 1
+    svc2.submit(X0[:8], model="a", kind="raw")
+    svc2.submit(X1[:8], model="b", kind="raw")
+    assert svc2.pump(force=True) == 1         # clean cohort wave
+    assert svc2.counters["cohort_dispatches"] == 1
+    assert svc2.breakers["a"].consecutive_failures == 0

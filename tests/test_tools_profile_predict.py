@@ -25,7 +25,8 @@ def _load_tool():
 
 def test_profile_predict_smoke(capsys):
     tool = _load_tool()
-    rc = tool.main(["--smoke", "--rows", "1200", "--trees", "4"])
+    rc = tool.main(["--smoke", "--rows", "1200", "--trees", "4",
+                    "--cohort", "2"])
     assert rc == 0
     line = capsys.readouterr().out.strip().splitlines()[-1]
     payload = json.loads(line)
@@ -40,3 +41,14 @@ def test_profile_predict_smoke(capsys):
     # every traced (kind, bucket) was called at least once yet traced
     # exactly once
     assert all(v == 1 for v in detail["traces"].values())
+    # PR-13 lanes: the layered-vs-loop A/B is bit-exact with its own
+    # engines' compile counts pinned, and the 2-model cohort wave cost
+    # ONE dispatch with the cohort program traced exactly once
+    ab = detail["kernel_ab"]
+    assert ab["bit_parity_max_abs"] == 0.0
+    assert ab["multi_traced"] == {}
+    assert ab["grid"] and all(
+        g["layered_rows_trees_per_s"] > 0 for g in ab["grid"])
+    co = detail["cohort"]
+    assert co["violations"] == [], co["violations"]
+    assert co["cohort_traces"] == {"cohort_raw@128": 1}

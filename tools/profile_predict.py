@@ -11,9 +11,22 @@ Prints ONE JSON line (like bench.py):
   {"metric": "predict_serving", "detail": {"grid": [...],
    "traces": {...}, "device": "..."}}
 
+Two PR-13 lanes ride along:
+
+* **layered-vs-loop A/B** (always on): two identically-trained
+  boosters — one forced to the layered dense kernel
+  (ops/forest_tensor.py), one to the while-loop oracle — timed at
+  serving shapes (128..64k-row buckets), reporting rows*trees/sec per
+  kernel and the speedup, with each engine's per-(kind, bucket)
+  compile counts still pinned at one.
+* **--cohort N**: N tenant forests behind the serving plane with
+  ``serve_cohort`` on — one same-bucket raw wave per pump must cost
+  exactly ONE dispatch (asserted; rc!=0 on violation), timed against
+  the per-model dispatch baseline.
+
 Usage:
   python tools/profile_predict.py [--rows 100000] [--trees 100]
-      [--features 10] [--smoke]
+      [--features 10] [--cohort 0] [--smoke]
 
 ``--smoke`` shrinks the grid to seconds for the tier-1 lane.
 """
@@ -43,6 +56,141 @@ def _timed(fn, *args, **kw):
     t0 = time.time()
     out = fn(*args, **kw)
     return time.time() - t0, out
+
+
+def _warm_median(fn, reps=3):
+    return float(np.median([_timed(fn)[0] for _ in range(reps)]))
+
+
+def run_ab(rows, trees, features, smoke):
+    """Layered-vs-loop kernel A/B over serving-shaped row buckets.
+
+    Two boosters trained on the same data/seed hold bit-identical
+    trees; one serves through ``predict_kernel=layered``, one through
+    ``loop``, so each engine keeps its own jit caches and its own
+    pinned one-trace-per-(kind, bucket) counts."""
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(5)
+    n_train = min(rows, 20000)
+    X = rng.normal(size=(n_train, features))
+    y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2] > 0).astype(np.float64)
+
+    def train(kernel):
+        bst = lgb.train({"objective": "binary", "num_leaves": 31,
+                         "verbosity": -1, "metric": "",
+                         "predict_kernel": kernel},
+                        lgb.Dataset(X, label=y), num_boost_round=trees)
+        bst._gbdt._flush_pending()
+        return bst
+
+    lay, loop = train("layered"), train("loop")
+    warm = rng.normal(size=(max(4096, min(rows, 8192)), features))
+    for b in (lay, loop):
+        b.predict(warm, raw_score=True)
+    pack = lay._gbdt.serving._packs["insession"][1]
+    assert pack.get("layers_depth") is not None, \
+        "A/B forest must be layered-eligible"
+    grid = []
+    row_grid = [n for n in (128, 1024, 8192, 65536) if n <= rows]
+    parity = 0.0
+    for n in row_grid:
+        Xp = rng.normal(size=(n, features))
+        a = np.asarray(lay.predict(Xp, raw_score=True))
+        b = np.asarray(loop.predict(Xp, raw_score=True))
+        parity = max(parity, float(np.max(np.abs(a - b))))
+        t_lay = _warm_median(lambda: lay.predict(Xp, raw_score=True))
+        t_loop = _warm_median(lambda: loop.predict(Xp, raw_score=True))
+        grid.append({
+            "rows": n, "trees": trees,
+            "layered_warm_s": round(t_lay, 5),
+            "loop_warm_s": round(t_loop, 5),
+            "layered_rows_trees_per_s":
+                round(n * trees / max(t_lay, 1e-9)),
+            "loop_rows_trees_per_s":
+                round(n * trees / max(t_loop, 1e-9)),
+            "layered_speedup": round(t_loop / max(t_lay, 1e-9), 3)})
+    multi = {}
+    for tag, b in (("layered", lay), ("loop", loop)):
+        for k, v in b._gbdt.serving.stats()["traces"].items():
+            if v != 1:
+                multi[f"{tag}:{k[0]}@{k[1]}"] = v
+    return {"grid": grid, "bit_parity_max_abs": parity,
+            "multi_traced": multi, "depth": pack["layers_depth"]}
+
+
+def run_cohort(n_models, trees, features, smoke):
+    """N tenant forests behind the serving plane with cohort lanes on:
+    every same-bucket raw wave must cost exactly ONE dispatch, timed
+    against the per-model dispatch baseline."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.serving import ModelRegistry, ServingService
+
+    rng = np.random.RandomState(7)
+    wave_rows = 128 if smoke else 1024
+    waves = 3 if smoke else 10
+    boosters = []
+    for i in range(n_models):
+        Xt = rng.normal(size=(2000, features))
+        yt = Xt[:, 0] + 0.5 * np.sin(Xt[:, 1]) \
+            + 0.1 * rng.normal(size=2000)
+        bst = lgb.train({"objective": "regression", "num_leaves": 31,
+                         "verbosity": -1, "metric": "", "seed": i},
+                        lgb.Dataset(Xt, label=yt),
+                        num_boost_round=trees)
+        bst._gbdt._flush_pending()
+        boosters.append((f"m{i}", bst, Xt))
+
+    def service(cohort):
+        reg = ModelRegistry()
+        svc = ServingService(reg, flush_rows=wave_rows, max_delay=10.0,
+                             queue_depth=1 << 16, cohort=cohort)
+        for name, bst, Xt in boosters:
+            reg.publish(name, bst, gate_rows=Xt)
+        return reg, svc
+
+    def wave(svc):
+        for name, bst, Xt in boosters:
+            svc.submit(Xt[:wave_rows], model=name, kind="raw",
+                       tenant=name)
+        return svc.pump(force=True)
+
+    violations = []
+    reg_c, svc_c = service(True)
+    wave(svc_c)                                    # warm cohort pack
+    t0 = time.time()
+    for _ in range(waves):
+        if wave(svc_c) != 1:
+            violations.append("cohort wave took >1 dispatch")
+    cohort_s = (time.time() - t0) / waves
+    if svc_c.counters["cohort_dispatches"] != waves + 1:
+        violations.append(
+            f"cohort_dispatches={svc_c.counters['cohort_dispatches']}"
+            f" want {waves + 1}")
+    bad_traces = {f"{k[0]}@{k[1]}": v
+                  for k, v in reg_c.cohort_traces.items() if v != 1}
+    if bad_traces:
+        violations.append(f"cohort retrace: {bad_traces}")
+
+    reg_p, svc_p = service(False)
+    wave(svc_p)                                    # warm per-model
+    t0 = time.time()
+    for _ in range(waves):
+        if wave(svc_p) != n_models:
+            violations.append("per-model wave dispatch count off")
+    permodel_s = (time.time() - t0) / waves
+    return {"models": n_models, "wave_rows": wave_rows,
+            "waves": waves,
+            "cohort_wave_s": round(cohort_s, 5),
+            "permodel_wave_s": round(permodel_s, 5),
+            "cohort_waves_per_s": round(1.0 / max(cohort_s, 1e-9), 2),
+            "permodel_waves_per_s":
+                round(1.0 / max(permodel_s, 1e-9), 2),
+            "cohort_speedup":
+                round(permodel_s / max(cohort_s, 1e-9), 3),
+            "cohort_traces": {f"{k[0]}@{k[1]}": v
+                              for k, v in reg_c.cohort_traces.items()},
+            "violations": violations}
 
 
 def run(rows, trees, features, smoke, host_oracle_rows):
@@ -108,6 +256,10 @@ def main(argv=None):
     ap.add_argument("--host-oracle-rows", type=int, default=2000,
                     help="rows for the host-recursion comparison point "
                          "(0 disables)")
+    ap.add_argument("--cohort", type=int, default=0, metavar="N",
+                    help="multi-forest lane: N tenant forests, one "
+                         "cohort dispatch per wave asserted "
+                         "(0 disables)")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny grid for the tier-1 smoke lane")
     args = ap.parse_args(argv)
@@ -117,24 +269,50 @@ def main(argv=None):
         args.host_oracle_rows = min(args.host_oracle_rows, 200)
     from lightgbm_tpu.obs import benchio
     cfg = {"rows": args.rows, "trees": args.trees,
-           "features": args.features, "smoke": bool(args.smoke)}
+           "features": args.features, "smoke": bool(args.smoke),
+           "cohort": args.cohort}
     # export-on-failure guard: a crashed harness still drops an aborted
     # BENCH_obs artifact + BENCH_history.jsonl trajectory entry
     with benchio.abort_guard("profile_predict", cfg) as guard:
         out = run(args.rows, args.trees, args.features, args.smoke,
                   args.host_oracle_rows)
-        top = out["detail"]["grid"][-1]
-        guard.write(out["detail"],
-                    metrics={"raw_rows_per_s": top["raw_rows_per_s"],
-                             "contrib_rows_per_s":
-                                 top["contrib_rows_per_s"],
-                             "raw_warm_s": top["raw_warm_s"],
-                             "contrib_warm_s": top["contrib_warm_s"]},
-                    rows=args.rows, features=args.features)
+        ab = run_ab(args.rows, args.trees, args.features, args.smoke)
+        out["detail"]["kernel_ab"] = ab
+        metrics = {"raw_rows_per_s":
+                       out["detail"]["grid"][-1]["raw_rows_per_s"],
+                   "contrib_rows_per_s":
+                       out["detail"]["grid"][-1]["contrib_rows_per_s"],
+                   "raw_warm_s":
+                       out["detail"]["grid"][-1]["raw_warm_s"],
+                   "contrib_warm_s":
+                       out["detail"]["grid"][-1]["contrib_warm_s"]}
+        abg = ab["grid"][-1]
+        metrics.update({
+            "layered_rows_trees_per_s":
+                abg["layered_rows_trees_per_s"],
+            "loop_rows_trees_per_s": abg["loop_rows_trees_per_s"],
+            "layered_speedup": abg["layered_speedup"]})
+        violations = list(ab["multi_traced"].items())
+        if ab["bit_parity_max_abs"] != 0.0:
+            violations.append(("layered_bit_parity",
+                               ab["bit_parity_max_abs"]))
+        if args.cohort:
+            co = run_cohort(args.cohort, args.trees, args.features,
+                            args.smoke)
+            out["detail"]["cohort"] = co
+            metrics.update({
+                "cohort_waves_per_s": co["cohort_waves_per_s"],
+                "cohort_speedup": co["cohort_speedup"]})
+            violations.extend((v, 1) for v in co["violations"])
+        guard.write(out["detail"], metrics=metrics,
+                    rows=args.rows, features=args.features,
+                    fingerprint_extra={"cohort": args.cohort}
+                    if args.cohort else None)
     print(json.dumps(out))
-    # non-zero exit when the compile-count invariant is violated, so the
-    # smoke lane fails loudly on a retrace regression
-    return 1 if out["detail"]["multi_traced"] else 0
+    # non-zero exit when a pinned invariant breaks: a retrace per
+    # (kind, bucket), layered-vs-loop bit divergence, or a cohort wave
+    # costing more than one dispatch
+    return 1 if (out["detail"]["multi_traced"] or violations) else 0
 
 
 if __name__ == "__main__":
