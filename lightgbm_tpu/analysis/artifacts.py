@@ -81,6 +81,38 @@ def check_while_body_mega() -> Dict[str, int]:
     }
 
 
+def check_chunk_adaptive() -> Dict[str, int]:
+    """Leaf-size-adaptive chunk-policy budget (ops/chunkpolicy.py).
+
+    The adaptive body must dispatch its per-leaf band variants via
+    zero-trip loops, never conditionals: the hist-state copies stay at
+    the fixed body's exact count and the total-copy delta versus an
+    explicitly fixed lowering stays pinned (lax.switch plumbing would
+    add one copy PER ROW BUFFER per split — the round-1 conditional
+    pathology, measured again while building this policy).  The traced
+    variant registry additionally pins the compiled-variant count per
+    pass to the static menu — the training-side analog of the serving
+    engine's per-(kind, bucket) compile keys."""
+    from ..ops import chunkpolicy
+    from .hlo import report
+    chunkpolicy.reset_variant_log()
+    ra = report({"tpu_chunk_policy": "adaptive"})
+    per_pass: Dict[str, set] = {}
+    for (pass_name, width) in chunkpolicy.variant_log():
+        per_pass.setdefault(pass_name, set()).add(width)
+    menu_max = 4
+    over = sum(1 for ws in per_pass.values() if len(ws) > menu_max)
+    rf = report({"tpu_chunk_policy": "fixed"})
+    return {
+        "hist_state_copies": ra["hist_state_copies"],
+        "hist_state_copies_delta": abs(ra["hist_state_copies"]
+                                       - rf["hist_state_copies"]),
+        "copies_delta_vs_fixed": max(ra["copies"] - rf["copies"], 0),
+        "passes_over_menu": over,
+        "variants_missing": 0 if per_pass else 1,
+    }
+
+
 _FRONTIER_K = 4
 
 
@@ -495,6 +527,7 @@ CHECKS = {
     "while_body.default": check_while_body_default,
     "while_body.mega": check_while_body_mega,
     "frontier.body": check_while_body_frontier,
+    "chunk.adaptive": check_chunk_adaptive,
     "serving.compiles": check_serving_compiles,
     "serving.transfers": check_serving_transfers,
     "predict.layered": check_predict_layered,

@@ -428,8 +428,20 @@ _PARAMS: List[_Param] = [
     _p("tpu_kernel_interpret", False, bool),
     # rows per partition/histogram chunk; 4096 measured best end-to-end
     # on v5e (round 3: fixed cost 15.9 -> 12.1 ms/iter vs 8192 at equal
-    # slope — smaller per-split padding waste)
-    _p("tpu_row_chunk", 4096, int, (), ">0"),
+    # slope — smaller per-split padding waste).  "auto" consults the
+    # BENCH_history.jsonl trajectory for a same-fingerprint chunk-sweep
+    # winner before falling back to 4096 (ops/chunkpolicy.py); also the
+    # SEED of the leaf-size-adaptive menu below
+    _p("tpu_row_chunk", "4096", str),
+    # leaf-size-adaptive chunk policy (ops/chunkpolicy.py): per-leaf
+    # histogram/partition passes pick their chunk width from a bounded
+    # static menu seeded by tpu_row_chunk, so small leaves stop paying
+    # the worst-case padded chunk (68% of the CPU iteration, PERF.md
+    # round 12) while trees stay BIT-identical to the fixed grid.
+    # "auto" = adaptive in the small-leaf regime (or per a measured
+    # same-fingerprint chunk-sweep verdict) on the plain XLA serial
+    # path; "fixed" = the base grid everywhere; "adaptive" = force on
+    _p("tpu_chunk_policy", "auto", str),
     # ride the rowid row inside the spare packed-bin bytes when G <= G32-4
     # (one fewer payload sublane through the partition roll networks)
     _p("tpu_pack_rowid", False, bool),
@@ -601,6 +613,18 @@ class Config:
 
     # -- derived state (reference: Config::Set, src/io/config.cpp) --
     def _post_process(self) -> None:
+        # str-typed numeric-or-auto knobs keep config-time validation
+        # (a typo must fail HERE with a clear message, not surface as a
+        # swallowed exception in dataset/learner construction)
+        from .ops.chunkpolicy import parse_row_chunk
+        try:
+            parse_row_chunk(self.tpu_row_chunk)
+        except ValueError as exc:
+            log.fatal("%s", exc)
+        if str(self.tpu_chunk_policy).strip().lower() not in (
+                "auto", "fixed", "adaptive", ""):
+            log.warning("unknown tpu_chunk_policy=%r; treating as auto",
+                        self.tpu_chunk_policy)
         self.objective = _OBJECTIVE_ALIASES.get(
             str(self.objective).lower(), str(self.objective).lower())
         # boosting aliases; "goss" boosting folds into gbdt + goss strategy

@@ -451,9 +451,11 @@ class BinnedDataset:
         if not self._ingest_ok:
             return None
         try:
+            from .ops.chunkpolicy import resolve_base
             from .ops.construct import DeviceIngest
             return DeviceIngest(len(self.groups), self.num_data, dtype,
-                                int(self.config.tpu_row_chunk))
+                                resolve_base(self.config, self.num_data,
+                                             self.num_total_features))
         except Exception as exc:
             log.warning("device ingest unavailable (%s); keeping the "
                         "host binned matrix", str(exc).split("\n")[0][:120])

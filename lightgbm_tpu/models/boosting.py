@@ -1275,6 +1275,7 @@ class GBDT:
             else:
                 tree.leaf_value = np.asarray([self.init_scores[k_cls]])
         self._health_record_tree(host_record, num_nodes)
+        self._telemetry_chunk_waste(host_record, num_nodes)
         self.models.append(tree)
         self.device_trees.append({
             "nodes": nodes, "leaf_value": delta_leaf,
@@ -1327,6 +1328,28 @@ class GBDT:
         self.flight.record_tree(idx // K, idx % K, host_record,
                                 num_nodes,
                                 effective_rows=self._health_effective_rows())
+
+    # -- chunk-policy padding-waste gauges (obs/telemetry.py) -----------
+    def _telemetry_chunk_waste(self, host_record, num_nodes: int) -> None:
+        """Per-band live-row occupancy + padding-waste gauges of the
+        just-materialized tree under the active chunk policy
+        (``train.chunk.*``, surfaced in ``Booster.telemetry_report()``).
+        Host arithmetic on leaf counts the trainer already transferred
+        — zero device ops, zero syncs, no-op with telemetry off."""
+        sess = obs.get()
+        if sess.mode == "off" or "leaf_cnt" not in host_record:
+            return
+        policy = getattr(self.learner, "_chunk_policy", None)
+        if policy is None:
+            return
+        from ..ops.chunkpolicy import waste_stats
+        counts = np.asarray(host_record["leaf_cnt"])[:num_nodes + 1]
+        stats = waste_stats(counts, policy)
+        sess.gauge("train.chunk.waste", stats["waste"])
+        sess.gauge("train.chunk.fixed_waste", stats["fixed_waste"])
+        for k, v in stats.items():
+            if k.startswith("band_"):
+                sess.gauge(f"train.chunk.{k}", v)
 
     # ------------------------------------------------------------------
     def continue_from(self, trees, train_pred: np.ndarray) -> None:
@@ -1838,6 +1861,7 @@ class GBDT:
                     if tree.is_linear:
                         tree.leaf_const = np.asarray([self.init_scores[k]])
             self._health_record_tree(host_record, num_nodes)
+            self._telemetry_chunk_waste(host_record, num_nodes)
             self.models.append(tree)
             self.device_trees.append({
                 "nodes": nodes, "leaf_value": delta_leaf,

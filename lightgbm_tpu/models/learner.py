@@ -372,8 +372,11 @@ class SerialTreeLearner:
             else (self._ingest.dtype if self._ingest is not None
                   else np.uint8))
         self._host_bin_dtype = host_bin_dtype
-        self.row_chunk = min(int(config.tpu_row_chunk),
-                             max(_pow2ceil(self.N), 256))
+        from ..ops import chunkpolicy
+        self.row_chunk = min(
+            chunkpolicy.resolve_base(config, self.N,
+                                     dataset.num_total_features),
+            max(_pow2ceil(self.N), 256))
         if self.row_chunk & (self.row_chunk - 1):
             self.row_chunk = _pow2ceil(self.row_chunk)
         # the partition packs (dest << bits) | src into one uint32 sort key
@@ -700,6 +703,31 @@ class SerialTreeLearner:
                             str(exc).split("\n")[0][:120])
                 self._use_flat_hist = False
 
+        # ---- leaf-size-adaptive chunk policy (ops/chunkpolicy.py) ----
+        # Per-leaf hist/partition passes pick their chunk width from a
+        # bounded static menu so small leaves stop paying the worst-case
+        # padded chunk (68% of the CPU iteration, PERF.md round 12).
+        # Band dispatch is zero-trip fori_loops — never lax.switch/cond,
+        # whose branch plumbing copies the multi-MB row buffers per
+        # split.  Plain XLA serial paths only: the Pallas kernels keep
+        # their proven base grid until the on-TPU round (ROADMAP 4b),
+        # and the in-context doubling probe must measure the fixed
+        # formulation it was calibrated on.  Trees stay BIT-identical
+        # to tpu_chunk_policy=fixed (see chunkpolicy module docstring;
+        # pinned by tests/test_chunkpolicy.py and ab_bench --chunk).
+        chunk_eligible = (parallel_mode == "serial"
+                          and axis_name is None
+                          and not self._use_pallas
+                          and not self._use_pallas_part
+                          and self._use_mega != "pallas"
+                          and not self._ab_double
+                          and self._hist_dtype is jnp.float32
+                          and self.F > 0)
+        _, self._chunk_policy = chunkpolicy.resolve(
+            config, self.N, self.L, chunk_eligible,
+            base=self.row_chunk,
+            features=dataset.num_total_features)
+
         axes = (0, 0, 0, 0, 0, 0, 0, 0, 0, 0, None)
         if self.cegb_lazy is not None:
             axes = axes + (0,)
@@ -799,6 +827,17 @@ class SerialTreeLearner:
                                     start, cnt, num_bins=self.B,
                                     row_chunk=self.row_chunk,
                                     num_groups=self.G)
+        if self._chunk_policy.adaptive:
+            # leaf-size-adaptive bands (eligibility guarantees the
+            # plain-XLA path with no in-context doubling); quantized
+            # integer carriers are exact at any width by construction
+            from ..ops.histogram import leaf_hist_banded
+            return leaf_hist_banded(
+                part_bins, part_ghi, start, cnt, num_bins=self.B,
+                policy=self._chunk_policy,
+                dtype=(jnp.bfloat16 if scale is not None
+                       else self._hist_dtype),
+                vary=self._pvary, num_groups=self.G)
         # quantized training rides INTEGER gradient carriers: the one-hot
         # matmuls run in bfloat16 (exact for the small int grid, double
         # MXU rate — the int16-histogram analog).  The histogram stays
@@ -912,8 +951,18 @@ class SerialTreeLearner:
         if self._use_pallas_part:
             return self._partition_leaf_pallas(st, start, cnt, col,
                                                decision_scalars)
+        pol = self._chunk_policy
         C = self.row_chunk
         G = self.G
+        from ..ops.chunkpolicy import note_variant
+        note_variant("partition", C)
+        # leaf-size-adaptive banding: the base chunk loops run ZERO
+        # trips when a smaller menu width covers the leaf, and each
+        # smaller width appends a zero-or-one-trip single-window pass
+        # below (bit-identical row moves at any width — see
+        # ops/partition.py window_order)
+        base_cover = (pol.base_cover(cnt, pol.sizes) if pol.adaptive
+                      else None)
         part_bins = st["part_bins"]
         # grad/hess/rowid (+ score/objective payload rows in the fused
         # physical mode) live PERMANENTLY as one (R, N_pad) f32 matrix
@@ -922,7 +971,8 @@ class SerialTreeLearner:
         # per-split pack/unpack of the full row payload is materialized.
         part_ghi = st["part_ghi"]
         R = part_ghi.shape[0]
-        n_chunks = (cnt + C - 1) // C
+        n_chunks = ((cnt + C - 1) // C if base_cover is None
+                    else base_cover)
 
         def blend(dst, val, off, mask):
             # (rows-on-lanes window write at column offset ``off``)
@@ -1021,7 +1071,69 @@ class SerialTreeLearner:
         if self.aux_rows:
             moved["part_aux"] = part_aux
             moved["sc_aux"] = sa
+        if pol.adaptive:
+            # exactly one band executes per split; the others cost a
+            # zero-trip loop header.  The window pass skips the scratch
+            # + copyback entirely (single window: no cross-chunk
+            # hazards), writing byte-identical buffers.
+            for w, trip in zip(pol.sizes[1:],
+                               pol.small_trips(cnt, pol.sizes)):
+                moved, nl_w = self._partition_leaf_window(
+                    moved, start, cnt, col, decision_scalars, w, trip)
+                nl = nl + nl_w
         return moved, nl
+
+    def _partition_leaf_window(self, bufs, start, cnt, col,
+                               decision_scalars, width: int, trip):
+        """Single-window leaf partition at a smaller menu width: one
+        (G+R, W) read, one packed-key sort, one gather, masked window
+        writes — wrapped in a ``trip``-gated fori_loop so a non-selected
+        band skips at runtime without a conditional (lax.cond/switch
+        would copy the multi-MB row buffers every split)."""
+        from ..ops.chunkpolicy import note_variant
+        from ..ops.partition import window_order
+        note_variant("partition", width)
+        G = self.G
+        W = width
+        aw = self.aux_rows
+        col_onehot = (jax.lax.iota(jnp.int32, G) == col)[:, None]
+
+        def body(_, carry):
+            pb, pg, pa, nl = carry
+            PBR = pb.shape[0]
+            R = pg.shape[0]
+            bch = jax.lax.dynamic_slice(pb, (0, start), (PBR, W))
+            gch = jax.lax.dynamic_slice(pg, (0, start), (R, W))
+            colv = jnp.sum(bch[:G].astype(jnp.int32) * col_onehot, axis=0)
+            valid = jax.lax.iota(jnp.int32, W) < cnt
+            gl = self._goes_left(colv, decision_scalars)
+            order, nlc = window_order(gl, valid, W)
+            both32 = jnp.concatenate(
+                [bch.astype(jnp.int32),
+                 jax.lax.bitcast_convert_type(gch, jnp.int32)], axis=0)
+            perm = jnp.take(both32, order, axis=1)
+            vm = valid[None, :]
+            pb = jax.lax.dynamic_update_slice(
+                pb, jnp.where(vm, perm[:PBR].astype(pb.dtype), bch),
+                (0, start))
+            pg = jax.lax.dynamic_update_slice(
+                pg, jnp.where(vm, jax.lax.bitcast_convert_type(
+                    perm[PBR:], jnp.float32), gch), (0, start))
+            if aw:
+                ach = jax.lax.dynamic_slice(pa, (0, start), (aw, W))
+                pa = jax.lax.dynamic_update_slice(
+                    pa, jnp.where(vm, jnp.take(ach, order, axis=1), ach),
+                    (0, start))
+            return pb, pg, pa, nl + nlc
+
+        pa0 = bufs["part_aux"] if aw else jnp.zeros((), jnp.int32)
+        carry0 = self._pvary((bufs["part_bins"], bufs["part_ghi"], pa0,
+                              jnp.int32(0)))
+        pb, pg, pa, nl = jax.lax.fori_loop(0, trip, body, carry0)
+        out = {**bufs, "part_bins": pb, "part_ghi": pg}
+        if aw:
+            out["part_aux"] = pa
+        return out, nl
 
     def _partition_leaf_pallas(self, st, start, cnt, col, decision_scalars):
         """Pallas-kernel leaf partition (see ops/partition_pallas.py):
@@ -1068,11 +1180,20 @@ class SerialTreeLearner:
         else:
             # oracle mode: the SAME chunk grid and accumulation math as
             # the kernel, as plain XLA ops, over the pre-partition rows
-            acc = both_children_hist_xla(
-                st["part_bins"], st["part_ghi"], start, cnt, col,
-                (bstart, isb, nb, dbin, mtype, thr, dl),
-                row_chunk=self.row_chunk, num_bins=self.B,
-                num_groups=self.G, vary=self._pvary)
+            if self._chunk_policy.adaptive:
+                from ..ops.split_megakernel_pallas import (
+                    both_children_hist_banded)
+                acc = both_children_hist_banded(
+                    st["part_bins"], st["part_ghi"], start, cnt, col,
+                    (bstart, isb, nb, dbin, mtype, thr, dl),
+                    policy=self._chunk_policy, num_bins=self.B,
+                    num_groups=self.G, vary=self._pvary)
+            else:
+                acc = both_children_hist_xla(
+                    st["part_bins"], st["part_ghi"], start, cnt, col,
+                    (bstart, isb, nb, dbin, mtype, thr, dl),
+                    row_chunk=self.row_chunk, num_bins=self.B,
+                    num_groups=self.G, vary=self._pvary)
             moved, left_cnt = self._partition_leaf(st, start, cnt, col,
                                                    decision_scalars)
         hl_g, hl_h, hr_g, hr_h = unpack_hist4(acc, self.B)
@@ -3089,13 +3210,15 @@ class SerialTreeLearner:
         nodes = self.max_splits
         lm = st["leafmat"][:, :L]         # drop the trash slots
         nm = st["nodemat"][:, :nodes]
+        # the histogram state is while-loop carry only: nothing
+        # downstream consumes it, and exporting it materialized an
+        # (L+1, G, B, 2) buffer per tree on the eager path (the PR-10
+        # frontier path already dropped it — now both paths agree)
         rec = {k: v for k, v in st.items()
-               if k not in ("leafmat", "nodemat")}
+               if k not in ("leafmat", "nodemat", "hist")}
         if "best_cat_set" in st:
             rec["best_cat_set"] = st["best_cat_set"][:L]
             rec["node_cat_set"] = st["node_cat_set"][:nodes]
-        if "hist" in st:   # absent on the mega path (no histogram state)
-            rec["hist"] = st["hist"][:L]
         rec["indices"] = _f2i(st["part_ghi"][2])
         rec["part_grad"] = st["part_ghi"][0]
         rec["part_hess"] = st["part_ghi"][1]

@@ -72,9 +72,10 @@ _MAD_TO_SIGMA = 1.4826
 # split trajectories; anything else (seeds, verbosity, paths) must NOT
 # fork the series
 _FINGERPRINT_KNOBS = (
-    "tpu_row_chunk", "tpu_frontier_k", "tpu_megakernel",
-    "tpu_compact_radix", "tpu_kernel_interpret", "construct_device",
-    "tree_learner", "num_leaves", "max_bin", "telemetry", "health",
+    "tpu_row_chunk", "tpu_chunk_policy", "tpu_frontier_k",
+    "tpu_megakernel", "tpu_compact_radix", "tpu_kernel_interpret",
+    "construct_device", "tree_learner", "num_leaves", "max_bin",
+    "telemetry", "health",
 )
 # producer-config spellings of the same knobs (bench.py/ab_bench.py
 # record "leaves"): without the alias, leaf-count changes would not

@@ -44,11 +44,15 @@ import numpy as np
 def leaf_hist_slice(part_bins, part_ghi, start, cnt, *,
                     num_bins: int, row_chunk: int,
                     gblock: int = 0, dtype=jnp.float32, vary=lambda x: x,
-                    num_groups: int = 0, flat_geom=None):
+                    num_groups: int = 0, flat_geom=None, cover=None):
     """(G, B, 2) histogram of the contiguous partitioned rows
     [start, start+cnt) of the (G, N_pad) binned matrix with matching
     (>=2, N_pad) packed (grad, hess, ...) rows; rows beyond ``cnt``
     inside the last chunk are masked via zeroed grad/hess.
+
+    ``cover`` overrides the chunk trip count (the leaf-size-adaptive
+    policy passes the cover length — 0 skips the pass outright, which
+    is how a zero-trip band variant costs nothing at runtime).
 
     Digit-decomposed one-hot accumulation: onehot_B(x) factors as
     onehot_hi(x >> 4) (x) onehot_16(x & 15), so the per-chunk histogram is a
@@ -74,7 +78,7 @@ def leaf_hist_slice(part_bins, part_ghi, start, cnt, *,
         gblock = max(1, (4 * 1024 * 1024) // (C * (16 + 2 * BH) * 4))
     nblk = (G + gblock - 1) // gblock
     Gp = nblk * gblock
-    n_chunks = (cnt + C - 1) // C
+    n_chunks = (cnt + C - 1) // C if cover is None else cover
     iota_hi = jax.lax.broadcasted_iota(jnp.int32, (1, 1, BH), 2)
     iota_lo = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 16), 2)
 
@@ -125,6 +129,41 @@ def leaf_hist_slice(part_bins, part_ghi, start, cnt, *,
         jg = jnp.pad(jg, ((0, 0), (0, Gf - G), (0, Bf - Bp)))
         return jg.reshape(8, WL)
     return jnp.moveaxis(per[:, :, :B], 1, 2)            # (G, B, 2)
+
+
+def leaf_hist_banded(part_bins, part_ghi, start, cnt, *, num_bins: int,
+                     policy, dtype=jnp.float32, vary=lambda x: x,
+                     num_groups: int = 0):
+    """Leaf-size-adaptive histogram (ops/chunkpolicy.py): the base-grid
+    pass runs with a cover of 0 when a smaller band covers the leaf,
+    and each smaller menu width runs a zero-or-one-trip single-chunk
+    variant.  Exactly one variant executes per call; the others skip at
+    runtime (dynamic trip counts — no ``lax.switch``, whose branch
+    plumbing copies the multi-MB row buffers).
+
+    Bit-identity: the selected small chunk accumulates the same live
+    rows plus exactly-zero masked padding, and the band widths are
+    capped at ``HIST_EXACT_MAX`` where the dot reduction provably
+    groups the live prefix like the base width does (module docstring
+    of chunkpolicy).  Summing the per-variant outputs (all-zero except
+    the selected one) reproduces the base path's trailing zero-padding
+    adds, so even signed-zero bins match.
+    """
+    from .chunkpolicy import note_variant
+    sizes = policy.hist_sizes
+    trips = policy.small_trips(cnt, sizes)
+    note_variant("hist", sizes[0])
+    out = leaf_hist_slice(part_bins, part_ghi, start, cnt,
+                          num_bins=num_bins, row_chunk=sizes[0],
+                          dtype=dtype, vary=vary, num_groups=num_groups,
+                          cover=policy.base_cover(cnt, sizes))
+    for w, trip in zip(sizes[1:], trips):
+        note_variant("hist", w)
+        out = out + leaf_hist_slice(
+            part_bins, part_ghi, start, cnt, num_bins=num_bins,
+            row_chunk=w, dtype=dtype, vary=vary, num_groups=num_groups,
+            cover=trip)
+    return out
 
 
 # ----------------------------------------------------------------------

@@ -37,6 +37,42 @@ def split_decision(bin_values: jnp.ndarray, threshold, default_left,
     return jnp.where(is_missing, default_left, natural)
 
 
+def window_order(goes_left: jnp.ndarray, valid: jnp.ndarray, width: int):
+    """Compaction permutation of one ``width``-row window: lefts pack
+    forward in encounter order, rights follow at ``[nl, nl+nr)`` in
+    encounter order, invalid (other-leaf / padding) rows park past the
+    live region.  Returns (order, left_count).
+
+    Byte-compatible with the chunked scatter+copyback path's SINGLE-
+    chunk case at any width — the leaf-size-adaptive policy's exactness
+    contract (ops/chunkpolicy.py): the move is an integer packed-key
+    sort + gather, so a leaf that fits one window produces the same
+    final row order whether that window is the base chunk or a smaller
+    menu width.
+    """
+    chunk_bits = width.bit_length() - 1
+    if width & (width - 1):
+        raise ValueError(f"window width {width} must be a power of two")
+    gl = goes_left & valid
+    gr = valid & ~gl
+    gli = gl.astype(jnp.int32)
+    gri = gr.astype(jnp.int32)
+    inv = (~valid).astype(jnp.int32)
+    nlc = jnp.sum(gli)
+    nrc = jnp.sum(gri)
+    lrank = jnp.cumsum(gli) - gli
+    rrank = jnp.cumsum(gri) - gri
+    irank = jnp.cumsum(inv) - inv
+    dloc = jnp.where(gl, lrank,
+                     jnp.where(gr, nlc + rrank, nlc + nrc + irank))
+    iot = jax.lax.iota(jnp.int32, width)
+    # single-operand sort of packed (dest << log2W) | src keys — the
+    # multi-operand sort jnp.argsort lowers to is the slow path
+    packed = ((dloc << chunk_bits) | iot).astype(jnp.uint32)
+    order = (jax.lax.sort(packed) & jnp.uint32(width - 1)).astype(jnp.int32)
+    return order, nlc
+
+
 def partition_leaf(indices: jnp.ndarray, binned_col_getter, start, count,
                    size: int, goes_left_of_rows):
     """Stably partition one leaf's index range in place.

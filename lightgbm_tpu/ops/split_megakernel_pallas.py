@@ -107,7 +107,7 @@ def unpack_hist4(acc, num_bins: int):
 
 def both_children_hist_xla(part_bins, part_ghi, start, cnt, col,
                            dec_scalars, *, row_chunk: int, num_bins: int,
-                           num_groups: int, vary=lambda x: x):
+                           num_groups: int, vary=lambda x: x, cover=None):
     """XLA oracle for the mega-kernel's histogram half: BOTH children's
     histograms of the leaf range [start, start+cnt) accumulated over the
     PARENT cover's chunk grid from the PRE-partition rows.
@@ -115,6 +115,9 @@ def both_children_hist_xla(part_bins, part_ghi, start, cnt, col,
     Must be called before the partition moves the rows.  Returns the
     (G, 4*BH, 16) accumulator (see ``unpack_hist4``); bit-identical to
     the Pallas kernel's histogram output by construction.
+
+    ``cover`` overrides the chunk trip count (the leaf-size-adaptive
+    policy passes the cover length; 0 skips the pass at runtime).
     """
     bstart, isb, nb, dbin, mtype, thr, dl = dec_scalars
     G = num_groups
@@ -124,7 +127,8 @@ def both_children_hist_xla(part_bins, part_ghi, start, cnt, col,
     a0b = jax.lax.shift_right_logical(start, 7)
     rem = start - a0b * 128
     total = rem + cnt
-    n_chunks = jnp.where(cnt > 0, _cdiv(total, C), 0)
+    n_chunks = (jnp.where(cnt > 0, _cdiv(total, C), 0) if cover is None
+                else cover)
     lane = jax.lax.broadcasted_iota(jnp.int32, (1, C), 1)
     iota_hi = jax.lax.broadcasted_iota(jnp.int32, (BH, C), 0)
     iota_lo = jax.lax.broadcasted_iota(jnp.int32, (16, C), 0)
@@ -159,6 +163,40 @@ def both_children_hist_xla(part_bins, part_ghi, start, cnt, col,
 
     acc0 = vary(jnp.zeros((G, 4 * BH, 16), jnp.float32))
     return jax.lax.fori_loop(0, n_chunks, body, acc0)
+
+
+def both_children_hist_banded(part_bins, part_ghi, start, cnt, col,
+                              dec_scalars, *, policy, num_bins: int,
+                              num_groups: int, vary=lambda x: x):
+    """Leaf-size-adaptive mega-oracle histogram (ops/chunkpolicy.py).
+
+    The mega grid is 128-ALIGNED (chunks start at the aligned floor of
+    the leaf offset), so a band applies when the leaf's ALIGNED cover
+    ``(start & 127) + cnt`` fits one chunk of that width; band widths
+    share the histogram menu's exactness cap.  Dispatch is zero-trip
+    fori_loops, same as the plain-path bands — exactly one variant
+    executes per split."""
+    from .chunkpolicy import note_variant
+    sizes = policy.hist_sizes
+    start_i = jnp.asarray(start, jnp.int32)
+    eff = (start_i & 127) + cnt
+    band = policy.band(eff, sizes)
+    live = cnt > 0
+    base_cover = jnp.where(
+        live & (band == 0), _cdiv(eff, sizes[0]), 0)
+    note_variant("mega_hist", sizes[0])
+    acc = both_children_hist_xla(
+        part_bins, part_ghi, start, cnt, col, dec_scalars,
+        row_chunk=sizes[0], num_bins=num_bins, num_groups=num_groups,
+        vary=vary, cover=base_cover)
+    for i, w in enumerate(sizes[1:], 1):
+        note_variant("mega_hist", w)
+        trip = ((band == i) & live).astype(jnp.int32)
+        acc = acc + both_children_hist_xla(
+            part_bins, part_ghi, start, cnt, col, dec_scalars,
+            row_chunk=w, num_bins=num_bins, num_groups=num_groups,
+            vary=vary, cover=trip)
+    return acc
 
 
 def split_megakernel_pallas(part_bins, part_ghi, sc_packed, scalars, *,

@@ -69,6 +69,10 @@ def test_forced_splits_x_monotone(rng, tmp_path):
     assert np.all(np.diff(p) >= -1e-6)
 
 
+@pytest.mark.slow  # 9.5 s: tier-1 window trim (PR 14, per
+# test_durations) — continuation-x-valid keeps its fast in-window
+# representative in test_continuation_x_dart_x_valid; multiclass
+# training rides test_fused_multiclass.py
 def test_continuation_x_multiclass_x_valid(rng):
     n = 3000
     X = rng.normal(size=(n, 6))
@@ -125,6 +129,9 @@ def test_efb_x_distributed(rng):
                                dist.predict(X[:500]), rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow  # 9.1 s: tier-1 window trim (PR 14) — voting
+# keeps fast in-window lanes in test_parallel.py, quantized in
+# test_quantized.py; the cross combination stays covered here slow
 def test_voting_x_quantized(rng):
     n = 4000
     X = rng.normal(size=(n, 8))

@@ -100,6 +100,9 @@ def test_cv_ranking_group_aware(rng):
     assert res["valid ndcg@3-mean"][-1] >= res["valid ndcg@3-mean"][0] - 1e-9
 
 
+@pytest.mark.slow  # 12.3 s: tier-1 window trim (PR 14, per
+# test_durations.json) — group-aware ranking CV keeps its fast
+# in-window representative in test_cv_ranking_group_aware
 def test_cv_sklearn_groupkfold_ranking(rng):
     """GroupKFold passed explicitly receives the flattened query ids as
     groups (reference: engine.py:509-516)."""
@@ -148,6 +151,9 @@ def test_cv_early_stopping_and_callbacks(rng):
                    for b in cvb.boosters)
 
 
+@pytest.mark.slow  # 17.3 s: tier-1 window trim (PR 14) — init_model
+# continuation keeps fast in-window representatives in
+# test_continue.py; the cv()-level plumbing stays covered here slow
 def test_cv_init_model_continues(rng, tmp_path):
     """cv(init_model=...) seeds every fold (and its valid scores) from
     the model, like train(); starting from a trained model must not be

@@ -86,6 +86,10 @@ def test_frontier_budget_boundary_partial_steps():
         assert _trees(b1) == _trees(bk), (L, K)
 
 
+@pytest.mark.slow  # 6.7 s: tier-1 window trim (PR 14) — frontier
+# bit-identity keeps its fast in-window representatives in
+# test_frontier_bitidentity (the multiclass lane also rides
+# test_chunkpolicy.py::test_chunk_bitidentity)
 def test_frontier_multiclass_and_regression():
     X, y = _data(seed=11)
     ym = (np.abs(X[:, 0]) + X[:, 1] > 1).astype(float) + (X[:, 2] > 0)

@@ -137,6 +137,9 @@ def test_advanced_mode_enforces(rng):
     assert mse_a <= mse_i * 1.05
 
 
+@pytest.mark.slow  # 11.8 s: tier-1 window trim (PR 14) — advanced
+# monotone mode keeps its fast in-window representative in
+# test_advanced_mode_enforces
 def test_advanced_finds_split_intermediate_clamps(tmp_path):
     """The reference's motivating case for advanced mode
     (monotone_constraints.hpp:858 AdvancedLeafConstraints): two upper

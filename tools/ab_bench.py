@@ -368,6 +368,168 @@ def _frontier_smoke(args, guard):
         raise SystemExit("--frontier: " + "; ".join(problems))
 
 
+def _chunk_smoke(args, guard):
+    """Chunk-policy A/B (`--chunk`): tpu_chunk_policy=fixed vs adaptive
+    at several (rows, num_leaves) regimes, asserting TREE BIT-IDENTITY
+    between the arms after every timed block.  Reports per-regime
+    speedups plus per-arm affine fits t(rows) = fixed + slope*rows over
+    the small-leaf-heavy row counts (`--chunk-rows` at
+    `--chunk-leaves`), and a separate large-uniform-leaf regime
+    (`--chunk-uniform`) that must stay inside the perfwatch noise floor
+    (adaptive bands are a no-op there — every leaf covers base chunks).
+    Each regime also appends a `chunk_sweep` trajectory entry (winning
+    base width + measured adaptive speedup under the knob-free
+    host/shape fingerprint) that `tpu_row_chunk=auto` /
+    `tpu_chunk_policy=auto` consult (ops/chunkpolicy.py).  Exits
+    non-zero on any tree mismatch, when the small-leaf speedup
+    undercuts `--chunk-min-x`, or when the uniform regime regresses
+    past the noise floor."""
+    import jax.numpy as jnp
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.obs import regress
+    from lightgbm_tpu.ops import chunkpolicy
+
+    rows_list = [int(r) for r in args.chunk_rows.split(",") if r]
+    if len(rows_list) < 2:
+        raise SystemExit("--chunk needs >= 2 row counts for the affine "
+                         "fit (--chunk-rows r1,r2[,...])")
+    u_rows, u_leaves = (int(v) for v in args.chunk_uniform.split(":"))
+    regimes = ([(r, args.chunk_leaves) for r in rows_list]
+               + [(u_rows, u_leaves)])
+    base = {"objective": "binary", "learning_rate": 0.1, "max_bin": 255,
+            "verbosity": -1, "metric": ""}
+
+    def trees(bst):
+        return [ln for ln in bst.model_to_string().splitlines()
+                if not ln.startswith("[")]
+
+    def sync(bst):
+        return float(jnp.sum(bst._gbdt.scores))
+
+    per = {}
+    mismatch = []
+    rng = np.random.RandomState(7)
+    for rows, leaves in regimes:
+        X = rng.normal(size=(rows, args.features)).astype(np.float32)
+        w = rng.normal(size=args.features)
+        y = ((X.dot(w) * 0.5 + rng.normal(size=rows)) > 0
+             ).astype(np.float32)
+        p = {**base, "num_leaves": leaves}
+        ds = lgb.Dataset(X, label=y)
+        ds.construct(p)
+        boosters = {n: lgb.Booster(params={**p, "tpu_chunk_policy": n},
+                                   train_set=ds)
+                    for n in ("fixed", "adaptive")}
+        for n in boosters:          # compile warmup
+            boosters[n].update()
+            sync(boosters[n])
+        for _ in range(2):          # settle (the _ab_body discipline)
+            for n in boosters:
+                boosters[n].update()
+        for n in boosters:
+            sync(boosters[n])
+        times = {"fixed": [], "adaptive": []}
+        for _ in range(args.chunk_blocks):
+            for n in ("fixed", "adaptive"):
+                bst = boosters[n]
+                t0 = time.time()
+                for _ in range(args.chunk_iters):
+                    bst.update()
+                sync(bst)
+                times[n].append((time.time() - t0) / args.chunk_iters)
+        key = f"{rows}x{leaves}"
+        if trees(boosters["fixed"]) != trees(boosters["adaptive"]):
+            mismatch.append(key)
+        tf = float(np.median(times["fixed"]))
+        ta = float(np.median(times["adaptive"]))
+        pol = boosters["adaptive"]._gbdt.learner._chunk_policy
+        per[key] = {
+            "rows": rows, "leaves": leaves,
+            "fixed_s_per_iter": round(tf, 5),
+            "adaptive_s_per_iter": round(ta, 5),
+            "speedup": round(tf / ta, 3) if ta > 0 else None,
+            "menu": list(pol.sizes), "hist_menu": list(pol.hist_sizes),
+            "adaptive_engaged": bool(pol.adaptive),
+            "trees_identical": key not in mismatch,
+        }
+        # the measured verdict tpu_row_chunk=auto / tpu_chunk_policy=
+        # auto consult: keyed by the knob-free host/shape fingerprint.
+        # A regime that failed bit-identity must NOT feed the auto
+        # modes a speedup verdict for a broken path — its entry is
+        # recorded aborted (evidence kept, detector and consult skip).
+        regress.append_entry(
+            chunkpolicy.SWEEP_TOOL,
+            {"best_row_chunk": int(pol.base),
+             "adaptive_speedup": tf / ta if ta > 0 else 0.0},
+            config={"rows": rows, "features": args.features,
+                    "leaves": leaves},
+            fingerprint_doc=chunkpolicy.sweep_fingerprint(
+                rows, args.features),
+            aborted=key in mismatch)
+
+    rr = np.asarray(rows_list, np.float64)
+    tf = np.asarray([per[f"{r}x{args.chunk_leaves}"]["fixed_s_per_iter"]
+                     for r in rows_list])
+    ta = np.asarray([per[f"{r}x{args.chunk_leaves}"]["adaptive_s_per_iter"]
+                     for r in rows_list])
+    slope_f, fixed_f = np.polyfit(rr, tf, 1)
+    slope_a, fixed_a = np.polyfit(rr, ta, 1)
+    small_speedups = [per[f"{r}x{args.chunk_leaves}"]["speedup"]
+                      for r in rows_list]
+    best_speedup = float(max(small_speedups))
+    ukey = f"{u_rows}x{u_leaves}"
+    u_ratio = (per[ukey]["adaptive_s_per_iter"]
+               / per[ukey]["fixed_s_per_iter"])
+    noise_floor = 1.0 + regress.FLOOR_PCT / 100.0
+    report = {
+        "chunk_mode": True, "features": args.features,
+        "iters": args.chunk_iters, "blocks": args.chunk_blocks,
+        "per_regime": per,
+        "fit_fixed": {"fixed_s_per_iter": round(float(fixed_f), 5),
+                      "slope_s_per_mrow": round(float(slope_f * 1e6), 4)},
+        "fit_adaptive": {"fixed_s_per_iter": round(float(fixed_a), 5),
+                         "slope_s_per_mrow": round(float(slope_a * 1e6),
+                                                   4)},
+        "small_leaf_speedups": small_speedups,
+        "small_leaf_speedup_best": round(best_speedup, 3),
+        "chunk_min_x": args.chunk_min_x,
+        "uniform_ratio": round(float(u_ratio), 4),
+        "uniform_noise_floor": round(noise_floor, 4),
+        "trees_identical": not mismatch,
+    }
+    print(json.dumps(report))
+    _write_obs(guard, args, "ab_bench.chunk",
+               {"rows": rows_list, "leaves": args.chunk_leaves,
+                "uniform": args.chunk_uniform,
+                "iters": args.chunk_iters, "blocks": args.chunk_blocks},
+               report,
+               metrics={"fixed_arm_fixed_s": float(fixed_f),
+                        "adaptive_arm_fixed_s": float(fixed_a),
+                        "fixed_arm_slope_s_per_mrow": float(slope_f * 1e6),
+                        "adaptive_arm_slope_s_per_mrow": float(
+                            slope_a * 1e6),
+                        "small_leaf_speedup": best_speedup,
+                        "uniform_ratio": float(u_ratio)},
+               rows=max(rows_list),
+               fingerprint_extra={"chunk_rows": rows_list,
+                                  "chunk_leaves": args.chunk_leaves,
+                                  "uniform": args.chunk_uniform})
+    problems = []
+    if mismatch:
+        problems.append(f"adaptive trees NOT bit-identical to the fixed "
+                        f"grid at {mismatch}")
+    if args.chunk_min_x is not None and best_speedup < args.chunk_min_x:
+        problems.append(
+            f"best small-leaf speedup {best_speedup:.2f}x undercuts "
+            f"the {args.chunk_min_x}x bar")
+    if u_ratio > noise_floor:
+        problems.append(
+            f"large-uniform-leaf regime regressed {100 * (u_ratio - 1):.1f}%"
+            f" — past the {regress.FLOOR_PCT}% perfwatch noise floor")
+    if problems:
+        raise SystemExit("--chunk: " + "; ".join(problems))
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--rows", type=int, default=1_000_000)
@@ -423,6 +585,31 @@ def main(argv=None):
                     "only — on CPU hosts the fixed cost is padded-chunk "
                     "compute, not the bookkeeping the batching "
                     "amortizes, see PERF.md round 12)")
+    ap.add_argument("--chunk", action="store_true",
+                    help="chunk-policy A/B: tpu_chunk_policy=fixed vs "
+                    "adaptive across --chunk-rows at --chunk-leaves "
+                    "plus the --chunk-uniform regime, asserting tree "
+                    "bit-identity, the speedup bar and the uniform "
+                    "noise gate; appends chunk_sweep trajectory "
+                    "entries the auto modes consult")
+    ap.add_argument("--chunk-rows", default="8192,16384,65536",
+                    metavar="R1,R2[,..]",
+                    help="--chunk: small-leaf-heavy row counts for the "
+                    "affine fit")
+    ap.add_argument("--chunk-leaves", type=int, default=255,
+                    help="--chunk: num_leaves of the small-leaf-heavy "
+                    "regimes")
+    ap.add_argument("--chunk-uniform", default="262144:31",
+                    metavar="ROWS:LEAVES",
+                    help="--chunk: large-uniform-leaf regime that must "
+                    "stay inside the perfwatch noise floor")
+    ap.add_argument("--chunk-iters", type=int, default=4,
+                    help="--chunk: iterations per timed block")
+    ap.add_argument("--chunk-blocks", type=int, default=3,
+                    help="--chunk: timed blocks per arm (interleaved)")
+    ap.add_argument("--chunk-min-x", type=float, default=None,
+                    help="--chunk: minimum small-leaf speedup to assert "
+                    "(exit non-zero below it; default: report only)")
     ap.add_argument("--obs-out", default=None, metavar="PATH",
                     help="BENCH_obs.json artifact path (default: "
                     "$BENCH_OBS_PATH or ./BENCH_obs.json)")
@@ -437,7 +624,8 @@ def main(argv=None):
 
     mode = ("ab_bench.fault" if args.fault else
             "ab_bench.drift" if args.drift else
-            "ab_bench.frontier" if args.frontier else "ab_bench")
+            "ab_bench.frontier" if args.frontier else
+            "ab_bench.chunk" if args.chunk else "ab_bench")
     # export-on-failure: a lane that dies mid-measurement still leaves
     # an aborted BENCH_obs artifact + trajectory entry; lanes that
     # wrote their artifact and THEN failed an assertion keep the real
@@ -455,6 +643,9 @@ def main(argv=None):
             return
         if args.frontier:
             _frontier_smoke(args, guard)
+            return
+        if args.chunk:
+            _chunk_smoke(args, guard)
             return
         _ab_body(args, guard)
 
