@@ -60,7 +60,7 @@ RULES: Dict[str, str] = {
 # the modules that stage device programs; JL005 the collective layer.
 JL001_SCOPE = ("ops/", "models/learner.py", "models/serving.py",
                "models/boosting.py", "models/metric.py", "continual/",
-               "obs/regress.py")
+               "obs/regress.py", "dataset.py")
 JL003_SCOPE = ("ops/", "models/learner.py", "models/serving.py",
                "models/shap.py")
 JL005_SCOPE = ("parallel/",)

@@ -309,6 +309,19 @@ _PARAMS: List[_Param] = [
     # the data — the free_raw_data analog for the packed bin matrix (a
     # raw float copy is only retained under linear_tree, which keeps it)
     _p("free_host_binned", False, bool),
+    # out-of-core bin finding (ops/sketch.py): "exact" = the full
+    # column sort of the row sample (the oracle); "sketch" =
+    # deterministic mergeable per-feature quantile sketches accumulated
+    # chunk by chunk — the dense raw matrix never materializes, and
+    # rank-sharded construction merges fixed-size sketch states instead
+    # of row samples; "auto" = sketch above sketch_row_threshold rows
+    _p("bin_construct_mode", "auto", str),
+    # sketch capacity per feature: below k distinct values the sketch
+    # is exact (mappers bit-identical to the oracle); past it, cells
+    # coarsen in power-of-two steps and the CDF error is bounded by the
+    # heaviest cell (FeatureSketch.rank_error_bound)
+    _p("sketch_k", 8192, int, (), ">=16"),
+    _p("sketch_row_threshold", 1000000, int, (), ">0"),
     _p("precise_float_parser", False, bool),
     _p("parser_config_file", "", str),
     # --- Predict ---

@@ -86,19 +86,19 @@ class Metadata:
             self.positions = None
             self.position_ids = None
             return
-        vals = list(np.asarray(position).reshape(-1))
-        if len(vals) != self.num_data:
+        vals = np.asarray(position).reshape(-1)
+        if vals.shape[0] != self.num_data:
             log.fatal("Length of position (%d) != num_data (%d)",
-                      len(vals), self.num_data)
-        seen: Dict[Any, int] = {}
-        ids = np.empty(len(vals), dtype=np.int32)
-        for i, v in enumerate(vals):
-            key = v.item() if hasattr(v, "item") else v
-            if key not in seen:
-                seen[key] = len(seen)
-            ids[i] = seen[key]
-        self.positions = ids
-        self.position_ids = [str(k) for k in seen.keys()]
+                      vals.shape[0], self.num_data)
+        # vectorized first-seen factorization (compact ids in order of
+        # first appearance, matching the reference's `.position` loader)
+        uniq, first, inv = np.unique(vals, return_index=True,
+                                     return_inverse=True)
+        order = np.argsort(first, kind="stable")
+        remap = np.empty(len(uniq), dtype=np.int32)
+        remap[order] = np.arange(len(uniq), dtype=np.int32)
+        self.positions = remap[inv.reshape(-1)]
+        self.position_ids = [str(uniq[o]) for o in order]
 
     def set_label(self, label) -> None:
         arr = np.asarray(label, dtype=np.float32).reshape(-1)
@@ -337,6 +337,7 @@ class BinnedDataset:
         ds.metadata.set_init_score(init_score)
         ds.metadata.set_position(position)
 
+        mode = None
         if reference is not None:
             # validation data: reuse the training mappers & grouping so bin
             # ids live in the SAME space (reference:
@@ -346,38 +347,81 @@ class BinnedDataset:
             ds.groups = reference.groups
             ds.feature_names = reference.feature_names
         else:
-            # sample rows across all sequences for binning; contiguous index
-            # runs are fetched through the slice protocol in blocks so
-            # disk-backed sequences see few large reads, not one per row
             cfg = config
+            from .ops.sketch import resolve_bin_mode
+            from .parallel import network as _net
+            mode = resolve_bin_mode(cfg, total)
             sample_cnt = min(total, cfg.bin_construct_sample_cnt)
             rng = np.random.RandomState(cfg.data_random_seed)
             idx = np.sort(rng.choice(total, size=sample_cnt, replace=False)) \
                 if sample_cnt < total else np.arange(total)
-            sample_rows = []
-            offset = 0
-            for s, ln in zip(seqs, lens):
-                sel = idx[(idx >= offset) & (idx < offset + ln)] - offset
-                i = 0
-                while i < len(sel):
-                    j = i
-                    while j + 1 < len(sel) and sel[j + 1] == sel[j] + 1:
-                        j += 1
-                    block = np.asarray(s[int(sel[i]):int(sel[j]) + 1],
-                                       dtype=np.float64)
-                    sample_rows.append(block.reshape(-1, F))
-                    i = j + 1
-                offset += ln
-            sample = np.concatenate(sample_rows, axis=0)
-            ds._construct_mappers_from_sample(sample,
-                                              categorical_features or [])
+            if mode == "sketch":
+                # pass 1 of 2 (out-of-core): fold every chunk into the
+                # mergeable per-feature sketches; the SAME rng-chosen
+                # row sample the exact path would block-fetch is
+                # gathered chunk-by-chunk for the EFB conflict graph,
+                # so the bundling decision — and its rng consumption —
+                # is identical across modes
+                from .ops.sketch import SketchSet
+                sset = SketchSet(F, cfg.sketch_k)
+                want_sample = bool(cfg.enable_bundle) \
+                    and _net.num_machines() <= 1
+                # idx is sorted and chunks arrive in row order, so the
+                # sample rows land contiguously: fill a preallocated
+                # matrix instead of concatenating parts (a parts list
+                # would hold 2x the sample at the concat)
+                sample = np.empty((len(idx) if want_sample else 0, F),
+                                  dtype=np.float64)
+                w = 0
+                for start, chunk in BinnedDataset._iter_seq_chunks(seqs):
+                    sset.update_chunk(chunk)
+                    if want_sample:
+                        sel = idx[(idx >= start)
+                                  & (idx < start + len(chunk))] - start
+                        if len(sel):
+                            sample[w:w + len(sel)] = chunk[sel]
+                            w += len(sel)
+                sample = sample[:w]
+                ds._construct_mappers_from_sketches(
+                    sset, categorical_features or [])
+            else:
+                # sample rows across all sequences for binning; contiguous
+                # index runs are fetched through the slice protocol in
+                # blocks so disk-backed sequences see few large reads, not
+                # one per row
+                sample_rows = []
+                offset = 0
+                for s, ln in zip(seqs, lens):
+                    sel = idx[(idx >= offset) & (idx < offset + ln)] - offset
+                    i = 0
+                    while i < len(sel):
+                        j = i
+                        while j + 1 < len(sel) and sel[j + 1] == sel[j] + 1:
+                            j += 1
+                        block = np.asarray(s[int(sel[i]):int(sel[j]) + 1],
+                                           dtype=np.float64)
+                        sample_rows.append(block.reshape(-1, F))
+                        i = j + 1
+                    offset += ln
+                sample = np.concatenate(sample_rows, axis=0)
+                ds._construct_mappers_from_sample(sample,
+                                                  categorical_features or [])
             ds._build_groups()
             # resolve any pending sparse bundling with the SAMPLE columns
             # (skip the binning pass entirely when nothing is pending)
             if getattr(ds, "_pending_sparse", None):
                 if ds._vec and ds.used_features:
-                    smat = ds.batched_mapper().map_chunk(
-                        sample[:, ds.used_features])
+                    # map the sample in row blocks: the used-features
+                    # fancy index copies its input, so a one-shot call
+                    # would hold a second full-f64 sample at peak
+                    bm = ds.batched_mapper()
+                    parts = [bm.map_chunk(sample[b:b + 65536,
+                                                 ds.used_features])
+                             for b in range(0, len(sample), 65536)]
+                    smat = (np.concatenate(parts, axis=0) if parts else
+                            np.empty((0, len(ds.used_features)),
+                                     dtype=ds._bin_dtype()))
+                    del parts
                     sample_cols = {f: np.asarray(smat[:, i]) for i, f
                                    in enumerate(ds.used_features)}
                 else:
@@ -385,57 +429,118 @@ class BinnedDataset:
                         f: ds.bin_mappers[f].values_to_bins(sample[:, f])
                         for f in ds.used_features}
                 ds._finalize_groups(sample_cols)
+                del sample_cols
             else:
                 ds._finalize_groups({})
+            # the raw sample has served binning + bundling; drop it
+            # before the pack pass so it doesn't ride the whole stream
+            sample = None
 
-        # stream: bin each chunk, pack, and push it into the host matrix
-        # and/or the device ingest buffer — chunk boundaries never change
-        # the result (the mapping is per-row; tests/test_construct_device
-        # straddles sequence boundaries to prove it)
+        # stream (pass 2 of 2): bin each chunk, pack, and push it into the
+        # host matrix and/or the device ingest buffer — chunk boundaries
+        # never change the result (the mapping is per-row;
+        # tests/test_construct_device straddles sequence boundaries to
+        # prove it)
         dtype = ds._bin_dtype()
         ingest = ds._make_ingest(dtype)
-        keep = ds._keep_host and not (
-            ingest is not None
-            and bool(getattr(config, "free_host_binned", False)))
+        # out-of-core default: when the sketch path streamed the data and
+        # the device ingest buffer holds it, the host binned matrix is NOT
+        # kept unless free_host_binned was set explicitly — geometry
+        # changes at train time re-stream from the retained source instead
+        # (restream_ingest)
+        free_host = bool(getattr(config, "free_host_binned", False))
+        if (mode == "sketch" and ingest is not None
+                and "free_host_binned" not in getattr(config, "_raw", {})):
+            free_host = True
+        keep = ds._keep_host and not (ingest is not None and free_host)
         out = (np.zeros((total, len(ds.groups)), dtype=dtype)
                if keep or ingest is None else None)
         raw = (np.zeros((total, F), dtype=np.float32)
                if config.linear_tree else None)
-        bmap = ds.batched_mapper() if (ds._vec and ds.used_features) \
-            else None
-        row = 0
-        for s in seqs:
-            bs = getattr(s, "batch_size", 4096) or 4096
-            for startr in range(0, len(s), bs):
-                chunk = np.asarray(s[startr:startr + bs], dtype=np.float64)
-                if chunk.ndim == 1:
-                    chunk = chunk.reshape(1, -1)
-                if bmap is not None:
-                    mat = bmap.map_chunk(chunk[:, ds.used_features])
-                    cols = {f: np.asarray(mat[:, i]) for i, f
-                            in enumerate(ds.used_features)}
-                else:
-                    cols = {f: ds.bin_mappers[f].values_to_bins(chunk[:, f])
-                            for f in ds.used_features}
-                packed = ds._pack_groups(cols, len(chunk), dtype)
-                if out is not None:
-                    out[row:row + len(chunk)] = packed
-                if ingest is not None:
-                    ingest.push(packed)
-                if raw is not None:
-                    raw[row:row + len(chunk)] = chunk.astype(np.float32)
-                row += len(chunk)
+        ds._stream_map_pack(seqs, dtype, ingest=ingest, out=out, raw=raw)
         ds.binned = out
         if ingest is not None:
             ingest.finish()
             ds.device_ingest = ingest
         ds.raw_data = raw
+        if reference is None and ingest is not None and out is None:
+            # keep the chunk source: epoch re-streaming (a geometry
+            # change at train time rebuilds the ingest buffer from here
+            # instead of materializing the full host matrix)
+            ds._stream_src = list(seqs)
         if reference is None:
             from .obs import health as obs_health
             obs_health.configure_from_config(config)
             if obs_health.enabled():
                 ds.reference_profile()
         return ds
+
+    @staticmethod
+    def _iter_seq_chunks(seqs):
+        """Yield (global_row_offset, float64 chunk) across sequences,
+        honoring EACH sequence's own ``batch_size`` — the one chunk
+        iterator every streaming pass shares, so a mixed-batch-size
+        sequence list chunks identically in the sketch pass, the
+        map-and-pack pass and epoch re-streaming (bit-parity asserted
+        by tests/test_sketch.py)."""
+        row = 0
+        for s in seqs:
+            ln = len(s)
+            bs = int(getattr(s, "batch_size", 4096) or 4096)
+            for startr in range(0, ln, bs):
+                chunk = np.asarray(s[startr:startr + bs],
+                                   dtype=np.float64)
+                if chunk.ndim == 1:
+                    chunk = chunk.reshape(1, -1)
+                yield row + startr, chunk
+            row += ln
+
+    def _stream_map_pack(self, seqs, dtype, ingest=None, out=None,
+                         raw=None) -> None:
+        """Map-and-pack every sequence chunk into the given sinks (the
+        shared body of construction pass 2 and epoch re-streaming)."""
+        bmap = self.batched_mapper() if (self._vec and self.used_features) \
+            else None
+        for start, chunk in self._iter_seq_chunks(seqs):
+            if bmap is not None:
+                mat = bmap.map_chunk(chunk[:, self.used_features])
+                cols = {f: np.asarray(mat[:, i]) for i, f
+                        in enumerate(self.used_features)}
+            else:
+                cols = {f: self.bin_mappers[f].values_to_bins(chunk[:, f])
+                        for f in self.used_features}
+            packed = self._pack_groups(cols, len(chunk), dtype)
+            if out is not None:
+                out[start:start + len(chunk)] = packed
+            if ingest is not None:
+                ingest.push(packed)
+            if raw is not None:
+                raw[start:start + len(chunk)] = chunk.astype(np.float32)
+
+    def restream_ingest(self, tpu_row_chunk: int):
+        """Re-stream the retained chunk source into a FRESH DeviceIngest
+        with the requested row geometry — the out-of-core twin of
+        ``DeviceIngest.host_binned()`` for the learner's recovery path
+        when the construct-time geometry no longer matches: one more
+        pass over the source instead of materializing the full host
+        binned matrix.  Returns None when there is no retained source
+        or the device path is unavailable."""
+        seqs = getattr(self, "_stream_src", None)
+        if not seqs:
+            return None
+        dtype = self._bin_dtype()
+        try:
+            from .ops.construct import DeviceIngest
+            ingest = DeviceIngest(len(self.groups), self.num_data, dtype,
+                                  int(tpu_row_chunk))
+        except Exception as exc:
+            log.warning("restream ingest unavailable (%s)",
+                        str(exc).split("\n")[0][:120])
+            return None
+        self._stream_map_pack(seqs, dtype, ingest=ingest)
+        ingest.finish()
+        self.device_ingest = ingest
+        return ingest
 
     def _resolve_construct_mode(self, is_reference: bool) -> None:
         """Pick the construction path for this dataset from
@@ -469,23 +574,10 @@ class BinnedDataset:
         self._construct_mappers(sample, categorical_features,
                                 _presampled=True)
 
-    def _construct_mappers(self, data: np.ndarray,
-                           categorical_features: Sequence[int],
-                           _presampled: bool = False) -> None:
+    def _mapper_param_table(self):
+        """Per-feature bin-finding knobs shared by the exact and sketch
+        paths: (max_bin_by_feature list or None, forced bounds dict)."""
         cfg = self.config
-        n = self.num_data
-        if _presampled:
-            sample_cnt = len(data)
-            sample_idx = np.arange(sample_cnt)
-        else:
-            sample_cnt = min(n, cfg.bin_construct_sample_cnt)
-            rng = np.random.RandomState(cfg.data_random_seed)
-            if sample_cnt < n:
-                sample_idx = np.sort(
-                    rng.choice(n, size=sample_cnt, replace=False))
-            else:
-                sample_idx = np.arange(n)
-        cat_set = set(int(c) for c in categorical_features)
         max_bin_by_feature = None
         if cfg.max_bin_by_feature:
             max_bin_by_feature = [int(x) for x in str(cfg.max_bin_by_feature).split(",")]
@@ -503,6 +595,95 @@ class BinnedDataset:
             except (OSError, ValueError, KeyError, TypeError) as exc:
                 log.warning("could not read forcedbins file %s (%s); "
                             "ignoring", cfg.forcedbins_filename, exc)
+        return max_bin_by_feature, forced_bounds
+
+    def _finish_mappers(self) -> None:
+        """Shared epilogue of every mapper-construction path."""
+        self.used_features = [f for f in range(self.num_total_features)
+                              if not self.bin_mappers[f].is_trivial]
+        if not self.used_features:
+            log.warning("There are no meaningful features which satisfy the "
+                        "provided configuration. Decreasing Dataset parameters "
+                        "min_data_in_bin or min_data_in_leaf and re-constructing "
+                        "Dataset might resolve this warning.")
+
+    def _construct_mappers_from_sketches(self, sset,
+                                         categorical_features) -> None:
+        """BinMappers from accumulated per-feature sketches
+        (ops/sketch.py).  Under multi-process construction each rank
+        sketched only its ROW shard; the fixed-size sketch states are
+        allgathered and canonically merged, so every rank derives
+        bit-identical global mappers without any rank ever holding the
+        global matrix (the rank-sharded out-of-core path)."""
+        cfg = self.config
+        from .parallel import network as _net
+        self._distributed = _net.num_machines() > 1
+        if self._distributed:
+            from .parallel.distributed import allgather_feature_sketches
+            sset = allgather_feature_sketches(sset)
+            # feature widths agree by max, like allgather_bin_mappers
+            self.num_total_features = max(self.num_total_features,
+                                          len(sset))
+        cat_set = set(int(c) for c in categorical_features)
+        max_bin_by_feature, forced_bounds = self._mapper_param_table()
+        # the sketch pass consumes the FULL stream, so the pre-filter's
+        # sample/population ratio is exactly 1
+        filter_cnt = int(cfg.min_data_in_leaf)
+
+        def _mb(f):
+            if max_bin_by_feature and f < len(max_bin_by_feature):
+                return max_bin_by_feature[f]
+            return cfg.max_bin
+
+        trivial = BinMapper()
+        self.bin_mappers = [
+            sset.sketches[f].to_mapper(
+                _mb(f), min_data_in_bin=cfg.min_data_in_bin,
+                min_split_data=filter_cnt,
+                pre_filter=cfg.feature_pre_filter,
+                bin_type=(BIN_CATEGORICAL if f in cat_set
+                          else BIN_NUMERICAL),
+                use_missing=cfg.use_missing,
+                zero_as_missing=cfg.zero_as_missing,
+                forced_upper_bounds=forced_bounds.get(f))
+            if f < len(sset) else trivial
+            for f in range(self.num_total_features)]
+        self._finish_mappers()
+
+    def _construct_mappers(self, data: np.ndarray,
+                           categorical_features: Sequence[int],
+                           _presampled: bool = False) -> None:
+        cfg = self.config
+        n = self.num_data
+        if not _presampled:
+            from .ops.sketch import resolve_bin_mode
+            if resolve_bin_mode(cfg, n) == "sketch":
+                # sketch-based bin finding over row chunks: no full
+                # sample materialization, no full column sort — and the
+                # distributed branch inside merges rank ROW shards
+                from .ops.sketch import SketchSet
+                sset = SketchSet(self.num_total_features, cfg.sketch_k)
+                step = self.CONSTRUCT_CHUNK
+                for start in range(0, n, step):
+                    sset.update_chunk(np.asarray(
+                        data[start:min(start + step, n)],
+                        dtype=np.float64))
+                self._construct_mappers_from_sketches(
+                    sset, categorical_features)
+                return
+        if _presampled:
+            sample_cnt = len(data)
+            sample_idx = np.arange(sample_cnt)
+        else:
+            sample_cnt = min(n, cfg.bin_construct_sample_cnt)
+            rng = np.random.RandomState(cfg.data_random_seed)
+            if sample_cnt < n:
+                sample_idx = np.sort(
+                    rng.choice(n, size=sample_cnt, replace=False))
+            else:
+                sample_idx = np.arange(n)
+        cat_set = set(int(c) for c in categorical_features)
+        max_bin_by_feature, forced_bounds = self._mapper_param_table()
         # feature_pre_filter threshold (reference: dataset_loader.cpp FindBin call)
         filter_cnt = int(cfg.min_data_in_leaf * sample_cnt / max(n, 1))
         # multi-process construction: each rank finds bins only for its
@@ -598,13 +779,7 @@ class BinnedDataset:
             self.bin_mappers = [merged.get(f, trivial)
                                 for f in range(num_total)]
             self.num_total_features = num_total
-        self.used_features = [f for f in range(self.num_total_features)
-                              if not self.bin_mappers[f].is_trivial]
-        if not self.used_features:
-            log.warning("There are no meaningful features which satisfy the "
-                        "provided configuration. Decreasing Dataset parameters "
-                        "min_data_in_bin or min_data_in_leaf and re-constructing "
-                        "Dataset might resolve this warning.")
+        self._finish_mappers()
 
     def _build_groups(self) -> None:
         """EFB bundling (reference: dataset.cpp FindGroups:60 / FastFeatureBundling:246).

@@ -523,16 +523,37 @@ class SerialTreeLearner:
                 binned = dataset.binned
                 if binned is None and ing is not None:
                     # geometry changed between construction and train
-                    # (e.g. a different tpu_row_chunk): recover the host
-                    # matrix once and rebuild through the oracle path
-                    binned = ing.host_binned()
-                binned = np.ascontiguousarray(binned)
-                if binned.shape[1] < self.G:   # zero usable features
-                    binned = np.zeros((binned.shape[0], self.G),
-                                      binned.dtype)
-                pad = np.zeros((self._pb_rows, self.N_pad), binned.dtype)
-                pad[:self.G, C:C + self.N] = binned.T
-                self._part0 = jnp.asarray(pad)
+                    # (e.g. a different tpu_row_chunk): an out-of-core
+                    # dataset re-streams its retained chunk source into
+                    # a fresh ingest buffer at THIS geometry (epoch
+                    # re-streaming, dataset.py restream_ingest) — the
+                    # full host matrix never materializes
+                    restream = getattr(dataset, "restream_ingest", None)
+                    if restream is not None and getattr(
+                            dataset, "_stream_src", None):
+                        ing2 = restream(self.row_chunk)
+                        if (ing2 is not None and ing2.N == self.N
+                                and ing2.matches(self.row_chunk,
+                                                 self.N_pad,
+                                                 host_bin_dtype)):
+                            self._part0 = ing2.part0(self._pb_rows)
+                            # drop the stale-geometry buffer: keeping
+                            # both would hold 2x the binned footprint
+                            # for the whole training run
+                            self._ingest = ing = ing2
+                    if self._part0 is None:
+                        # last resort: recover the host matrix once and
+                        # rebuild through the oracle path
+                        binned = ing.host_binned()
+                if self._part0 is None:
+                    binned = np.ascontiguousarray(binned)
+                    if binned.shape[1] < self.G:   # zero usable features
+                        binned = np.zeros((binned.shape[0], self.G),
+                                          binned.dtype)
+                    pad = np.zeros((self._pb_rows, self.N_pad),
+                                   binned.dtype)
+                    pad[:self.G, C:C + self.N] = binned.T
+                    self._part0 = jnp.asarray(pad)
 
         # ---- scalars ----
         self.l1 = float(config.lambda_l1)

@@ -31,6 +31,8 @@ explicit snapshot calls, each costing at most one device→host sync.
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import os
 import threading
 import time
@@ -230,6 +232,26 @@ class FlightRecorder:
 # ---------------------------------------------------------------------------
 # training<->serving skew monitor
 # ---------------------------------------------------------------------------
+# ambient tenant id for skew attribution: the service's dispatch wraps
+# its predict call in ``tenant_scope`` so the monitor — which observes
+# deep inside the serving path, with no tenant in any signature on the
+# way down — can key its rolling digests per tenant without widening
+# every call chain between admission and the digest
+_serving_tenant: contextvars.ContextVar = contextvars.ContextVar(
+    "lightgbm_tpu_serving_tenant", default=None)
+
+
+@contextlib.contextmanager
+def tenant_scope(tenant: Optional[str]):
+    """Attribute every skew observation inside the block to ``tenant``
+    (the admission layer's client id; None = unattributed)."""
+    tok = _serving_tenant.set(tenant)
+    try:
+        yield
+    finally:
+        _serving_tenant.reset(tok)
+
+
 class SkewMonitor:
     """Rolling serving-time digests per bucket, scored against the
     model's reference profile (obs/digest.py).  All host NumPy — the
@@ -250,6 +272,10 @@ class SkewMonitor:
     # PSI over 16 coarse bins accurate to ~±0.02 while the digest
     # stays ~0.5 ms on the 2-core host
     OBSERVE_CAP = 2048
+    # tenant ids are client-supplied strings: bound the per-tenant
+    # digest map exactly like the service bounds tenant latency
+    # histograms — overflow tenants fold into one "~other" bucket
+    TENANT_MAX = 32
 
     def __init__(self, profile: Dict[str, Any], groups, bin_mappers,
                  num_bins: int, topk: int = 5, threshold: float = 0.25):
@@ -263,6 +289,8 @@ class SkewMonitor:
         self.counts: Dict[Any, np.ndarray] = {}     # bucket -> (G, nb)
         self.rows: Dict[Any, int] = {}              # rows DIGESTED
         self.seen: Dict[Any, int] = {}              # rows served
+        self.tenant_counts: Dict[str, np.ndarray] = {}  # tenant -> (G, nb)
+        self.tenant_rows: Dict[str, int] = {}
         self.margin = np.zeros(digest.MARGIN_BUCKETS, np.int64)
         self.alerts = 0
         self._alerted: set = set()
@@ -286,16 +314,32 @@ class SkewMonitor:
         if n > self.OBSERVE_CAP:
             rows = rows[::n // self.OBSERVE_CAP + 1]
         c = digest.bin_counts_host(rows, self.nb)
+        tenant = _serving_tenant.get()
         with self._lock:
             prev = self.counts.get(bucket)
             self.counts[bucket] = c if prev is None else prev + c
             self.rows[bucket] = self.rows.get(bucket, 0) + rows.shape[0]
             self.seen[bucket] = self.seen.get(bucket, 0) + n
+            if tenant is not None:
+                tkey = str(tenant)
+                if tkey not in self.tenant_counts and \
+                        len(self.tenant_counts) >= self.TENANT_MAX:
+                    tkey = "~other"
+                tprev = self.tenant_counts.get(tkey)
+                # copy, never alias counts[bucket]: the rolling halve
+                # below is in-place and must hit each map exactly once
+                self.tenant_counts[tkey] = \
+                    c.copy() if tprev is None else tprev + c
+                self.tenant_rows[tkey] = \
+                    self.tenant_rows.get(tkey, 0) + rows.shape[0]
             total = sum(self.rows.values())
             if total > 2 * self.ROLL_ROWS:
                 for b in self.counts:
                     self.counts[b] //= 2
                     self.rows[b] //= 2
+                for t in self.tenant_counts:
+                    self.tenant_counts[t] //= 2
+                    self.tenant_rows[t] //= 2
             now = time.monotonic()
             check = now - self._last_check >= self.CHECK_INTERVAL_S
             if check:
@@ -328,6 +372,26 @@ class SkewMonitor:
         return digest.rank_skew(self.profile, fc,
                                 self.topk if topk is None else topk)
 
+    def tenant_scores(self, topk: Optional[int] = None
+                      ) -> Dict[str, Dict[str, Any]]:
+        """Per-tenant PSI against the SAME reference profile: which
+        client's traffic drifted, not just that some traffic did."""
+        with self._lock:
+            snap = {t: (c.copy(), int(self.tenant_rows.get(t, 0)))
+                    for t, c in self.tenant_counts.items()}
+        k = self.topk if topk is None else topk
+        out: Dict[str, Dict[str, Any]] = {}
+        for t, (c, n) in sorted(snap.items()):
+            if n <= 0:
+                continue
+            fc = digest.per_feature_counts(self.groups, self.bin_mappers,
+                                           n, c)
+            top = digest.rank_skew(self.profile, fc, k)
+            out[t] = {"rows": n,
+                      "psi_max": (top[0]["psi"] if top else 0.0),
+                      "top": top}
+        return out
+
     def _check_thresholds(self) -> None:
         for s in self.scores(topk=0):
             if s["psi"] > self.threshold and s["feature"] not in \
@@ -355,7 +419,8 @@ class SkewMonitor:
         return {"rows_by_bucket": rows, "rows_total": sum(rows.values()),
                 "rows_seen": sum(seen.values()),
                 "alerts": alerts, "psi_threshold": self.threshold,
-                "top": self.scores(), "margin_hist": margin}
+                "top": self.scores(), "margin_hist": margin,
+                "tenants": self.tenant_scores()}
 
 
 # ---------------------------------------------------------------------------

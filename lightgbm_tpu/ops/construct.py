@@ -166,7 +166,8 @@ def sorted_sample_columns(sample: np.ndarray, workers: int = 1
             "lo": lo, "hi": hi}
 
 
-def _distinct_from_sorted(vals: np.ndarray, zero_cnt: int
+def _distinct_from_sorted(vals: np.ndarray, zero_cnt: int,
+                          counts: Optional[np.ndarray] = None
                           ) -> Tuple[np.ndarray, np.ndarray]:
     """Distinct values + counts from an ascending non-zero non-NaN value
     array, with the implied-zero bin spliced in — the vectorized replica
@@ -176,19 +177,30 @@ def _distinct_from_sorted(vals: np.ndarray, zero_cnt: int
     ``b <= nextafter(a, inf)`` collapse into one distinct value keeping
     the LARGER value; a run's representative is therefore its last
     element.
+
+    ``counts`` (optional) marks ``vals`` as an already-deduplicated
+    weighted array — each entry stands for ``counts[i]`` raw
+    occurrences (the sketch path, ops/sketch.py).  Identical raw values
+    always share one weighted entry, so run boundaries — and therefore
+    the merged distincts — match the unweighted scan bit for bit.
     """
     m = len(vals)
     if m == 0:
         return (np.asarray([0.0]), np.asarray([zero_cnt], dtype=np.int64))
     if m == 1:
         d = np.asarray([float(vals[0])])
-        c = np.asarray([1], dtype=np.int64)
+        c = (np.asarray([1], dtype=np.int64) if counts is None
+             else np.asarray([int(counts[0])], dtype=np.int64))
     else:
         merge = vals[1:] <= np.nextafter(vals[:-1], np.inf)
         ends = np.flatnonzero(np.concatenate([~merge, [True]]))
         d = vals[ends]
         starts = np.concatenate([[0], ends[:-1] + 1])
-        c = (ends - starts + 1).astype(np.int64)
+        if counts is None:
+            c = (ends - starts + 1).astype(np.int64)
+        else:
+            csum = np.concatenate([[0], np.cumsum(counts)])
+            c = (csum[ends + 1] - csum[starts]).astype(np.int64)
     # zero insertion, replicating find_bin's three sites exactly:
     #  * all-positive sample with zeros present -> leading zero bin
     #  * sign change between adjacent distincts -> zero spliced between
@@ -338,9 +350,39 @@ def find_bin_sorted(sorted_nonzero: np.ndarray, na_cnt: int,
     non-zero non-NaN value array — the per-feature stage of the batched
     construction.  Distinct extraction, bin counting and the greedy
     search are vectorized; every branch mirrors the oracle exactly."""
-    bm = BinMapper()
     vals = np.asarray(sorted_nonzero, dtype=np.float64)
     non_na_cnt = len(vals)
+    zero_cnt = int(total_sample_cnt - non_na_cnt - na_cnt)
+    distinct, counts = _distinct_from_sorted(vals, zero_cnt)
+    if non_na_cnt == 0 and zero_cnt == 0:
+        # find_bin still emits the zero distinct with its (zero) count
+        distinct, counts = np.asarray([0.0]), np.asarray([0],
+                                                         dtype=np.int64)
+    return mapper_from_distinct(
+        distinct, counts, na_cnt, total_sample_cnt, max_bin,
+        min_data_in_bin=min_data_in_bin, min_split_data=min_split_data,
+        pre_filter=pre_filter, bin_type=bin_type, use_missing=use_missing,
+        zero_as_missing=zero_as_missing,
+        forced_upper_bounds=forced_upper_bounds)
+
+
+def mapper_from_distinct(distinct: np.ndarray, counts: np.ndarray,
+                         na_cnt: int, total_sample_cnt: int, max_bin: int,
+                         min_data_in_bin: int = 3, min_split_data: int = 0,
+                         pre_filter: bool = False,
+                         bin_type: int = BIN_NUMERICAL,
+                         use_missing: bool = True,
+                         zero_as_missing: bool = False,
+                         forced_upper_bounds: Optional[List[float]] = None
+                         ) -> BinMapper:
+    """The shared distinct+counts -> BinMapper tail of the bin finder:
+    bounds search, per-bin counting, the categorical most-frequent-first
+    walk, pre-filtering and the default/most-freq-bin epilogue.  Both
+    the exact path (``find_bin_sorted``, distincts from a full column
+    sort) and the out-of-core sketch path (ops/sketch.py, distincts
+    from merged cell maxes) end here, which is what makes the two
+    bit-comparable."""
+    bm = BinMapper()
     if not use_missing:
         bm.missing_type = MISSING_NONE
     elif zero_as_missing:
@@ -349,12 +391,8 @@ def find_bin_sorted(sorted_nonzero: np.ndarray, na_cnt: int,
         bm.missing_type = MISSING_NAN if na_cnt > 0 else MISSING_NONE
     bm.bin_type = bin_type
     bm.default_bin = 0
-    zero_cnt = int(total_sample_cnt - non_na_cnt - na_cnt)
-    distinct, counts = _distinct_from_sorted(vals, zero_cnt)
-    if non_na_cnt == 0 and zero_cnt == 0:
-        # find_bin still emits the zero distinct with its (zero) count
-        distinct, counts = np.asarray([0.0]), np.asarray([0],
-                                                         dtype=np.int64)
+    distinct = np.asarray(distinct, dtype=np.float64)
+    counts = np.asarray(counts, dtype=np.int64)
     bm.min_val = float(distinct[0]) if len(distinct) else 0.0
     bm.max_val = float(distinct[-1]) if len(distinct) else 0.0
     num_distinct = len(distinct)

@@ -1,0 +1,320 @@
+"""Deterministic mergeable per-feature quantile sketches for
+out-of-core bin finding (ISSUE 17 / ROADMAP item 2).
+
+The construction bottleneck for datasets that do not fit host RAM is
+the exact bin finder: it wants every (sampled) value of a feature in
+one sorted array.  This module replaces that with a sketch in the
+spirit of the weighted quantile sketch of arXiv:1806.11248 (XGBoost's
+external-memory path), but built so that merging is *canonical*:
+
+* Every non-zero non-NaN float64 value is mapped through an
+  order-preserving bijection onto uint64 codes (sign-folded IEEE bits,
+  ``_monotone_code``).
+* A sketch at ``level`` r keeps, for every occupied cell
+  ``code >> r``, the exact value count and the exact **maximum** value
+  in the cell.  Counts are additive and max is associative, so cell
+  states combine in any order.
+* ``level`` starts at 0 — cells are then exact distinct float64
+  values with exact counts, and the extracted ``BinMapper`` is
+  bit-identical to the exact sort-based oracle.  Only when the number
+  of occupied cells exceeds the capacity ``k`` does the level rise
+  (cells pairwise-merge, dropping one low bit per step).
+* The resting level is *canonical*: the smallest r with at most ``k``
+  occupied cells for the value multiset seen so far.  A folded stream
+  can never overshoot it (it only coarsens when its running occupancy
+  — a lower bound on the union's — exceeds ``k``), and a merge of
+  shard sketches aligns to the same point.  The final state is
+  therefore a pure function of the value multiset: chunk order, chunk
+  boundaries and rank sharding cannot change a single bit of the
+  extracted cuts.
+
+Cut extraction feeds the (cell max, cell count) pairs through the SAME
+nextafter-merge + greedy equal-count machinery as the exact path
+(ops/construct.py ``mapper_from_distinct``).  In the lossy regime
+(level > 0) the CDF error of the sketch against the raw stream is
+bounded by the heaviest multi-value cell (only the single cell
+straddling a query point can be misattributed — cells partition the
+value axis into disjoint ordered ranges); ``rank_error_bound`` reports
+that bound and tests/test_sketch.py asserts the measured deviation
+stays under it.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..utils import log
+from .binning import BIN_CATEGORICAL, BIN_NUMERICAL, K_ZERO_THRESHOLD
+
+DEFAULT_K = 8192
+
+
+def _monotone_code(vals: np.ndarray) -> np.ndarray:
+    """Order-preserving bijection float64 -> uint64 (sign-folded IEEE
+    bits): positives get the sign bit set, negatives are bit-flipped so
+    more-negative sorts lower.  NaNs must be filtered by the caller."""
+    b = np.ascontiguousarray(vals, dtype=np.float64).view(np.int64)
+    return np.where(b < 0, ~b, b ^ np.int64(-2 ** 63)).astype(np.uint64)
+
+
+def _combine(keys: np.ndarray, counts: np.ndarray, maxes: np.ndarray):
+    """Collapse duplicate keys: counts sum, maxes max — the (unsorted,
+    with-duplicates) -> (sorted unique) normal form.  Both reductions
+    are order-independent, so any interleaving of inputs lands here."""
+    if len(keys) == 0:
+        return keys, counts, maxes
+    order = np.argsort(keys, kind="stable")
+    k2, c2, m2 = keys[order], counts[order], maxes[order]
+    starts = np.flatnonzero(
+        np.concatenate([[True], k2[1:] != k2[:-1]]))
+    uk = k2[starts]
+    uc = np.add.reduceat(c2, starts).astype(np.int64)
+    um = np.maximum.reduceat(m2, starts)
+    return uk, uc, um
+
+
+class FeatureSketch:
+    """One feature's mergeable value sketch (see module docstring)."""
+
+    __slots__ = ("k", "level", "keys", "counts", "maxes",
+                 "nan_cnt", "total_cnt")
+
+    def __init__(self, k: int = DEFAULT_K):
+        # k >= 2 guarantees the coarsening loop terminates before the
+        # 64-bit code runs out of droppable bits (level <= 63)
+        self.k = max(int(k), 2)
+        self.level = 0
+        self.keys = np.empty(0, np.uint64)
+        self.counts = np.empty(0, np.int64)
+        self.maxes = np.empty(0, np.float64)
+        self.nan_cnt = 0
+        self.total_cnt = 0
+
+    # -- accumulation ---------------------------------------------------
+    def _coarsen_to_fit(self) -> None:
+        while len(self.keys) > self.k:
+            self.level += 1
+            self.keys, self.counts, self.maxes = _combine(
+                self.keys >> np.uint64(1), self.counts, self.maxes)
+
+    def update(self, values) -> None:
+        """Fold one raw value chunk (any order, NaN/zero included)."""
+        v = np.asarray(values, dtype=np.float64).ravel()
+        self.total_cnt += int(v.size)
+        if v.size == 0:
+            return
+        nan = np.isnan(v)
+        n_nan = int(np.count_nonzero(nan))
+        if n_nan:
+            self.nan_cnt += n_nan
+            v = v[~nan]
+        # |v| <= K_ZERO_THRESHOLD is the implied-zero bin, tracked by
+        # count only (zero_cnt = total - nan - sum(counts)), exactly
+        # like the exact path's sparse sampling
+        v = v[np.abs(v) > K_ZERO_THRESHOLD]
+        if v.size == 0:
+            return
+        v = np.sort(v)
+        keys = _monotone_code(v) >> np.uint64(self.level)
+        # v ascending => codes ascending => per-key groups contiguous:
+        # group count by run length, group max = run's last element
+        starts = np.flatnonzero(
+            np.concatenate([[True], keys[1:] != keys[:-1]]))
+        bounds = np.concatenate([starts, [len(keys)]])
+        uk = keys[starts]
+        uc = np.diff(bounds).astype(np.int64)
+        um = v[bounds[1:] - 1]
+        if len(self.keys) == 0:
+            self.keys, self.counts, self.maxes = uk, uc, um
+        else:
+            self.keys, self.counts, self.maxes = _combine(
+                np.concatenate([self.keys, uk]),
+                np.concatenate([self.counts, uc]),
+                np.concatenate([self.maxes, um]))
+        self._coarsen_to_fit()
+
+    @classmethod
+    def merge(cls, sketches: Sequence["FeatureSketch"]) -> "FeatureSketch":
+        """Canonical multiset merge of shard/chunk sketches: the result
+        is bit-identical for ANY partitioning or ordering of the same
+        value stream (tests/test_sketch.py permutes and re-shards)."""
+        sketches = list(sketches)
+        if not sketches:
+            return cls()
+        out = cls(sketches[0].k)
+        if any(s.k != out.k for s in sketches):
+            raise ValueError("cannot merge sketches with different k")
+        out.total_cnt = sum(s.total_cnt for s in sketches)
+        out.nan_cnt = sum(s.nan_cnt for s in sketches)
+        lvl = max(s.level for s in sketches)
+        parts = [(s.keys >> np.uint64(lvl - s.level), s.counts, s.maxes)
+                 for s in sketches if len(s.keys)]
+        if parts:
+            out.level = lvl
+            out.keys, out.counts, out.maxes = _combine(
+                np.concatenate([p[0] for p in parts]),
+                np.concatenate([p[1] for p in parts]),
+                np.concatenate([p[2] for p in parts]))
+            out._coarsen_to_fit()
+        return out
+
+    # -- extraction -----------------------------------------------------
+    @property
+    def zero_cnt(self) -> int:
+        return int(self.total_cnt - self.nan_cnt - int(self.counts.sum()))
+
+    def rank_error_bound(self) -> int:
+        """Worst-case CDF miscount vs the raw stream: only the one cell
+        straddling a query value can be misattributed, and exact cells
+        (level 0) or single-value cells cannot err at all."""
+        if self.level == 0 or len(self.counts) == 0:
+            return 0
+        multi = self.counts[self.counts > 1]
+        return int(multi.max()) if len(multi) else 0
+
+    def rank_upto(self, x: float) -> int:
+        """Sketch CDF: count of non-NaN values <= x (zeros included) —
+        the quantity the rank-error bound is asserted against."""
+        i = int(np.searchsorted(self.maxes, x, side="right"))
+        r = int(self.counts[:i].sum())
+        if x >= 0.0:
+            r += self.zero_cnt
+        return r
+
+    def to_mapper(self, max_bin: int, min_data_in_bin: int = 3,
+                  min_split_data: int = 0, pre_filter: bool = False,
+                  bin_type: int = BIN_NUMERICAL, use_missing: bool = True,
+                  zero_as_missing: bool = False,
+                  forced_upper_bounds: Optional[List[float]] = None):
+        """The feature's BinMapper via the SAME distinct+counts tail as
+        the exact path (ops/construct.py mapper_from_distinct) — at
+        level 0 the inputs are the exact distinct values and counts, so
+        the mapper is bit-identical to the sort-based oracle."""
+        from .construct import _distinct_from_sorted, mapper_from_distinct
+        if bin_type == BIN_CATEGORICAL and self.level > 0:
+            # a coarsened cell folds several category ids into one max:
+            # silently mis-binning categories is never acceptable
+            raise ValueError(
+                "categorical feature overflowed the sketch (more than "
+                "sketch_k=%d distinct values); raise sketch_k or use "
+                "bin_construct_mode=exact" % self.k)
+        zero_cnt = self.zero_cnt
+        if len(self.maxes) == 0 and zero_cnt == 0:
+            # mirror find_bin_sorted's empty-feature special case: the
+            # zero distinct is emitted with a zero count
+            distinct = np.asarray([0.0])
+            counts = np.asarray([0], dtype=np.int64)
+        else:
+            distinct, counts = _distinct_from_sorted(
+                self.maxes, zero_cnt, counts=self.counts)
+        return mapper_from_distinct(
+            distinct, counts, na_cnt=self.nan_cnt,
+            total_sample_cnt=self.total_cnt, max_bin=max_bin,
+            min_data_in_bin=min_data_in_bin, min_split_data=min_split_data,
+            pre_filter=pre_filter, bin_type=bin_type,
+            use_missing=use_missing, zero_as_missing=zero_as_missing,
+            forced_upper_bounds=forced_upper_bounds)
+
+
+class SketchSet:
+    """All features' sketches for one dataset (or one rank's row shard),
+    with a compact binary serialization for the rank allgather."""
+
+    def __init__(self, num_features: int, k: int = DEFAULT_K):
+        self.k = max(int(k), 2)
+        self.sketches = [FeatureSketch(self.k)
+                         for _ in range(int(num_features))]
+
+    def __len__(self) -> int:
+        return len(self.sketches)
+
+    def update_chunk(self, chunk: np.ndarray) -> None:
+        """Fold one (rows, F) raw chunk, column by column."""
+        chunk = np.asarray(chunk, dtype=np.float64)
+        if chunk.ndim == 1:
+            chunk = chunk.reshape(1, -1)
+        if chunk.shape[1] != len(self.sketches):
+            raise ValueError("chunk has %d features, sketch set has %d"
+                             % (chunk.shape[1], len(self.sketches)))
+        for f in range(chunk.shape[1]):
+            self.sketches[f].update(chunk[:, f])
+
+    @classmethod
+    def merge(cls, sets: Sequence["SketchSet"]) -> "SketchSet":
+        sets = list(sets)
+        if not sets:
+            return cls(0)
+        nf = max(len(s) for s in sets)
+        out = cls(0, sets[0].k)
+        out.sketches = [
+            FeatureSketch.merge([s.sketches[f] for s in sets
+                                 if f < len(s)])
+            for f in range(nf)]
+        return out
+
+    # -- wire format (parallel/distributed.py allgather) ----------------
+    def serialize(self) -> bytes:
+        """Header JSON + concatenated cell arrays.  No pickle: the
+        payload crosses rank boundaries."""
+        header = {
+            "k": self.k,
+            "features": [{"level": s.level, "cells": len(s.keys),
+                          "nan": s.nan_cnt, "total": s.total_cnt}
+                         for s in self.sketches],
+        }
+        keys = (np.concatenate([s.keys for s in self.sketches])
+                if self.sketches else np.empty(0, np.uint64))
+        counts = (np.concatenate([s.counts for s in self.sketches])
+                  if self.sketches else np.empty(0, np.int64))
+        maxes = (np.concatenate([s.maxes for s in self.sketches])
+                 if self.sketches else np.empty(0, np.float64))
+        return (json.dumps(header, separators=(",", ":")).encode()
+                + b"\x00" + keys.astype("<u8").tobytes()
+                + counts.astype("<i8").tobytes()
+                + maxes.astype("<f8").tobytes())
+
+    @classmethod
+    def deserialize(cls, payload: bytes) -> "SketchSet":
+        head, body = payload.split(b"\x00", 1)
+        header = json.loads(head.decode())
+        feats = header["features"]
+        out = cls(len(feats), header["k"])
+        ncell = sum(int(f["cells"]) for f in feats)
+        keys = np.frombuffer(body, "<u8", count=ncell, offset=0)
+        counts = np.frombuffer(body, "<i8", count=ncell, offset=8 * ncell)
+        maxes = np.frombuffer(body, "<f8", count=ncell, offset=16 * ncell)
+        pos = 0
+        for s, f in zip(out.sketches, feats):
+            n = int(f["cells"])
+            s.level = int(f["level"])
+            s.nan_cnt = int(f["nan"])
+            s.total_cnt = int(f["total"])
+            s.keys = keys[pos:pos + n].astype(np.uint64)
+            s.counts = counts[pos:pos + n].astype(np.int64)
+            s.maxes = maxes[pos:pos + n].astype(np.float64)
+            pos += n
+        return out
+
+    def memory_bytes(self) -> int:
+        return sum(s.keys.nbytes + s.counts.nbytes + s.maxes.nbytes
+                   for s in self.sketches)
+
+
+def resolve_bin_mode(config, num_data: int) -> str:
+    """'exact' or 'sketch' from ``bin_construct_mode`` ('auto' switches
+    to the sketch path above ``sketch_row_threshold`` rows, where the
+    exact path's full-sample sort and the raw matrix both stop being
+    cheap)."""
+    mode = str(getattr(config, "bin_construct_mode", "auto")
+               or "auto").lower()
+    if mode not in ("auto", "exact", "sketch"):
+        log.warning("bin_construct_mode=%s unknown; using 'auto'", mode)
+        mode = "auto"
+    if mode == "auto":
+        thr = int(getattr(config, "sketch_row_threshold", 1_000_000)
+                  or 1_000_000)
+        return "sketch" if int(num_data) > thr else "exact"
+    return mode

@@ -75,6 +75,35 @@ def allgather_bin_mappers(local_mappers: dict, num_total_features: int):
     return merged, num_total
 
 
+def allgather_feature_sketches(sset):
+    """Exchange per-rank feature sketches (each rank sketched only its
+    ROW shard, all features) and return the canonical merge — the
+    out-of-core twin of ``allgather_bin_mappers``: what crosses rank
+    boundaries is one fixed-size sketch state per feature
+    (ops/sketch.py), never row samples or the matrix itself.  The merge
+    is a pure function of the global value multiset, so every rank
+    derives bit-identical BinMappers for ANY rank count or row
+    sharding (tests/test_sketch.py asserts 1-vs-4-shard identity)."""
+    from ..ops.sketch import SketchSet
+    nmach = network.num_machines()
+    if nmach <= 1:
+        return sset
+    payload = sset.serialize()
+    from jax.experimental import multihost_utils
+    # two-phase exchange: lengths first, then the padded byte tensors
+    # (the same wire pattern as allgather_bin_mappers above)
+    lens = multihost_utils.process_allgather(
+        np.asarray([len(payload)], np.int32))
+    maxlen = int(lens.max())
+    buf = np.zeros((maxlen,), np.uint8)
+    buf[:len(payload)] = np.frombuffer(payload, np.uint8)
+    bufs = multihost_utils.process_allgather(buf)
+    shards = [SketchSet.deserialize(
+        bytes(bufs[r][:int(lens[r, 0])].tobytes()))
+        for r in range(bufs.shape[0])]
+    return SketchSet.merge(shards)
+
+
 def sync_config_params(config) -> None:
     """Cross-rank parameter agreement at startup (reference:
     application.cpp:173-179 — the seeds and sampled fractions must match

@@ -31,6 +31,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
+from ..obs import health as obs_health
 from ..obs import telemetry as obs
 from ..obs.telemetry import Histogram
 from ..robustness import faultinject
@@ -505,9 +506,14 @@ class ServingService:
                            model=model, tenant=tenant,
                            rows=int(X.shape[0]))
                   if obs.enabled() else obs.NULL):
-                out = self._predict(booster, kind, X, start, num,
-                                    inject_model=None if fallback
-                                    else model)
+                # the booster's SkewMonitor observes deep inside the
+                # predict path; the ambient scope keys its rolling
+                # digests by the SAME tenant id the latency histograms
+                # use, so /stats lines up PSI next to p50/p99
+                with obs_health.tenant_scope(tenant):
+                    out = self._predict(booster, kind, X, start, num,
+                                        inject_model=None if fallback
+                                        else model)
         except Exception as exc:   # noqa: BLE001 — any model fault
             self.counters["dispatch_failures"] += 1
             # fallback dispatches never blame the client: its rows
@@ -531,8 +537,9 @@ class ServingService:
                     prev = self.registry.last_good(model)
                     if prev is not None:
                         try:
-                            out = self._predict(prev, kind, X, start,
-                                                num)
+                            with obs_health.tenant_scope(tenant):
+                                out = self._predict(prev, kind, X,
+                                                    start, num)
                             self._complete(reqs, out, model, kind,
                                            fallback=True)
                             return
@@ -652,5 +659,30 @@ class ServingService:
                     "p50_s": round(h.quantile(0.5), 6),
                     "p99_s": round(h.quantile(0.99), 6)}
                 for t, h in sorted(dict(self.tenant_latency).items())},
+            # per-tenant distribution skew (PSI vs the training
+            # reference profile) from each live model's SkewMonitor,
+            # next to the latency percentiles for the same tenant ids
+            "tenant_skew": self._tenant_skew(),
             "registry": self.registry.stats(),
         }
+
+    def _tenant_skew(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for name in self.registry.names():
+            # peek, not get: a stats scrape must not refresh a model's
+            # LRU/eviction priority
+            booster = self.registry.peek(name)
+            if booster is None:
+                continue
+            gbdt = getattr(booster, "_gbdt", None)
+            serving = getattr(gbdt, "serving", None)
+            mon = getattr(serving, "_skew", None)
+            if not mon:          # None (never built) or False (can't)
+                continue
+            scores = mon.tenant_scores()
+            if scores:
+                out[name] = {
+                    t: {"rows": s["rows"],
+                        "psi_max": round(float(s["psi_max"]), 6)}
+                    for t, s in scores.items()}
+        return out

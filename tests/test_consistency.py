@@ -60,6 +60,11 @@ def test_cli_matches_python(example, objective, train_file, tmp_path):
                                rtol=1e-5, atol=1e-5)
 
 
+# tier-1 window trim (PR 17): the ranking-CLI-conf lane's fast
+# in-window representative is test_cli.py::
+# test_example_confs_train[xendcg]; the lambdarank objective itself
+# stays covered in-window by the objectives suite
+@pytest.mark.slow
 def test_cli_lambdarank_example(tmp_path):
     d = os.path.join(EXAMPLES, "lambdarank")
     model_path = tmp_path / "model.txt"

@@ -1,0 +1,432 @@
+"""Out-of-core dataset construction (ops/sketch.py + streaming paths).
+
+Acceptance for the out-of-core PR (ISSUE 17):
+
+* the sketch is CANONICAL — chunk order, chunk boundaries and rank
+  sharding cannot change a single bit of its state or extracted cuts;
+* at level 0 (distincts fit in ``sketch_k``) the sketch-derived
+  BinMapper is bit-identical to the exact sort-based oracle, including
+  NaN, zero-as-missing and categorical branches;
+* in the lossy regime the measured CDF deviation stays under the
+  reported ``rank_error_bound``;
+* streaming construction from Sequences (two passes, free-host default,
+  epoch re-streaming) trains bit-identically to the resident exact
+  path, with mixed per-sequence batch sizes;
+* a capped-RSS subprocess proves a dataset whose dense matrix exceeds
+  the address-space cap still constructs AND trains.
+"""
+
+import itertools
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.dataset import BinnedDataset
+from lightgbm_tpu.ops.binning import BIN_CATEGORICAL, BIN_NUMERICAL, BinMapper
+from lightgbm_tpu.ops.sketch import (DEFAULT_K, FeatureSketch, SketchSet,
+                                     resolve_bin_mode)
+
+BASE = {"verbosity": -1}
+
+
+def _mapper_dicts(ds):
+    return [json.dumps(bm.to_dict(), sort_keys=True)
+            for bm in ds.bin_mappers]
+
+
+def _group_tuples(ds):
+    return [(tuple(g.feature_indices), g.num_total_bin,
+             tuple(g.bin_offsets)) for g in ds.groups]
+
+
+def _tree_part(model_str: str) -> str:
+    """The model string minus the echoed parameter block (the only part
+    that legitimately differs between bin_construct_mode settings)."""
+    head, sep, tail = model_str.partition("parameters:")
+    return head
+
+
+def _columns_matrix(rng, n):
+    """Every mapper branch: dense, sparse, NaN, few-distinct, constant,
+    all-negative, categorical (negative code), integer grid."""
+    X = rng.normal(size=(n, 12))
+    X[:, 1] = np.where(rng.rand(n) < 0.9, 0.0, X[:, 1])
+    X[:, 2] = np.where(rng.rand(n) < 0.85, 0.0, X[:, 2])
+    X[rng.rand(n) < 0.07, 3] = np.nan
+    X[:, 4] = rng.randint(0, 5, size=n).astype(float)
+    X[:, 5] = 3.25
+    X[:, 6] = -np.abs(rng.normal(size=n)) - 0.5
+    X[:, 7] = rng.randint(0, 9, size=n).astype(float)
+    X[rng.rand(n) < 0.02, 7] = -1.0
+    X[:, 8] = rng.randint(0, 3, size=n).astype(float)
+    X[:, 9] = np.where(rng.rand(n) < 0.5, 0.0, np.abs(X[:, 9]))
+    X[rng.rand(n) < 0.04, 9] = np.nan
+    return X
+
+
+class _Seq(lgb.Sequence):
+    def __init__(self, mat, batch_size):
+        self._m = mat
+        self.batch_size = batch_size
+
+    def __getitem__(self, idx):
+        return self._m[idx]
+
+    def __len__(self):
+        return len(self._m)
+
+
+def _state(s: FeatureSketch):
+    return (s.level, s.keys.tobytes(), s.counts.tobytes(),
+            s.maxes.tobytes(), s.nan_cnt, s.total_cnt)
+
+
+# ---------------------------------------------------------------------------
+# canonical merge: permutations, shardings, the wire format
+# ---------------------------------------------------------------------------
+def test_fold_order_permutation_invariance(rng):
+    """All 120 orderings of 5 chunks fold to ONE bit-identical state
+    (and the per-chunk sketch merge agrees with the fold), deep in the
+    lossy regime (k=64 << distincts)."""
+    col = np.concatenate([rng.normal(size=3500), np.zeros(400),
+                          [np.nan] * 100])
+    rng.shuffle(col)
+    chunks = np.array_split(col, 5)
+    ref = FeatureSketch(64)
+    for c in chunks:
+        ref.update(c)
+    assert ref.level > 0           # the invariance claim must be lossy
+    per_chunk = []
+    for c in chunks:
+        s = FeatureSketch(64)
+        s.update(c)
+        per_chunk.append(s)
+    for perm in itertools.permutations(range(5)):
+        s = FeatureSketch(64)
+        for i in perm:
+            s.update(chunks[i])
+        assert _state(s) == _state(ref), perm
+        m = FeatureSketch.merge([per_chunk[i] for i in perm])
+        assert _state(m) == _state(ref), perm
+    cuts_ref = json.dumps(ref.to_mapper(63).to_dict(), sort_keys=True)
+    assert json.dumps(per_chunk[0].merge(per_chunk).to_mapper(63)
+                      .to_dict(), sort_keys=True) == cuts_ref
+
+
+def test_one_vs_four_shard_merge_bit_identity(rng):
+    """One rank sketching everything == four rank-sharded sketches
+    merged, for both contiguous row blocks and round-robin sharding —
+    the distributed allgather's correctness claim, in-process."""
+    X = _columns_matrix(rng, 3000)
+    one = SketchSet(X.shape[1], k=64)
+    for a in range(0, len(X), 777):
+        one.update_chunk(X[a:a + 777])
+    for shards in (
+            [X[a::4] for a in range(4)],                    # round-robin
+            np.array_split(X, 4)):                          # contiguous
+        sets = []
+        for sh in shards:
+            ss = SketchSet(X.shape[1], k=64)
+            ss.update_chunk(sh)
+            sets.append(ss)
+        merged = SketchSet.merge(sets)
+        for f in range(X.shape[1]):
+            assert _state(merged.sketches[f]) == _state(one.sketches[f]), f
+        assert merged.serialize() == one.serialize()
+    # the wire format round-trips bit-exactly
+    back = SketchSet.deserialize(one.serialize())
+    for f in range(X.shape[1]):
+        assert _state(back.sketches[f]) == _state(one.sketches[f]), f
+
+
+def test_merge_mixed_k_raises():
+    with pytest.raises(ValueError):
+        FeatureSketch.merge([FeatureSketch(64), FeatureSketch(128)])
+
+
+# ---------------------------------------------------------------------------
+# exact tier: bit-identity to the sort-based oracle
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("opts", [
+    {},
+    {"max_bin": 15},
+    {"zero_as_missing": True},
+    {"use_missing": False},
+    {"min_data_in_bin": 25},
+])
+def test_exact_tier_matches_oracle(rng, opts):
+    """Distincts <= k keeps level 0: the sketch mapper must equal
+    BinMapper.find_bin bit-for-bit on every column shape."""
+    X = _columns_matrix(rng, 3000)
+    for f in range(X.shape[1]):
+        col = X[:, f]
+        s = FeatureSketch(DEFAULT_K)
+        for a in range(0, len(col), 997):
+            s.update(col[a:a + 997])
+        assert s.level == 0
+        bt = BIN_CATEGORICAL if f == 7 else BIN_NUMERICAL
+        kw = dict(max_bin=255, min_data_in_bin=3, min_split_data=0,
+                  pre_filter=False, bin_type=bt, use_missing=True,
+                  zero_as_missing=False)
+        kw.update(opts)
+        nonzero = col[(np.abs(col) > 1e-35) | np.isnan(col)]
+        ref = BinMapper()
+        ref.find_bin(nonzero, total_sample_cnt=len(col), **kw)
+        got = s.to_mapper(**kw)
+        assert (json.dumps(got.to_dict(), sort_keys=True)
+                == json.dumps(ref.to_dict(), sort_keys=True)), f
+
+
+def test_categorical_overflow_refuses_to_guess(rng):
+    s = FeatureSketch(8)
+    s.update(rng.randint(1, 1000, size=2000).astype(float))
+    assert s.level > 0
+    with pytest.raises(ValueError, match="categorical"):
+        s.to_mapper(255, bin_type=BIN_CATEGORICAL)
+
+
+def test_rank_error_bound_holds(rng):
+    """Measured CDF deviation at every cell boundary stays under the
+    reported bound in the lossy regime."""
+    vals = rng.normal(size=20000)
+    s = FeatureSketch(64)
+    for a in range(0, len(vals), 3001):
+        s.update(vals[a:a + 3001])
+    bound = s.rank_error_bound()
+    assert s.level > 0 and bound > 0
+    sv = np.sort(vals)
+    worst = 0
+    for x in s.maxes:
+        true_rank = int(np.searchsorted(sv, x, side="right"))
+        worst = max(worst, abs(s.rank_upto(float(x)) - true_rank))
+    assert worst <= bound, (worst, bound)
+
+
+def test_resolve_bin_mode():
+    assert resolve_bin_mode(Config(dict(BASE)), 10_000) == "exact"
+    assert resolve_bin_mode(Config(dict(BASE)), 2_000_000) == "sketch"
+    assert resolve_bin_mode(
+        Config(dict(BASE, bin_construct_mode="sketch")), 10) == "sketch"
+    assert resolve_bin_mode(
+        Config(dict(BASE, bin_construct_mode="exact")),
+        5_000_000) == "exact"
+    assert resolve_bin_mode(
+        Config(dict(BASE, sketch_row_threshold=100)), 101) == "sketch"
+
+
+# ---------------------------------------------------------------------------
+# dataset-level parity: from_matrix / from_sequences under sketch mode
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("extra", [{}, {"zero_as_missing": True}])
+def test_from_matrix_sketch_parity(rng, extra):
+    """bin_construct_mode=sketch on a resident matrix: mappers, EFB
+    groups and the packed bin matrix all bit-match exact mode —
+    including the NaN, zero-as-missing and categorical branches."""
+    X = _columns_matrix(rng, 2500)
+    y = X[:, 0]
+    exact = BinnedDataset.from_matrix(
+        X, Config(dict(BASE, bin_construct_mode="exact", **extra)),
+        label=y, categorical_features=[7])
+    sk = BinnedDataset.from_matrix(
+        X, Config(dict(BASE, bin_construct_mode="sketch", **extra)),
+        label=y, categorical_features=[7])
+    assert _mapper_dicts(sk) == _mapper_dicts(exact)
+    assert _group_tuples(sk) == _group_tuples(exact)
+    np.testing.assert_array_equal(sk.host_binned(), exact.host_binned())
+
+
+def test_from_sequences_sketch_free_host_default(rng):
+    """Sequence construction in sketch mode: bit-parity with the exact
+    resident path, host copy freed by DEFAULT (the ingest buffer is the
+    only binned copy), sources retained for epoch re-streaming, and
+    restream_ingest() rebuilds a bit-identical buffer."""
+    X = _columns_matrix(rng, 2611)
+    y = X[:, 0]
+    one = BinnedDataset.from_matrix(
+        X, Config(dict(BASE, bin_construct_mode="exact")), label=y)
+    cuts = [0, 611, 1900, len(X)]
+    seqs = [_Seq(X[a:b], 173) for a, b in zip(cuts[:-1], cuts[1:])]
+    ds = BinnedDataset.from_sequences(
+        seqs, Config(dict(BASE, bin_construct_mode="sketch")), label=y)
+    assert _mapper_dicts(ds) == _mapper_dicts(one)
+    assert _group_tuples(ds) == _group_tuples(one)
+    if ds.device_ingest is not None:
+        # free-host default-on: no resident (N, G) host matrix survives
+        assert ds.binned is None
+        assert ds._stream_src, "sequence sources must be retained"
+        ing2 = ds.restream_ingest(1024)
+        assert ing2 is not None
+        np.testing.assert_array_equal(ing2.host_binned(),
+                                      one.host_binned())
+    np.testing.assert_array_equal(ds.host_binned(), one.host_binned())
+    # explicit free_host_binned=false wins over the mode default
+    ds2 = BinnedDataset.from_sequences(
+        seqs, Config(dict(BASE, bin_construct_mode="sketch",
+                          free_host_binned=False)), label=y)
+    assert ds2.binned is not None
+    np.testing.assert_array_equal(ds2.binned, one.host_binned())
+
+
+def test_mixed_batch_sizes_bit_parity(rng):
+    """Each sequence's OWN batch_size drives its chunking; mixing sizes
+    (including one that defaults) changes nothing bit-wise."""
+    X = _columns_matrix(rng, 2200)
+    y = X[:, 0]
+    one = BinnedDataset.from_matrix(
+        X, Config(dict(BASE, bin_construct_mode="exact")), label=y)
+    a, b = _Seq(X[:700], 37), _Seq(X[700:1500], 256)
+    c = _Seq(X[1500:], 101)
+    c.batch_size = None            # falls back to the default chunking
+    for mode in ("exact", "sketch"):
+        ds = BinnedDataset.from_sequences(
+            [a, b, c], Config(dict(BASE, bin_construct_mode=mode)),
+            label=y)
+        assert _mapper_dicts(ds) == _mapper_dicts(one), mode
+        np.testing.assert_array_equal(ds.host_binned(),
+                                      one.host_binned())
+
+
+def test_epoch_restream_training_parity(rng):
+    """The full claim: sketch + streaming + free-host-by-default must
+    train BIT-IDENTICAL trees to the exact resident-matrix path (the
+    trainer re-streams epochs from the retained sources when the host
+    matrix is gone)."""
+    X = _columns_matrix(rng, 2000)
+    y = X[:, 0] + 0.1 * rng.normal(size=len(X))
+    p = dict(BASE, objective="regression", num_leaves=15,
+             num_iterations=5, seed=3)
+    m_exact = lgb.train(dict(p, bin_construct_mode="exact"),
+                        lgb.Dataset(X, label=y))
+    seqs = [_Seq(X[:900], 211), _Seq(X[900:], 173)]
+    m_stream = lgb.train(dict(p, bin_construct_mode="sketch"),
+                         lgb.Dataset(seqs, label=y))
+    assert (_tree_part(m_stream.model_to_string())
+            == _tree_part(m_exact.model_to_string()))
+
+
+# ---------------------------------------------------------------------------
+# out-of-core proof: dense matrix exceeds the address-space cap
+# ---------------------------------------------------------------------------
+_OOCORE_SCRIPT = textwrap.dedent("""
+    import json, resource, sys
+    import numpy as np
+    import lightgbm_tpu as lgb
+
+    ROWS, F = 800_000, 32          # dense f64 = 204.8 MB
+    # warm jax + the trainer BEFORE capping: the cap must prove the
+    # out-of-core path's working set, not the runtime's startup cost
+    Xw = np.random.RandomState(0).normal(size=(512, F))
+    lgb.train({"verbosity": -1, "objective": "regression",
+               "num_iterations": 1, "num_leaves": 4},
+              lgb.Dataset(Xw, label=Xw[:, 0]))
+
+    def vm_data_kb():
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmData:"):
+                    return int(line.split()[1])
+        raise RuntimeError("no VmData")
+
+    hard = resource.getrlimit(resource.RLIMIT_DATA)[1]
+
+    # -- phase 1: CONSTRUCTION under a cap the dense matrix cannot fit.
+    # 160 MB headroom covers chunk transients + sketches + the (G,
+    # N_pad) ingest buffer (~binned-sized), but NOT the 204.8 MB dense
+    # matrix (a single allocation larger than the whole headroom, so
+    # the probe below fails no matter how the rest is laid out): if any
+    # step materialized it, construction would die here.
+    cap1 = (vm_data_kb() + 160 * 1024) * 1024
+    resource.setrlimit(resource.RLIMIT_DATA, (cap1, hard))
+
+    dense_failed = False
+    try:
+        np.ones((ROWS, F))
+    except MemoryError:
+        dense_failed = True
+
+    class Seq(lgb.Sequence):
+        batch_size = 32768
+        def __len__(self):
+            return ROWS
+        def __getitem__(self, item):
+            sl = item if isinstance(item, slice) else slice(item, item + 1)
+            start, stop, _ = sl.indices(ROWS)
+            i = np.arange(start, stop, dtype=np.int64)[:, None]
+            j = np.arange(F, dtype=np.int64)[None, :]
+            h = (i * 2654435761 + j * 40503) % 100003
+            X = h.astype(np.float64) / 100003.0 * 6.0 - 3.0
+            X[((j % 4 == 0) & (h * 7 % 10 < 9)).nonzero()] = 0.0
+            return X if isinstance(item, slice) else X[0]
+
+    y = (np.arange(ROWS, dtype=np.float64) % 97) / 97.0
+    # a modest EFB sample: the default 200k-row gather would cost
+    # 200k * F * 8B — a deliberate knob, not a hidden dense copy
+    params = {"verbosity": -1, "objective": "regression",
+              "num_iterations": 2, "num_leaves": 7,
+              "bin_construct_mode": "sketch",
+              "bin_construct_sample_cnt": 50_000}
+    d = lgb.Dataset(Seq(), label=y, params=params).construct()
+
+    # -- phase 2: TRAINING under a larger (binned-scale) cap.  The
+    # trainer's residents are ~3x the 1-byte binned footprint plus the
+    # runtime's program workspace — none of it raw-matrix-sized; the
+    # raised cap still proves training never resurrects the dense f64
+    # matrix on top of its working set.
+    cap2 = (vm_data_kb() + 640 * 1024) * 1024
+    resource.setrlimit(resource.RLIMIT_DATA, (cap2, hard))
+    m = lgb.train(params, d)
+    pred = m.predict(np.asarray(Seq()[0:256]))
+    print(json.dumps({"dense_failed": dense_failed,
+                      "trees": m.num_trees(),
+                      "pred_finite": bool(np.isfinite(pred).all())}))
+""")
+
+
+def test_capped_rss_out_of_core_construct_and_train(tmp_path):
+    """A dataset whose dense f64 matrix does NOT fit under the process
+    address-space cap still constructs (sketch pass + streaming pack)
+    and trains end to end — the PR's headline acceptance test.
+
+    Two soft RLIMIT_DATA phases: phase 1 caps construction below the
+    dense size (a np.ones dense probe must MemoryError, construction
+    must not);
+    phase 2 raises the soft cap — legal, hard limit untouched — to a
+    binned-scale budget for training, whose working set is ~3x the
+    1-byte binned footprint plus the runtime workspace, never
+    raw-matrix-sized."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "oocore_capped.py"
+    script.write_text(_OOCORE_SCRIPT)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=root)
+    out = subprocess.run([sys.executable, str(script)],
+                         capture_output=True, text=True, env=env,
+                         timeout=600)
+    assert out.returncode == 0, out.stdout + out.stderr
+    rec = json.loads([ln for ln in out.stdout.strip().splitlines()
+                      if ln.startswith("{")][-1])
+    assert rec["dense_failed"] is True, \
+        "the cap must be tight enough that the dense matrix cannot exist"
+    assert rec["trees"] == 2 and rec["pred_finite"] is True
+
+
+def test_profile_construct_oocore_smoke():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=root)
+    out = subprocess.run(
+        [sys.executable, os.path.join(root, "tools",
+                                      "profile_construct.py"),
+         "--oocore", "--smoke"],
+        capture_output=True, text=True, env=env, timeout=600)
+    assert out.returncode == 0, out.stdout + out.stderr
+    rec = json.loads([ln for ln in out.stdout.strip().splitlines()
+                      if ln.startswith("{")][-1])
+    assert rec["parity_ok"] is True
+    assert rec["rss_ok"] is True
+    assert rec["grid"], "oocore smoke grid must not be empty"
