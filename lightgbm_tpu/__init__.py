@@ -26,7 +26,8 @@ if _os.environ.get("LIGHTGBM_TPU_DISABLE_COMPILE_CACHE", "0") != "1":
     except Exception:  # older jax without these flags
         pass
 
-from .basic import Booster, Dataset, LightGBMError, Sequence
+from .basic import (Booster, Dataset, LightGBMError, Sequence,
+                    TextFileSequence)
 from .callback import (EarlyStopException, early_stopping, log_evaluation,
                        record_evaluation, reset_parameter)
 from .engine import CVBooster, cv, train
@@ -35,6 +36,7 @@ __version__ = "0.1.0"
 
 __all__ = [
     "Dataset", "Booster", "LightGBMError", "CVBooster",
+    "Sequence", "TextFileSequence",
     "train", "cv",
     "early_stopping", "log_evaluation", "record_evaluation", "reset_parameter",
     "EarlyStopException", "CheckpointCallback",

@@ -285,6 +285,69 @@ def check_train_donation() -> Dict[str, int]:
             "fused_step_missing": 0 if fused else 1}
 
 
+def check_train_residency() -> Dict[str, int]:
+    """Single-copy binned residency invariants: the fused trainer must
+    ADOPT the ingest/learner master buffer (alias, not copy), update it
+    in place every iteration, retire every other reference, and the
+    ledger must attribute the surviving carrier.  Budgets pin:
+
+      * ``binned_residents`` — live binned-footprint device buffers
+        among {physical carrier, learner ``_part0``, ingest buffer}
+        after two fused iterations (must be exactly 1);
+      * ``adopt_not_aliased`` — the init forwarded a COPY instead of
+        aliasing the donated master buffer;
+      * ``step_not_inplace`` — the donated step returned the bins in a
+        different buffer (XLA refused the aliasing);
+      * ``master_not_retired`` — learner/ingest still hold a reference
+        the donation is about to invalidate;
+      * ``carrier_unattributed`` — the ledger's ``train.state`` owner
+        does not account the carrier's bytes."""
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+    from ..obs import memory as obs_memory
+    rng = np.random.RandomState(6)
+    X = rng.normal(size=(600, 6))
+    y = X[:, 0] - X[:, 3] + 0.1 * rng.normal(size=len(X))
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.Booster({"objective": "regression", "verbosity": -1,
+                       "num_leaves": 7, "min_data_in_leaf": 5,
+                       "metric": ""}, ds)
+    g = bst._gbdt
+    lr = g.learner
+    p0 = lr._part0
+    ptr0 = p0.unsafe_buffer_pointer() if p0 is not None else None
+    bst.update()
+    if g._phys is None:
+        return {"fused_phys_missing": 1, "binned_residents": 0,
+                "adopt_not_aliased": 0, "step_not_inplace": 0,
+                "master_not_retired": 0, "carrier_unattributed": 0}
+    pb = g._phys[0]
+    adopt_not_aliased = 0 if (ptr0 is not None
+                              and pb.unsafe_buffer_pointer() == ptr0) else 1
+    ptr1 = pb.unsafe_buffer_pointer()
+    bst.update()
+    pb2 = g._phys[0]
+    step_not_inplace = 0 if pb2.unsafe_buffer_pointer() == ptr1 else 1
+    ing = getattr(lr, "_ingest", None)
+    master_not_retired = 0 if (
+        lr._part0 is None
+        and (ing is None or getattr(ing, "buffer", None) is None)) else 1
+    residents = 1                       # the carrier itself
+    for cand in (getattr(ing, "buffer", None),
+                 getattr(lr, "_part0", None)):
+        if cand is not None and not cand.is_deleted():
+            residents += 1
+    st = obs_memory.snapshot()["owners"].get("train.state", {})
+    carrier_unattributed = (
+        0 if st.get("device_unique_bytes", 0) >= int(pb2.nbytes) else 1)
+    return {"fused_phys_missing": 0, "binned_residents": residents,
+            "adopt_not_aliased": adopt_not_aliased,
+            "step_not_inplace": step_not_inplace,
+            "master_not_retired": master_not_retired,
+            "carrier_unattributed": carrier_unattributed}
+
+
 # ---------------------------------------------------------------------------
 # device TreeSHAP program structure
 # ---------------------------------------------------------------------------
@@ -532,6 +595,7 @@ CHECKS = {
     "serving.transfers": check_serving_transfers,
     "predict.layered": check_predict_layered,
     "train.donation": check_train_donation,
+    "train.residency": check_train_residency,
     "shap.kernel": check_shap_kernel,
     "continual.tick": check_continual_tick,
     "telemetry.off": check_telemetry_off,

@@ -15,14 +15,15 @@ import jax.numpy as jnp
 import numpy as np
 
 from .config import Config
-from .dataset import BinnedDataset
+from .dataset import BinnedDataset, _TextFileSequenceImpl
 from .models.boosting import GBDT, create_boosting
 from .models.objective import create_objective
 from .models.tree import Tree
 from .utils import log
 from .utils.log import LightGBMError
 
-__all__ = ["Dataset", "Booster", "LightGBMError", "Sequence"]
+__all__ = ["Dataset", "Booster", "LightGBMError", "Sequence",
+           "TextFileSequence"]
 
 
 class Sequence:
@@ -44,6 +45,15 @@ class Sequence:
     def __len__(self):
         raise NotImplementedError("Sequence subclasses must implement "
                                   "__len__")
+
+
+class TextFileSequence(_TextFileSequenceImpl, Sequence):
+    """Text/CSV file-backed :class:`Sequence`: rows are read from disk
+    in ``batch_size`` blocks during streaming construction, so the raw
+    matrix never materializes in host memory.  See
+    :class:`~lightgbm_tpu.dataset._TextFileSequenceImpl` for parsing
+    semantics (float64 fields, NA-ish -> NaN, auto header skip,
+    ``usecols`` column selection, ``read_column`` for labels)."""
 
 
 def _is_cat_dtype(dt: str) -> bool:

@@ -37,7 +37,12 @@ def _dataset_memory_arrays(ds):
     if di is not None:
         out.extend(v for v in vars(di).values()
                    if getattr(v, "nbytes", None) is not None)
-    return [a for a in out if a is not None]
+    # a donated/adopted buffer (single-copy residency) stays reachable
+    # as a deleted jax Array: it holds no memory, so skip it
+    def _alive(a):
+        deleted = getattr(a, "is_deleted", None)
+        return a is not None and not (deleted is not None and deleted())
+    return [a for a in out if _alive(a)]
 
 
 def _fill_rows_t(dst: np.ndarray, start: int, packed_cols: np.ndarray
@@ -61,6 +66,124 @@ def _construct_workers(config) -> int:
     split per-feature / per-chunk and merged in deterministic order."""
     nt = int(getattr(config, "num_threads", 0) or 0)
     return nt if nt > 0 else max(1, os.cpu_count() or 1)
+
+
+class _TextFileSequenceImpl:
+    """File-backed text/CSV row reader for streaming construction (the
+    concrete body of :class:`lightgbm_tpu.TextFileSequence`, which mixes
+    this with the :class:`~lightgbm_tpu.basic.Sequence` protocol — the
+    split avoids a dataset<->basic import cycle).
+
+    Indexes line byte-offsets in ONE pass at open (12 bytes of index per
+    row), then serves ``__getitem__`` slices by seek+read of exactly the
+    requested rows — the raw matrix never materializes in host memory,
+    so the PR-17 two-pass sketch construction streams straight off disk
+    (first slice of the ROADMAP "Arrow/text readers" remainder).
+
+    Fields parse as float64 via Python ``float`` (empty / NA-ish fields
+    -> NaN), so a file round-tripped through ``repr`` is bit-identical
+    to the in-memory matrix it came from — the chunk-boundary parity
+    test relies on that.
+    """
+
+    _NA = frozenset(("", "na", "nan", "n/a", "null", "none", "?"))
+
+    def __init__(self, path: str, delimiter: str = ",",
+                 header: Any = "auto", batch_size: int = 4096,
+                 usecols: Optional[List[int]] = None):
+        self.path = str(path)
+        self.delimiter = delimiter
+        self.batch_size = int(batch_size)
+        self.usecols = list(usecols) if usecols is not None else None
+        starts: List[int] = []
+        lens: List[int] = []
+        off = 0
+        first_line = None
+        with open(self.path, "rb") as f:
+            for line in f:
+                if line.strip():
+                    if first_line is None:
+                        first_line = line
+                    starts.append(off)
+                    lens.append(len(line))
+                off += len(line)
+        if header == "auto":
+            header = (first_line is not None
+                      and not self._parses(first_line))
+        if header and starts:
+            starts, lens = starts[1:], lens[1:]
+        self._starts = np.asarray(starts, dtype=np.int64)
+        self._lens = np.asarray(lens, dtype=np.int32)
+        if len(self._starts):
+            self.ncols = len(self._fields(self._read_block(0, 1)[0]))
+        else:
+            self.ncols = 0
+
+    # -- parsing --------------------------------------------------------
+    def _fields(self, line: bytes) -> List[str]:
+        txt = line.decode("utf-8").strip()
+        parts = (txt.split(self.delimiter) if self.delimiter != " "
+                 else txt.split())
+        if self.usecols is not None:
+            parts = [parts[c] for c in self.usecols]
+        return parts
+
+    def _parses(self, line: bytes) -> bool:
+        try:
+            self._row(line)
+            return True
+        except (ValueError, IndexError):
+            return False
+
+    def _row(self, line: bytes) -> List[float]:
+        return [float("nan") if p.strip().lower() in self._NA else float(p)
+                for p in self._fields(line)]
+
+    def _read_block(self, lo: int, hi: int) -> List[bytes]:
+        with open(self.path, "rb") as f:
+            f.seek(int(self._starts[lo]))
+            raw = f.read(int(self._starts[hi - 1] + self._lens[hi - 1]
+                             - self._starts[lo]))
+        return [ln for ln in raw.split(b"\n") if ln.strip()]
+
+    # -- Sequence protocol ---------------------------------------------
+    def __len__(self) -> int:
+        return len(self._starts)
+
+    def __getitem__(self, idx):
+        n = len(self._starts)
+        if isinstance(idx, slice):
+            lo, hi, step = idx.indices(n)
+            if step != 1:
+                raise ValueError("TextFileSequence slices must be "
+                                 "contiguous (step 1)")
+            if hi <= lo:
+                return np.empty((0, self.ncols), dtype=np.float64)
+            lines = self._read_block(lo, hi)
+            out = np.empty((len(lines), self.ncols), dtype=np.float64)
+            for i, ln in enumerate(lines):
+                out[i] = self._row(ln)
+            return out
+        if idx < 0:
+            idx += n
+        if not 0 <= idx < n:
+            raise IndexError(idx)
+        return np.asarray(self._row(self._read_block(idx, idx + 1)[0]),
+                          dtype=np.float64)
+
+    def read_column(self, col: int) -> np.ndarray:
+        """Stream one ORIGINAL-file column (e.g. a label column excluded
+        from ``usecols``) in ``batch_size`` row blocks."""
+        saved = self.usecols
+        self.usecols = [col]
+        try:
+            out = np.empty((len(self),), dtype=np.float64)
+            for lo in range(0, len(self), self.batch_size):
+                hi = min(lo + self.batch_size, len(self))
+                out[lo:hi] = self[lo:hi][:, 0]
+            return out
+        finally:
+            self.usecols = saved
 
 
 class Metadata:
@@ -218,9 +341,12 @@ class BinnedDataset:
                                                  self.max_group_bins)
             elif self.device_ingest is not None:
                 di = self.device_ingest
+                # live_buffer: recovers the pristine layout if the fused
+                # trainer adopted the buffer (single-copy residency);
+                # [:G] drops carrier sublane-pad rows
                 snap = _digest.snapshot_device(
-                    di.buffer, self.max_group_bins, transposed=True,
-                    pad_cols=di.n_pad - di.N)
+                    di.live_buffer()[:di.G], self.max_group_bins,
+                    transposed=True, pad_cols=di.n_pad - di.N)
                 counts = snap["group_counts"]
             else:
                 return None

@@ -21,7 +21,7 @@ from ..config import Config
 from ..dataset import BinnedDataset
 from ..obs import memory as obs_memory
 from ..obs import telemetry as obs
-from ..ops.predict import predict_leaf_binned
+from ..ops.predict import predict_leaf_binned, predict_leaf_binned_t
 from ..robustness import faultinject
 from ..robustness.guard import NonFiniteGuard
 from ..utils import log
@@ -262,16 +262,39 @@ def _learner_memory_arrays(lr):
 
 def _gbdt_memory_arrays(g):
     """Telemetry memory provider: training-side score/physical state
-    plus the per-tree device arrays."""
-    out = [g._scores_arr, getattr(g, "train_binned", None)]
+    plus the per-tree device arrays.  The binned residency is fully
+    visible here: the live ``_phys`` carrier or the retired
+    ``_phys_carrier`` (bins + rowid row) IS the training copy of the
+    binned matrix once the fused path adopts the master buffer."""
+    out = [g._scores_arr]
     phys = getattr(g, "_phys", None)
     if phys is not None:
         out.extend(phys)
+    carrier = getattr(g, "_phys_carrier", None)
+    if carrier is not None:
+        out.extend(carrier)
     for dt in g.device_trees:
         if dt is not None:
             out.append(dt["nodes"])
             out.append(dt["leaf_value"])
     return out
+
+
+def _unpermute_bins(part_bins, rowid_bits, N, C, Npad):
+    """Invert the partition permutation of a physical bins carrier back
+    to the pristine identity layout: column ``C + i`` of the output
+    holds original row ``i``'s bins, all pad columns are zero — exactly
+    the ingest buffer the carrier adopted.  Exact (integer gather), so
+    re-initializing from the result is bit-identical to initializing
+    from the never-donated master buffer."""
+    iota = jax.lax.iota(jnp.int32, Npad)
+    rowid = jnp.where((iota >= C) & (iota < C + N), iota - C, N)
+    old = jax.lax.bitcast_convert_type(rowid_bits, jnp.int32)
+    # pos[i] = physical column currently holding original row i
+    pos = jnp.zeros((N,), jnp.int32).at[old].set(iota, mode="drop")
+    src = jnp.take(pos, jnp.minimum(rowid, N - 1))
+    bins = jnp.take(part_bins, src, axis=1)
+    return jnp.where((rowid < N)[None, :], bins, 0).astype(part_bins.dtype)
 
 
 class GBDT:
@@ -313,8 +336,16 @@ class GBDT:
         # physical-order fused state: (part_bins, part_ghi) kept permuted
         # across consecutive fused iterations (see _setup_fused_phys)
         self._phys = None
+        # retired carrier: (part_bins, rowid_bits) kept after the scores
+        # materialize — under single-copy residency this pair IS the
+        # binned training data (the master buffer was donated into it),
+        # so it must survive every score read/write until a pristine
+        # copy is rebuilt (_ensure_part0) or training resumes
+        self._phys_carrier = None
         self._fused_phys = None
         self._init_phys_fn = None
+        self._init_phys_adopt = None
+        self._init_phys_perm = None
         self._scores_arr = None
         # model & data health (obs/health.py): the training flight
         # recorder (None when health=off) and the reference data profile
@@ -334,8 +365,12 @@ class GBDT:
     @property
     def scores(self):
         if getattr(self, "_phys", None) is not None:
-            ghi = self._phys[1]
+            pb, ghi = self._phys
             self._phys = None
+            # the bins + rowid row stay resident as the retired carrier:
+            # they are the ONLY binned copy (single-copy residency) and
+            # the next fused init / traversal / recovery reads them
+            self._phys_carrier = (pb, ghi[2])
             K = self.num_tree_per_iteration
             sb = self.sharded_builder
             if K > 1:
@@ -350,8 +385,96 @@ class GBDT:
 
     @scores.setter
     def scores(self, v):
+        if getattr(self, "_phys", None) is not None:
+            # an external write drops the physical scores but must NOT
+            # drop the bins: they may be the only binned copy left
+            pb, ghi = self._phys
+            self._phys = None
+            self._phys_carrier = (pb, ghi[2])
         self._scores_arr = v
-        self._phys = None
+
+    # ------------------------------------------------------------------
+    # Train-set leaf traversal over the live binned resident.  There is
+    # no standing row-major train matrix anymore (single-copy binned
+    # residency): leaf lookups read whichever resident is live — the
+    # fused physical carrier (bins permuted, scattered back to original
+    # order through the rowid row), the learner's pristine master
+    # buffer, or as a last resort a TRANSIENT device copy of the host
+    # matrix — and always return (N,) leaf ids in original row order.
+    def _traverse_train(self, nodes):
+        src = self._phys if self._phys is not None \
+            else self._phys_carrier
+        sb = self.sharded_builder
+        if src is not None and (sb is None or sb.nproc == 1):
+            pb, second = src
+            rowid_bits = second[2] if second.ndim == 2 else second
+            return self._traverse_phys_fn(nodes, pb, rowid_bits)
+        p0 = getattr(self.learner, "_part0", None)
+        if p0 is not None and not p0.is_deleted():
+            return self._traverse_part0_fn(nodes, p0)
+        binned = self.train_data.binned
+        if binned is None:
+            binned = self.train_data.host_binned()
+        return self._traverse_rows_fn(nodes, jnp.asarray(binned))
+
+    def _recover_pristine_part0(self):
+        """Rebuild the pristine (pb_rows, N_pad) master buffer from the
+        live physical carrier (one exact unpermute gather).  Serves the
+        ingest's recovery callback (pickle / save_binary / a second
+        booster on the same dataset) and the eager-path crossing."""
+        src = self._phys if self._phys is not None \
+            else self._phys_carrier
+        if src is None:
+            raise LightGBMError(
+                "binned master buffer was donated to the fused trainer "
+                "and no physical carrier is live to recover it from")
+        pb, second = src
+        rowid_bits = second[2] if second.ndim == 2 else second
+        return self._unpermute_fn(pb, rowid_bits)
+
+    def _adopt_master_buffer(self) -> None:
+        """Called right after the identity init forwards the learner's
+        master buffer into the physical carrier: the fused step donates
+        that buffer in place every iteration, so every OTHER reference
+        must let go now (a later read would observe donated memory).
+        The ingest keeps a recovery callback instead of the buffer."""
+        lr = self.learner
+        p0 = lr._part0
+        lr._part0 = None
+        ing = getattr(lr, "_ingest", None)
+        if ing is None:
+            return
+        if (getattr(ing, "buffer", None) is p0
+                or getattr(lr, "_part0_from_ingest", False)):
+            # the flag also covers the sublane-padded case (_pb_rows >
+            # G): part0 is then pad(buffer) — the recovered carrier's
+            # first G rows ARE the master buffer, so the ingest's own
+            # copy is redundant either way
+            ing.release_buffer(self._recover_pristine_part0)
+
+    def _ensure_part0(self) -> None:
+        """The eager tree build reads the learner's pristine master
+        buffer; if the fused carrier adopted it, rebuild it (and hand
+        the ingest its buffer back) so eager and fused iterations can
+        interleave.  Residency returns to ONE pristine copy and the
+        next fused init restarts from the identity layout — the exact
+        state a never-fused run would be in."""
+        lr = self.learner
+        if getattr(lr, "_part0", None) is not None:
+            return
+        if self._phys is None and self._phys_carrier is None:
+            return
+        _ = self.scores          # materialize pending fused scores first
+        pb = self._recover_pristine_part0()
+        self._phys_carrier = None
+        lr._part0 = pb
+        ing = getattr(lr, "_ingest", None)
+        if (ing is not None and getattr(ing, "buffer", None) is None
+                and pb.shape[1] == ing.n_pad and pb.shape[0] >= ing.G):
+            # extra sublane-pad rows beyond G are zeros; every ingest
+            # consumer slices [:G]
+            ing.buffer = pb
+            ing._recover = None
 
     # ------------------------------------------------------------------
     def _setup_training(self, train_data: BinnedDataset) -> None:
@@ -471,16 +594,30 @@ class GBDT:
                         "compatibility but is not implemented by the "
                         "reference this framework tracks; it is IGNORED")
         self._cached_bag = None
-        binned_host = train_data.binned
-        if binned_host is None or binned_host.shape[1] < self.learner.G:
-            self.train_binned = self.learner._part0[
-                :self.learner.G,
-                self.learner.row0: self.learner.row0 + self.num_data].T
-        else:
-            self.train_binned = jnp.asarray(binned_host)
+        # ---- train-set traversal programs (single-copy residency) ----
+        # each reads a different live binned resident; the dispatcher
+        # (_traverse_train) picks per call.  The phys variant traverses
+        # the PERMUTED carrier and scatters leaf ids back to original
+        # row order through the bitcast rowid row (sentinel ids >= N
+        # drop out of the scatter).
+        _G = self.learner.G
+        _C = self.learner.row0
+        _N = self.num_data
 
-        self._traverse_train = jax.jit(
+        def _tr_phys(nodes, pb, rowid_bits):
+            rowid = jax.lax.bitcast_convert_type(rowid_bits, jnp.int32)
+            leaf = predict_leaf_binned_t(pb[:_G], nodes)
+            return jnp.zeros((_N,), jnp.int32).at[rowid].set(
+                leaf, mode="drop")
+
+        self._traverse_phys_fn = jax.jit(_tr_phys)
+        self._traverse_part0_fn = jax.jit(
+            lambda nodes, p0: predict_leaf_binned_t(
+                p0[:_G, _C:_C + _N], nodes))
+        self._traverse_rows_fn = jax.jit(
             lambda nodes, binned: predict_leaf_binned(binned, nodes))
+        self._unpermute_fn = jax.jit(functools.partial(
+            _unpermute_bins, N=_N, C=_C, Npad=self.learner.N_pad))
 
         # ---- fused training step ----
         # One jitted program per boosting iteration: gradients -> tree build
@@ -650,7 +787,7 @@ class GBDT:
         payload_arrs = [jnp.asarray(getattr(obj, n), jnp.float32)
                         for n in names]
 
-        def init_phys(part_bins, scores):
+        def ghi0(scores):
             iota = jax.lax.iota(jnp.int32, Npad)
             rowid = jnp.where((iota >= C) & (iota < C + N), iota - C, N)
             rows = [jnp.zeros((Npad,), jnp.float32),
@@ -660,12 +797,29 @@ class GBDT:
             rows += [jnp.pad(a, (C, Npad - C - N)) for a in payload_arrs]
             rows += [jnp.zeros((Npad,), jnp.float32)
                      for _ in range(lr_._ghi_rows - len(rows))]
-            # the bins copy keeps the learner's master buffer alive
-            # through the step's donation
-            return part_bins + jnp.zeros((), part_bins.dtype), \
-                jnp.stack(rows)
+            return jnp.stack(rows)
+
+        def init_phys(part_bins, scores):
+            # the bins pass through UNTOUCHED; with the bins argument
+            # DONATED, XLA aliases the output onto the input buffer, so
+            # the physical carrier ADOPTS the learner's master buffer
+            # instead of copying it (single-copy residency) —
+            # _adopt_master_buffer retires every other reference right
+            # after.  The non-donating jit keeps the pre-adoption
+            # semantics for lowering-only probes (jaxlint).
+            return part_bins, ghi0(scores)
+
+        def init_phys_perm(part_bins, rowid_bits, scores):
+            # resume from a RETIRED carrier (scores were read between
+            # iterations): unpermute the bins back to the identity
+            # layout, so the rebuilt state — and every tree after it —
+            # is bit-identical to an init from the pristine buffer
+            bins = _unpermute_bins(part_bins, rowid_bits, N, C, Npad)
+            return bins, ghi0(scores)
 
         self._init_phys = jax.jit(init_phys)
+        self._init_phys_adopt = jax.jit(init_phys, donate_argnums=(0,))
+        self._init_phys_perm = jax.jit(init_phys_perm, donate_argnums=(0,))
 
         use_quant = self.use_quant
         cfg = self.config
@@ -894,7 +1048,7 @@ class GBDT:
         label_arr = jnp.asarray(obj.label, jnp.float32)
         weight_arr = obj.weight
 
-        def init_phys(part_bins, scores):
+        def ghi0(scores):
             iota = jax.lax.iota(jnp.int32, Npad)
             rowid = jnp.where((iota >= C) & (iota < C + N), iota - C, N)
             ghi = jnp.zeros((lr_._ghi_rows, Npad), jnp.float32)
@@ -907,11 +1061,22 @@ class GBDT:
             if has_w:
                 ghi = ghi.at[w_row].set(
                     jnp.pad(weight_arr, (C, Npad - C - N)))
-            # the bins copy keeps the learner's master buffer alive
-            # through the step's donation
-            return part_bins + jnp.zeros((), part_bins.dtype), ghi
+            return ghi
+
+        def init_phys(part_bins, scores):
+            # bins pass through untouched; donated in the _adopt
+            # variant so the carrier adopts the master buffer (see
+            # _setup_fused_phys / single-copy residency);
+            # _adopt_master_buffer retires the other refs
+            return part_bins, ghi0(scores)
+
+        def init_phys_perm(part_bins, rowid_bits, scores):
+            bins = _unpermute_bins(part_bins, rowid_bits, N, C, Npad)
+            return bins, ghi0(scores)
 
         self._init_phys = jax.jit(init_phys)
+        self._init_phys_adopt = jax.jit(init_phys, donate_argnums=(0,))
+        self._init_phys_perm = jax.jit(init_phys_perm, donate_argnums=(0,))
 
         use_bag = self.need_bagging and not self.balanced_bagging
         bag_key = jax.random.PRNGKey(cfg.bagging_seed)
@@ -1182,9 +1347,22 @@ class GBDT:
             if self._phys is None:
                 if self._init_phys_fn is not None:   # sharded layout
                     self._phys = tuple(self._init_phys_fn())
+                    self._phys_carrier = None
+                elif self._phys_carrier is not None:
+                    # resume from the retired carrier: the bins are
+                    # unpermuted back to the identity layout in-program,
+                    # bit-identical to an init from the master buffer
+                    pb, rowid_bits = self._phys_carrier
+                    self._phys_carrier = None
+                    self._phys = tuple(self._init_phys_perm(
+                        pb, rowid_bits, self._scores_arr))
                 else:
-                    self._phys = tuple(self._init_phys(
+                    self._phys = tuple(self._init_phys_adopt(
                         self.learner._part0, self._scores_arr))
+                    # the donating identity init aliased the master
+                    # buffer into the carrier; retire the (now stale)
+                    # learner/ingest references
+                    self._adopt_master_buffer()
             with global_timer.section("GBDT::FusedIter",
                                       sync=lambda: self._phys[1]):
                 pb, ghi, rec = self._fused_phys(
@@ -1570,7 +1748,7 @@ class GBDT:
         Returns ``rows(leaf) -> np.ndarray`` of original row ids.
         """
         nodes = self.learner.node_arrays_for_predict(record)
-        leaf_idx = np.asarray(self._traverse_train(nodes, self.train_binned))
+        leaf_idx = np.asarray(self._traverse_train(nodes))
         order = np.argsort(leaf_idx, kind="stable")
         bounds = np.searchsorted(leaf_idx[order],
                                  np.arange(num_nodes + 2))
@@ -1678,8 +1856,7 @@ class GBDT:
         recomputable at any time from the host tree, so nothing per-row needs
         to be retained for rollback (reference: Tree::AddPredictionToScore
         linear arm)."""
-        leaf_train = np.asarray(self._traverse_train(nodes,
-                                                     self.train_binned))
+        leaf_train = np.asarray(self._traverse_train(nodes))
         delta = tree._linear_output(self.train_data.raw_data, leaf_train) \
             - init_score_adjust
         out = [jnp.asarray(delta.astype(np.float32))]
@@ -1728,6 +1905,9 @@ class GBDT:
         # the eager path appends trees directly: any lagged fused records
         # must land first so model order matches training order
         self._flush_pending()
+        # eager builds read the learner's pristine master buffer; rebuild
+        # it if the fused carrier adopted it (mixed fused/eager training)
+        self._ensure_part0()
         if grad is None or hess is None:
             with global_timer.section("GBDT::Boosting (gradients)"):
                 grad, hess = self._compute_gradients()
@@ -1874,7 +2054,7 @@ class GBDT:
         return should_stop
 
     def _apply_score_update(self, nodes, delta_leaf, k: int) -> None:
-        leaf_train = self._traverse_train(nodes, self.train_binned)
+        leaf_train = self._traverse_train(nodes)
         delta = jnp.take(delta_leaf, leaf_train)
         if self.num_tree_per_iteration == 1:
             self.scores = self.scores + delta
@@ -2188,8 +2368,14 @@ class GBDT:
                     dt["leaf_value"] = jnp.asarray(slot)
         self.init_scores = [0.0] * self.num_tree_per_iteration
         # training-side state is stale from here on (see docstring);
-        # train_one_iter refuses serving-only boosters loudly
-        self._phys = None
+        # train_one_iter refuses serving-only boosters loudly.  The
+        # bins must survive as the retired carrier though — under
+        # single-copy residency they may be the dataset's only binned
+        # copy (pickle / save_binary / a second booster recover it)
+        if self._phys is not None:
+            pb, ghi = self._phys
+            self._phys = None
+            self._phys_carrier = (pb, ghi[2])
         self._serving_only = True
         self._model_version += 1
         self.serving.refit_leaf_values(
@@ -2225,7 +2411,7 @@ class GBDT:
                 delta = deltas[0]
                 valid_dvs = deltas[1:]
             else:
-                leaf_train = self._traverse_train(nodes, self.train_binned)
+                leaf_train = self._traverse_train(nodes)
                 delta = jnp.take(delta_leaf, leaf_train)
                 valid_dvs = None
             if K == 1:
@@ -2362,7 +2548,7 @@ class DART(GBDT):
         K = self.num_tree_per_iteration
         k = t_idx % K
         if train:
-            leaf_train = self._traverse_train(dt["nodes"], self.train_binned)
+            leaf_train = self._traverse_train(dt["nodes"])
             delta = jnp.take(dt["leaf_value"], leaf_train) * factor
             if K == 1:
                 self.scores = self.scores + delta

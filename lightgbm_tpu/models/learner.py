@@ -511,6 +511,11 @@ class SerialTreeLearner:
         # window writes.  Rows are never gathered by bag index:
         # bagging/GOSS zero the out-of-bag gradients instead.
         self._part0 = None
+        # True when _part0 is the ingest's master buffer (or its
+        # sublane-padded extension): the fused trainer may then ADOPT
+        # the buffer and release the ingest's reference (single-copy
+        # residency, boosting._adopt_master_buffer)
+        self._part0_from_ingest = False
         if local_num_data is None:
             ing = self._ingest
             if (ing is not None and ing.N == self.N
@@ -519,6 +524,7 @@ class SerialTreeLearner:
                 # construction already streamed the transposed layout to
                 # the device: no host transpose, no host pad copy
                 self._part0 = ing.part0(self._pb_rows)
+                self._part0_from_ingest = True
             else:
                 binned = dataset.binned
                 if binned is None and ing is not None:
@@ -537,6 +543,7 @@ class SerialTreeLearner:
                                                  self.N_pad,
                                                  host_bin_dtype)):
                             self._part0 = ing2.part0(self._pb_rows)
+                            self._part0_from_ingest = True
                             # drop the stale-geometry buffer: keeping
                             # both would hold 2x the binned footprint
                             # for the whole training run

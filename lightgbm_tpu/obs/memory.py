@@ -45,6 +45,33 @@ def _is_device_array(x) -> bool:
         return False
 
 
+def _is_deleted(x) -> bool:
+    """True for a jax Array whose buffer was donated/deleted — it holds
+    no memory, only metadata, and must not be billed to anyone."""
+    fn = getattr(x, "is_deleted", None)
+    try:
+        return bool(fn()) if fn is not None else False
+    except Exception:
+        return False
+
+
+def _buffer_key(x):
+    """Identity of the underlying device buffer(s), so one buffer shared
+    by several owners (single-copy residency: the ingest buffer, the
+    learner's ``_part0`` and the fused physical carrier can all be ONE
+    allocation) is deduplicated in ``unique`` accounting."""
+    try:
+        return ("ptr", int(x.unsafe_buffer_pointer()))
+    except Exception:
+        pass
+    try:                                # sharded: one pointer per shard
+        return ("shards", tuple(
+            int(s.data.unsafe_buffer_pointer())
+            for s in x.addressable_shards))
+    except Exception:
+        return ("id", id(x))
+
+
 def live_device_bytes() -> Optional[int]:
     """Total bytes of every live ``jax.Array`` in the process, or None
     when the runtime can't enumerate them."""
@@ -105,7 +132,11 @@ class MemoryLedger:
             items = list(self._providers.items())
         owners: Dict[str, Dict[str, int]] = {}
         dead = []
-        for key, (ref, provider) in items:
+        seen_buffers: set = set()
+        # walk owners in name order so the dedup attribution (who gets
+        # billed for a shared buffer: the FIRST owner to report it) is
+        # deterministic across snapshots
+        for key, (ref, provider) in sorted(items, key=lambda kv: kv[0][0]):
             obj = ref()
             if obj is None:
                 dead.append(key)
@@ -114,24 +145,37 @@ class MemoryLedger:
                 leaves = _leaves(provider(obj))
             except Exception:
                 continue                # a provider must never sink a report
-            dev = host = 0
+            dev = host = uniq = 0
             for leaf in leaves:
                 nb = getattr(leaf, "nbytes", None)
                 if nb is None:
                     continue
                 if _is_device_array(leaf):
+                    if _is_deleted(leaf):
+                        continue        # donated: holds no memory
                     dev += int(nb)
+                    bk = _buffer_key(leaf)
+                    if bk not in seen_buffers:
+                        seen_buffers.add(bk)
+                        uniq += int(nb)
                 else:
                     host += int(nb)
-            slot = owners.setdefault(key[0], {"device_bytes": 0,
-                                              "host_bytes": 0})
+            slot = owners.setdefault(key[0],
+                                     {"device_bytes": 0,
+                                      "device_unique_bytes": 0,
+                                      "host_bytes": 0})
             slot["device_bytes"] += dev
+            slot["device_unique_bytes"] += uniq
             slot["host_bytes"] += host
         if dead:
             with self._lock:
                 for key in dead:
                     self._providers.pop(key, None)
         return {"owners": owners,
+                # sum of device_unique_bytes: each physical buffer
+                # counted once even when several owners reference it
+                "dedup_device_bytes": sum(
+                    b["device_unique_bytes"] for b in owners.values()),
                 "live_device_bytes": live_device_bytes(),
                 "device_memory_stats": backend_memory_stats()}
 
@@ -155,6 +199,7 @@ def snapshot_to(tel) -> Dict[str, Any]:
     for owner, b in snap["owners"].items():
         tel.gauge(f"mem.{owner}.device_bytes", b["device_bytes"])
         tel.gauge(f"mem.{owner}.host_bytes", b["host_bytes"])
+    tel.gauge("mem.dedup_device_bytes", snap["dedup_device_bytes"])
     if snap["live_device_bytes"] is not None:
         tel.gauge("mem.live_device_bytes", snap["live_device_bytes"])
     stats = snap["device_memory_stats"]

@@ -323,9 +323,16 @@ _WORSE_HIGH_SUFFIXES = ("_s", "_ms", "_us", "_s_per_iter", "_seconds",
                         "_s_per_mrow")
 _WORSE_LOW_SUFFIXES = ("_per_s", "_per_sec", "speedup")
 _WORSE_LOW_NAMES = {"vs_baseline"}
+# memory metrics: peak/extra footprint is higher-worse.  Checked BEFORE
+# the "delta" report-only rule — a "peak_rss_delta_mb" is a bounded
+# footprint measurement (how much a phase grew RSS), not a signed
+# near-zero A/B difference, so it must gate.
+_WORSE_HIGH_MEM_SUFFIXES = ("_mb", "_rss", "_rss_kb", "_bytes")
 
 
 def metric_direction(name: str) -> int:
+    if name.endswith(_WORSE_HIGH_MEM_SUFFIXES):
+        return 1
     if "delta" in name:
         # signed difference metrics (ab_bench paired_delta_s) center on
         # ~0, so the relative floor vanishes and small-n MAD alone

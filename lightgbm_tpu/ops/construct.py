@@ -861,6 +861,11 @@ class DeviceIngest:
             donate_argnums=(0,))
         self._row = 0
         self._pending = None           # (device chunk, offset) in flight
+        # single-copy residency handoff: the fused trainer may ADOPT the
+        # buffer outright (donating it through its per-iteration step) and
+        # leave a recovery callback that reconstructs the original-order
+        # layout from its live permuted carrier
+        self._recover = None
 
     def _flush(self):
         if self._pending is not None:
@@ -906,18 +911,65 @@ class DeviceIngest:
         return (self.row_chunk == row_chunk and self.n_pad == n_pad
                 and self.dtype == np.dtype(dtype))
 
+    def release_buffer(self, recover) -> None:
+        """Hand the buffer to the fused trainer (single-copy residency:
+        the trainer's physical carrier becomes the ONLY binned resident
+        and is donated in place across iterations).  ``recover()`` must
+        return a fresh (G, n_pad) original-order device buffer rebuilt
+        from the carrier — it is called lazily by ``host_binned`` /
+        ``part0`` when a later consumer (pickle, save_binary, a second
+        booster) needs the pristine layout back."""
+        self.buffer = None
+        self._recover = recover
+
+    def live_buffer(self):
+        """The (G, n_pad) device buffer, reconstructing it from the
+        adopting trainer's carrier when the buffer was released.  May
+        transiently hold 2x the binned footprint (carrier + rebuilt
+        buffer) until the caller drops one of them."""
+        buf = self.buffer
+        if buf is not None and not buf.is_deleted():
+            return buf
+        if self._recover is None:
+            raise ValueError(
+                "device ingest buffer was consumed by training and no "
+                "recovery callback is installed")
+        return self._recover()
+
     def part0(self, pb_rows: int):
         """The learner-shaped buffer: padded with zero rows on device
         when the Pallas partition wants sublane-aligned extra rows."""
+        if self.buffer is None or self.buffer.is_deleted():
+            # a previous booster adopted the buffer: restore the pristine
+            # layout so this learner starts from the same state
+            self.buffer = self.live_buffer()
+            self._recover = None
         if pb_rows <= self.buffer.shape[0]:
             return self.buffer
         return self._jnp.pad(self.buffer,
                              ((0, pb_rows - self.buffer.shape[0]), (0, 0)))
 
-    def host_binned(self) -> np.ndarray:
+    def host_binned(self, block_rows: int = 262144) -> np.ndarray:
         """Materialize the row-major host binned matrix back from the
         device buffer (fallback for consumers that need host bins after
-        a host-binned-free construction)."""
+        a host-binned-free construction).
+
+        Streams in bounded row blocks: the peak HOST-side delta beyond
+        the (N, G) result is one (G, block) transfer staging buffer plus
+        its transpose — not a second full-matrix copy (the full-transfer
+        path doubled the host footprint exactly where pickling /
+        save_binary are already memory-tight)."""
         import jax
-        sl = self.buffer[:, self.row0: self.row0 + self.N]
-        return np.ascontiguousarray(np.asarray(jax.device_get(sl)).T)
+        buf = self.live_buffer()
+        # a carrier-recovered buffer may carry extra sublane-pad rows
+        # beyond G (learner _pb_rows > G): slice them off
+        out = np.empty((self.N, self.G), dtype=self.dtype)
+        for lo in range(0, self.N, block_rows):
+            hi = min(lo + block_rows, self.N)
+            sl = buf[:self.G, self.row0 + lo: self.row0 + hi]
+            # deliberate per-block transfer: batching is the hazard
+            # here — one get of the whole buffer is exactly the
+            # 2x-host-peak this path exists to avoid
+            out[lo:hi] = np.asarray(
+                jax.device_get(sl)).T    # jaxlint: ok=JL001
+        return out

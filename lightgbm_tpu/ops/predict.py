@@ -95,14 +95,25 @@ def predict_leaf_binned(binned: jnp.ndarray, node: dict,
         (children: >=0 internal node id, <0 encoded leaf ~leaf_id),
         plus scalar 'num_nodes'.
     """
-    n = binned.shape[0]
-    num_nodes = node["num_nodes"]
-    cur = jnp.zeros((n,), dtype=jnp.int32)
     # rows on the LANE axis: the per-row column read becomes a masked
     # reduction over G (a per-row take_along_axis over a few-lane axis
     # runs ~400x slower on TPU — same pathology as the partition's
     # split-column read, see PERF.md)
-    binned_t = binned.T.astype(jnp.int32)            # (G, n)
+    return predict_leaf_binned_t(binned.T, node, num_nodes_limit)
+
+
+def predict_leaf_binned_t(binned_t: jnp.ndarray, node: dict,
+                          num_nodes_limit: int | None = None) -> jnp.ndarray:
+    """``predict_leaf_binned`` over an already-transposed (G, n) matrix.
+
+    This is the layout the fused trainer keeps resident (``part_bins``
+    sans padding), so train-set traversal can read the live carrier
+    directly instead of materializing a row-major second copy.
+    """
+    n = binned_t.shape[1]
+    num_nodes = node["num_nodes"]
+    cur = jnp.zeros((n,), dtype=jnp.int32)
+    binned_t = binned_t.astype(jnp.int32)            # (G, n)
     g_iota = jax.lax.broadcasted_iota(jnp.int32, binned_t.shape, 0)
 
     # ALL per-node scalars ride ONE packed matrix so each level costs a

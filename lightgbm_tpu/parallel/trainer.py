@@ -76,15 +76,31 @@ class ShardedTreeBuilder:
             return jax.device_put(arr, sharding)
         self._put = _put
 
-        # host_binned() recovers the row-major matrix from the device
-        # ingest buffer when construct_device=on / free_host_binned
-        # dropped the host copy (the sharded builder needs its own
-        # mesh-sharded layout, not the serial learner's (G, N_pad) pad)
-        binned = dataset.host_binned()
-        if binned is None:
-            raise ValueError("dataset has no binned data (construct it first)")
-        N, G = binned.shape             # local rows when multi-process
-        sent = np.zeros((1, G), dtype=binned.dtype)
+        # The builder needs its own mesh-sharded layout, not the serial
+        # learner's (G, N_pad) pad.  With a live/recoverable device
+        # ingest the relayout runs ON DEVICE: one jitted
+        # slice-transpose-reshape from the (G, N_pad) master buffer to
+        # the per-device (local_n+1, G) blocks, placed by out_shardings
+        # — startup never round-trips the matrix through the host.
+        # Without one (host-resident dataset; or multi-process, where
+        # each rank's dataset holds only ITS row shard and
+        # make_array_from_process_local_data wants host blocks), the
+        # host path packs rank-local blocks from host_binned(), which
+        # now streams in bounded row blocks.
+        di = getattr(dataset, "device_ingest", None)
+        self._used_device_reshard = di is not None and self.nproc == 1
+        if self._used_device_reshard:
+            N, G = di.N, di.G           # geometry without materializing
+            bin_dtype = np.dtype(di.dtype)
+            binned = None
+        else:
+            binned = dataset.host_binned()
+            if binned is None:
+                raise ValueError(
+                    "dataset has no binned data (construct it first)")
+            N, G = binned.shape         # local rows when multi-process
+            bin_dtype = binned.dtype
+        sent = np.zeros((1, G), dtype=bin_dtype)
         sharding = NamedSharding(self.mesh, P(AXIS))
         if self.nproc > 1:
             from . import network
@@ -109,10 +125,19 @@ class ShardedTreeBuilder:
                             if self.mode != "feature" else N)
         if self.mode == "feature":
             self.local_n = self.N
-            host_binned = np.concatenate([binned, sent])
-            self.binned_sharded = _put(host_binned,
-                                       NamedSharding(self.mesh, P()))
+            if self._used_device_reshard:
+                self.binned_sharded = self._device_reshard(
+                    di, N, G, feature=True)
+            else:
+                host_binned = np.concatenate([binned, sent])
+                self.binned_sharded = _put(host_binned,
+                                           NamedSharding(self.mesh, P()))
             counts = [self.N] * self.local_ndev
+        elif self._used_device_reshard:
+            self.binned_sharded = self._device_reshard(
+                di, N, G, feature=False)
+            counts = [min(self.local_n, max(0, N - d * self.local_n))
+                      for d in range(self.local_ndev)]
         else:
             # blocked binned: (local_ndev * (local_n + 1), G) per process;
             # per-device sentinel row
@@ -129,6 +154,10 @@ class ShardedTreeBuilder:
             host_binned = np.concatenate(blocks, axis=0)
             self.binned_sharded = _put(host_binned, sharding)
         self.local_counts = _put(np.asarray(counts, dtype=np.int32), sharding)
+        from ..obs import memory as obs_memory
+        obs_memory.register(
+            "parallel.binned_sharded", self,
+            lambda sb: [sb.binned_sharded, sb.local_counts])
         self.learner = SerialTreeLearner(
             dataset, config, axis_name=AXIS, parallel_mode=mode,
             num_shards=self.ndev, local_num_data=self.local_n)
@@ -211,6 +240,39 @@ class ShardedTreeBuilder:
             in_specs=in_specs, out_specs=out_specs))
 
     # ------------------------------------------------------------------
+    def _device_reshard(self, di, N: int, G: int, feature: bool):
+        """On-device relayout of the ingest master buffer to the mesh
+        layout: ``(G, N_pad)`` column-major rows → per-device
+        ``(local_n+1, G)`` blocks (zero row pad + zero sentinel row),
+        bit-identical to the host blocked packing.  One jitted program;
+        ``out_shardings`` places the blocks, so the matrix never visits
+        the host and no (N, G) host copy materializes."""
+        C = di.row0
+        buf = di.live_buffer()
+        ndev, local_n = self.ndev, self.local_n
+        if feature:
+            spec = P()                    # rows replicated per device
+
+            def relay(b):
+                bt = b[:G, C:C + N].T
+                return jnp.concatenate(
+                    [bt, jnp.zeros((1, G), bt.dtype)], axis=0)
+        else:
+            spec = P(AXIS)
+            total = ndev * local_n
+
+            def relay(b):
+                bt = b[:G, C:C + N].T                      # (N, G)
+                bt = jnp.pad(bt, ((0, total - N), (0, 0)))
+                bt = bt.reshape(ndev, local_n, G)
+                bt = jnp.concatenate(
+                    [bt, jnp.zeros((ndev, 1, G), bt.dtype)], axis=1)
+                return bt.reshape(ndev * (local_n + 1), G)
+        # once-per-startup relayout: the trace is the product (shapes
+        # differ per dataset, nothing to rebind)
+        return jax.jit(relay,                    # jaxlint: ok=JL002
+                       out_shardings=NamedSharding(self.mesh, spec))(buf)
+
     def pad_rows(self, arr: np.ndarray) -> jnp.ndarray:
         """Pad a per-row array (process-local rows when multi-process) to
         the mesh row layout and shard it."""

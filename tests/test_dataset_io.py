@@ -318,3 +318,69 @@ def test_sequence_streaming_sparse_bundling_large():
               "min_data_in_leaf": 5, "bin_construct_sample_cnt": 500}
     bst = lgb.train(params, lgb.Dataset(_ChunkSeq(X), label=y), 10)
     assert np.mean((bst.predict(X) > 0.5) == y) > 0.8
+
+
+def _write_csv(path, X, header=True, na="NA"):
+    with open(path, "w") as f:
+        if header:
+            f.write(",".join(f"c{i}" for i in range(X.shape[1])) + "\n")
+        for row in X:
+            f.write(",".join(na if np.isnan(v) else repr(float(v))
+                             for v in row) + "\n")
+
+
+def test_text_file_sequence_chunk_boundary_bit_parity(tmp_path):
+    """TextFileSequence feeds the two-pass streaming construction from
+    disk; with a batch_size that does NOT divide the row count the
+    chunk-boundary path must still produce a bit-identical binned
+    matrix and bit-identical trees vs the resident from_matrix arm
+    (repr round-trip of float64 is exact)."""
+    rng = np.random.RandomState(21)
+    n = 317
+    X = rng.normal(size=(n, 7))
+    X[rng.rand(n) < 0.08, 3] = np.nan
+    X[:, 5] = rng.randint(0, 4, size=n).astype(float)
+    y = X[:, 0] + 0.1 * rng.normal(size=n)
+    p = tmp_path / "train.csv"
+    _write_csv(p, X)
+
+    seq = lgb.TextFileSequence(str(p), batch_size=50)   # 317 % 50 != 0
+    assert len(seq) == n and seq.ncols == 7
+    np.testing.assert_array_equal(np.asarray(seq[0:n]), X)
+    np.testing.assert_array_equal(np.asarray(seq[10:73]), X[10:73])
+    np.testing.assert_array_equal(np.asarray(seq[-1]), X[-1])
+    np.testing.assert_array_equal(seq.read_column(3), X[:, 3])
+
+    one = BinnedDataset.from_matrix(X, Config({"verbosity": -1}), label=y)
+    ds = BinnedDataset.from_sequences([seq], Config({"verbosity": -1}),
+                                      label=y)
+    np.testing.assert_array_equal(ds.host_binned(), one.host_binned())
+
+    params = {"verbosity": -1, "objective": "regression", "num_leaves": 15,
+              "min_data_in_leaf": 5, "num_iterations": 4, "seed": 3}
+    m_mat = lgb.train(params, lgb.Dataset(X, label=y))
+    m_txt = lgb.train(params, lgb.Dataset(seq, label=y))
+    strip = lambda s: s.partition("parameters:")[0]
+    assert strip(m_txt.model_to_string()) == strip(m_mat.model_to_string())
+
+
+def test_text_file_sequence_headerless_whitespace_usecols(tmp_path):
+    """Headerless whitespace-delimited files with NA-ish tokens and a
+    usecols projection parse to exactly the selected float64 columns."""
+    rng = np.random.RandomState(22)
+    X = rng.normal(size=(60, 5))
+    p = tmp_path / "train.txt"
+    with open(p, "w") as f:
+        for i, row in enumerate(X):
+            cells = [repr(float(v)) for v in row]
+            if i == 7:
+                cells[2] = "?"          # NA token -> NaN
+            f.write(" ".join(cells) + "\n")
+    X[7, 2] = np.nan
+    seq = lgb.TextFileSequence(str(p), delimiter=" ", header=False,
+                               usecols=[0, 2, 4], batch_size=17)
+    assert seq.ncols == 3
+    np.testing.assert_array_equal(np.asarray(seq[0:60]), X[:, [0, 2, 4]])
+    # read_column addresses ORIGINAL file columns (label-column use)
+    np.testing.assert_array_equal(seq.read_column(2), X[:, 2])
+    np.testing.assert_array_equal(seq.read_column(1), X[:, 1])

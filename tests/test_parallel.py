@@ -254,3 +254,63 @@ def test_data_scatter_ownership_512_groups():
         ln for ln in hlo.splitlines()
         if "all-reduce" in ln and f"512,32,2" in ln]
     assert not full_hist_allreduce, full_hist_allreduce[:2]
+
+
+def test_sharded_ingest_reshard_zero_host_materialization():
+    """ISSUE 18 acceptance: ShardedTreeBuilder startup on an
+    ingest-backed dataset resharding on-device must perform ZERO full
+    host materializations — host_binned() is poisoned on both the
+    dataset and the ingest — and the trees must be bit-identical to the
+    blocked host-path arm (same sharded layout, same reductions)."""
+    import lightgbm_tpu as lgb
+
+    X, y = _make_data(n=1003, f=8, seed=2)   # not divisible by 8 devices
+    cfg = Config({"num_leaves": 15, "min_data_in_leaf": 5,
+                  "verbosity": -1, "bin_construct_mode": "sketch"})
+
+    class _Seq(lgb.Sequence):
+        batch_size = 173
+
+        def __getitem__(self, idx):
+            return X[idx]
+
+        def __len__(self):
+            return len(X)
+
+    g = (0.0 - y).astype(np.float32)
+    h = np.ones(len(y), np.float32)
+
+    def _boom(*a, **k):
+        raise AssertionError(
+            "host_binned() materialized on the sharded startup path")
+
+    recs = {}
+    for mode in ("data", "voting", "feature"):
+        ds = BinnedDataset.from_sequences([_Seq()], cfg, label=y)
+        assert ds.device_ingest is not None
+        assert ds.binned is None, "sketch streaming frees the host copy"
+        ds.host_binned = _boom
+        ds.device_ingest.host_binned = _boom
+        builder = ShardedTreeBuilder(ds, cfg, mode=mode)
+        assert builder._used_device_reshard
+        recs[mode] = builder.build_tree(g, h)
+
+    # host arm: resident matrix with the ingest disabled exercises the
+    # pre-existing blocked host packing; binning is bit-identical
+    # (sketch streaming == sketch resident == exact, pinned elsewhere)
+    ds_host = BinnedDataset.from_matrix(X, cfg, label=y)
+    assert ds_host.binned is not None
+    ds_host.device_ingest = None
+    for mode in ("data", "voting", "feature"):
+        builder = ShardedTreeBuilder(ds_host, cfg, mode=mode)
+        assert not builder._used_device_reshard
+        rec_h = builder.build_tree(g, h)
+        rec_d = recs[mode]
+        s = int(rec_h["s"])
+        assert int(rec_d["s"]) == s, mode
+        for key in ("node_feature", "node_threshold", "node_left",
+                    "node_right", "leaf_value"):
+            np.testing.assert_array_equal(
+                np.asarray(rec_d[key][:s + 1]),
+                np.asarray(rec_h[key][:s + 1]),
+                err_msg=f"{mode}:{key}")

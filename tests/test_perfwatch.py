@@ -410,3 +410,21 @@ def test_committed_seed_trajectory_is_valid_and_covers_producers():
     text = regress.render_report(entries)
     assert "bench/" in text
     assert not regress.regressions(regress.evaluate(entries))
+
+
+def test_metric_direction_memory_suffixes_gate_higher_worse():
+    """Memory metrics (footprint in MB / RSS / bytes) are higher-worse
+    and must gate even when their name contains "delta" — a
+    "train_rss_delta_mb" is a bounded footprint measurement, not a
+    signed near-zero A/B difference (those keep direction 0)."""
+    from lightgbm_tpu.obs.regress import metric_direction
+    assert metric_direction("train_rss_delta_mb") == 1
+    assert metric_direction("rss_delta_mb") == 1
+    assert metric_direction("peak_rss_kb") == 1
+    assert metric_direction("vm_rss") == 1
+    assert metric_direction("dedup_device_bytes") == 1
+    # unchanged pre-existing behaviors
+    assert metric_direction("paired_delta_s") == 0      # signed A/B diff
+    assert metric_direction("train_s") == 1
+    assert metric_direction("rows_per_s") == -1
+    assert metric_direction("binned_residents") == 0    # unknown name

@@ -266,6 +266,122 @@ def main_oocore(args) -> int:
     return 0 if (parity_ok and rss_ok) else 1
 
 
+def run_trainmem_cell(rows: int, features: int, iters: int):
+    """One training-memory cell: stream-construct (host binned freed),
+    then train ``iters`` fused iterations and track
+
+    * peak-RSS delta beyond the post-construct baseline — under
+      single-copy residency the trainer ADOPTS the ingest buffer, so
+      the binned data adds ZERO new bytes; the budget covers the ghi
+      working rows, tree/score state and the XLA compile arena;
+    * binned residency — exactly ONE live binned-footprint device
+      buffer (the adopted physical carrier) after training;
+    * the HBM ledger's dedup accounting of that carrier."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.obs import memory as obs_memory
+
+    # the Dataset wrapper dispatches on isinstance(Sequence): a plain
+    # duck-typed _SynthSeq would be np.asarray'd into the dense matrix
+    class _Seq(_SynthSeq, lgb.Sequence):
+        pass
+    seq = _Seq(rows, features)
+    lab = _synth_label(rows)
+    params = {"verbosity": -1, "bin_construct_mode": "sketch",
+              "objective": "regression", "num_leaves": 31, "metric": ""}
+    dset = lgb.Dataset(seq, label=lab, params=params)
+    dset.construct(params)
+    inner = dset._inner
+    nbytes = inner._bin_dtype()().nbytes
+    G = len(inner.groups)
+    binned_mb = rows * G * nbytes / 1e6
+    rss0 = _rss_kb()
+    t0 = time.time()
+    bst = lgb.Booster(params, dset)
+    for _ in range(iters):
+        bst.update()
+    train_s = time.time() - t0
+    rss_delta_mb = max(_rss_kb() - rss0, 0) / 1024.0
+    g = bst._gbdt
+    lr = g.learner
+    phys = g._phys if g._phys is not None else g._phys_carrier
+    ghi_mb = (g._phys[1].nbytes / 1e6 if g._phys is not None else
+              32.0 * rows / 1e6)
+    residents = 1 if phys is not None else 0
+    ing = getattr(lr, "_ingest", None)
+    for cand in (getattr(ing, "buffer", None),
+                 getattr(lr, "_part0", None)):
+        if cand is not None and not cand.is_deleted():
+            residents += 1
+    snap = obs_memory.snapshot()
+    train_state = snap["owners"].get("train.state", {})
+    ledger_ok = (phys is None or
+                 train_state.get("device_unique_bytes", 0)
+                 >= int(phys[0].nbytes))
+    # budget: ghi + scores/trees + jitted fused program's arena.  The
+    # binned term is 0.25x SLACK, not a copy allowance — the pre-change
+    # 3x layout held 2 extra binned copies and fails this budget at any
+    # size where binned dominates the fixed terms
+    budget_mb = 0.25 * binned_mb + 2.0 * ghi_mb + 640.0
+    rss_ok = rss_delta_mb <= budget_mb
+    cell = {
+        "rows": rows, "features": features, "iters": iters,
+        "train_s": round(train_s, 3),
+        "iters_per_s": round(iters / train_s, 2) if train_s > 0 else None,
+        "binned_mb": round(binned_mb, 1),
+        "ghi_mb": round(ghi_mb, 1),
+        "train_rss_delta_mb": round(rss_delta_mb, 1),
+        "budget_mb": round(budget_mb, 1),
+        "binned_residents": residents,
+        "host_binned_freed": inner.binned is None,
+        "ledger_ok": bool(ledger_ok),
+        "rss_ok": bool(rss_ok),
+    }
+    return cell, bool(rss_ok and ledger_ok and residents == 1)
+
+
+def main_trainmem(args) -> int:
+    import jax
+
+    from lightgbm_tpu.obs import benchio
+    if args.rows or args.features:
+        rows = [int(r) for r in (args.rows or "800000").split(",")]
+        feats = [int(f) for f in (args.features or "32").split(",")]
+        grid = [(r, f) for r in rows for f in feats]
+    elif args.smoke:
+        grid = [(120_000, 12)]
+    else:
+        grid = [(800_000, 32)]
+    iters = 3 if args.smoke else 8
+    big_rows, big_feats = max(grid)
+    cfg = {"rows": big_rows, "features": big_feats, "cells": len(grid),
+           "iters": iters, "smoke": bool(args.smoke), "trainmem": True}
+    with benchio.abort_guard("profile_construct_trainmem", cfg) as guard:
+        cells = []
+        ok = True
+        for rows, features in grid:
+            cell, cell_ok = run_trainmem_cell(rows, features, iters)
+            ok = ok and cell_ok
+            cells.append(cell)
+            print(f"# {rows}x{features}x{iters}it: train {cell['train_s']}s"
+                  f" rss +{cell['train_rss_delta_mb']}MB (budget "
+                  f"{cell['budget_mb']}MB, binned {cell['binned_mb']}MB) "
+                  f"residents={cell['binned_residents']} "
+                  f"ledger_ok={cell['ledger_ok']}", file=sys.stderr)
+        big = [c for c in cells
+               if (c["rows"], c["features"]) == (big_rows, big_feats)][0]
+        rec = {"grid": cells, "ok": bool(ok),
+               "backend": jax.default_backend(), "smoke": bool(args.smoke),
+               "trainmem": True}
+        guard.write(rec,
+                    metrics={"train_s": big["train_s"],
+                             "train_rss_delta_mb": big["train_rss_delta_mb"],
+                             "binned_mb": big["binned_mb"],
+                             "binned_residents": big["binned_residents"]},
+                    rows=big_rows, features=big_feats)
+    print(json.dumps(rec))
+    return 0 if ok else 1
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--smoke", action="store_true",
@@ -274,6 +390,10 @@ def main(argv=None):
                     help="out-of-core lane: sketch + streaming "
                          "construction from synthetic sequences with "
                          "peak-RSS tracking and sketch-vs-exact parity")
+    ap.add_argument("--trainmem", action="store_true",
+                    help="training-memory lane: stream-construct, train "
+                         "N fused iterations, gate peak RSS delta and "
+                         "single-copy binned residency")
     ap.add_argument("--rows", type=str, default="",
                     help="comma-separated row counts (overrides grid)")
     ap.add_argument("--features", type=str, default="",
@@ -281,6 +401,8 @@ def main(argv=None):
     args = ap.parse_args(argv)
     if args.oocore:
         return main_oocore(args)
+    if args.trainmem:
+        return main_trainmem(args)
 
     if args.rows or args.features:
         rows = [int(r) for r in (args.rows or "100000").split(",")]
