@@ -1,8 +1,8 @@
 """jaxlint: static analysis + compile-artifact guards for the TPU
 training/serving stack.
 
-Two tiers (driven by ``tools/jaxlint.py`` and tier-1's
-``tests/test_jaxlint.py``):
+Three tiers (driven by ``tools/jaxlint.py`` and tier-1's
+``tests/test_jaxlint.py`` / ``tests/test_conlint.py``):
 
 * **Tier A** (:mod:`.astlint`) — AST lint with JAX-specific rules
   JL001–JL005 (host syncs in hot paths, retrace hazards, f64 leaks,
@@ -11,6 +11,13 @@ Two tiers (driven by ``tools/jaxlint.py`` and tier-1's
   points lowered to jaxpr/HLO with structural invariants asserted as
   budgets: while-body copy counts, serving transfer/compile counts,
   fused-step buffer donation, SHAP kernel structure.
+* **Tier C** (:mod:`.conlint`, :mod:`.schedule`) — concurrency
+  discipline for the threaded planes: lock-field inference + rules
+  CL001–CL004 (unguarded shared writes, lock-order inversions,
+  blocking calls under a lock, predicate-free condition waits), plus a
+  seeded deterministic schedule explorer that replays the serving
+  plane under permuted interleavings at the yield points the static
+  pass discovered.
 
 Findings and budgets ratchet against the committed
 ``jaxlint_baseline.json`` (:mod:`.baseline`): pre-existing debt is
@@ -18,6 +25,6 @@ pinned, new debt fails tier-1, and paying debt down requires shrinking
 the baseline.
 """
 
-from . import astlint, baseline  # noqa: F401
+from . import astlint, baseline, conlint  # noqa: F401
 from .astlint import Finding, RULES, finding_counts, lint_source, lint_tree  # noqa: F401
-from .baseline import Problem, compare_tier_a, compare_tier_b  # noqa: F401
+from .baseline import Problem, compare_tier_a, compare_tier_b, compare_tier_c  # noqa: F401

@@ -10,6 +10,12 @@ Tier B budgets are CEILINGS: measured values may sit below them (the
 HLO counts need headroom for toolchain drift — see
 tests/test_hlo_guard.py's ~50% margins), but never above.  Boolean
 invariants are encoded as 0/1 metrics with budget 0.
+
+Tier C (concurrency discipline, :mod:`.conlint`) pins exactly like
+tier A: same key shape (``RULE:path:qualname``), same new/stale
+semantics, its own ``tier_c`` table so the goal state — an EMPTY
+table, every surviving site pragma-documented in code — is visible at
+a glance.
 """
 
 from __future__ import annotations
@@ -42,7 +48,7 @@ class Problem:
 
 def load(path: str) -> Dict[str, Any]:
     if not os.path.exists(path):
-        return {"version": 1, "tier_a": {}, "tier_b": {}}
+        return {"version": 1, "tier_a": {}, "tier_b": {}, "tier_c": {}}
     with open(path, encoding="utf-8") as fh:
         return json.load(fh)
 
@@ -55,7 +61,8 @@ def save(path: str, data: Dict[str, Any]) -> None:
 
 def make(tier_a_counts: Dict[str, int],
          tier_b: Dict[str, Dict[str, int]],
-         headroom: Dict[str, Dict[str, int]] = None) -> Dict[str, Any]:
+         headroom: Dict[str, Dict[str, int]] = None,
+         tier_c_counts: Dict[str, int] = None) -> Dict[str, Any]:
     """Build a baseline document from measured values.  ``headroom``
     maps check -> {metric: extra budget} for tier B ceilings that need
     drift margin (never applied to invariant metrics pinned at 0)."""
@@ -66,12 +73,24 @@ def make(tier_a_counts: Dict[str, int],
             extra = (headroom or {}).get(check, {}).get(metric, 0)
             tb[check][metric] = value + (extra if value else 0)
     return {"version": 1, "tier_a": dict(sorted(tier_a_counts.items())),
-            "tier_b": tb}
+            "tier_b": tb,
+            "tier_c": dict(sorted((tier_c_counts or {}).items()))}
 
 
 def compare_tier_a(measured: Dict[str, int],
                    baseline: Dict[str, Any]) -> List[Problem]:
-    pinned: Dict[str, int] = baseline.get("tier_a", {})
+    return _compare_pins(measured, baseline.get("tier_a", {}))
+
+
+def compare_tier_c(measured: Dict[str, int],
+                   baseline: Dict[str, Any]) -> List[Problem]:
+    """Tier C ratchets exactly like tier A — exact pins, new AND stale
+    both fail — against the ``tier_c`` table."""
+    return _compare_pins(measured, baseline.get("tier_c", {}))
+
+
+def _compare_pins(measured: Dict[str, int],
+                  pinned: Dict[str, int]) -> List[Problem]:
     problems: List[Problem] = []
     for key in sorted(set(measured) | set(pinned)):
         m = measured.get(key, 0)

@@ -1,0 +1,825 @@
+"""Tier C of jaxlint: concurrency-discipline lint for the threaded
+planes (``serving/``, ``continual/``, ``obs/``, ``robustness/``,
+``native/``).
+
+Tiers A/B guard the JAX hot paths and the compiled HLO; tier C guards
+the *lock discipline* those paths run under.  It is pure-stdlib AST
+analysis (importable without jax, like :mod:`.astlint`) in two passes:
+
+pass 1 — per module, infer each class's lock fields
+  (``self.x = threading.Lock()/RLock()/Condition()``, with
+  ``Condition(self._lock)`` aliased to its base lock, plus
+  module-level ``NAME = threading.Lock()`` globals) and record, per
+  method, every lexical ``with <lock>:`` acquisition, every write /
+  aggregate-read of a ``self.*`` field together with the lock set
+  lexically held at that point, every intra-class and
+  ``self.attr.method()`` call site, every ``cv.wait()`` and every
+  potentially-blocking call.
+
+pass 2 — resolve held-lock *inheritance* for private methods (a
+  ``_method`` whose every intra-class call site holds lock L is
+  analyzed as holding L — this is how ``# lock held by the caller``
+  conventions like ``Telemetry._event`` stay pragma-free), then emit:
+
+* **CL001** unguarded shared write/publish: a field written under a
+  lock somewhere (its *owner* = the most common lock across its write
+  sites) but written — or published via an aggregate read such as
+  ``dict(self.f)`` / ``sorted(self.f.items())`` / iteration — without
+  that owner held.  Single-key subscript/attribute/membership reads
+  are deliberately NOT flagged: one ``dict.__getitem__`` is atomic
+  under the GIL and pinning those would bury real findings in noise.
+  ``__init__`` bodies are skipped (no concurrent peer exists yet) but
+  nested ``def``/``lambda`` closures defined there ARE analyzed: they
+  run later, on whatever thread fires them.
+* **CL002** lock-order inversion: global acquired-while-holding
+  digraph — edges from lexical nesting, from inherited held sets, and
+  from cross-class calls (``self.registry.publish()`` under the
+  service lock adds service-lock → every lock ``publish`` acquires;
+  attribute types come from ``self.x = ClassName(...)`` and annotated
+  ``__init__`` params) — then fails on every edge of every cycle.
+  Re-acquiring an RLock/Condition you already hold is reentrant and
+  skipped; a plain ``Lock`` self-edge is a guaranteed deadlock and
+  flagged.
+* **CL003** blocking call under a lexically-held lock: device
+  dispatch (``.predict``, ``.block_until_ready``, dotted
+  ``jax.``/``jnp.``/``lax.`` calls), ``time.sleep``, thread ``join``,
+  ``subprocess.*``, ``open``/``shutil.rmtree``/``urlopen``/socket
+  verbs — the pump's latency/deadlock trap.
+* **CL004** ``cv.wait()`` on a Condition field with no enclosing
+  ``while``: a wait whose predicate isn't re-checked swallows spurious
+  wakeups and missed-notify races.
+
+Findings key as ``RULE:path:qualname`` and ratchet against the
+``tier_c`` table of ``jaxlint_baseline.json`` exactly like tier A
+(new findings AND stale pins both fail).  Suppress a single line with
+``# conlint: ok=CL001`` (comma list; bare ``ok`` silences every rule)
+— every pragma must state the invariant that makes the site safe.
+
+The dynamic half lives in :mod:`.schedule`: CL001 finding lines become
+the extra yield points its cooperative scheduler interleaves at.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+__all__ = ["Finding", "RULES", "SCOPE", "lint_source", "lint_tree",
+           "iter_scope_files", "finding_counts"]
+
+RULES = {
+    "CL001": "field guarded elsewhere is written/published without its owning lock",
+    "CL002": "lock-order inversion (acquired-while-holding cycle)",
+    "CL003": "blocking call inside a lexically-held lock",
+    "CL004": "condition wait() without an enclosing predicate while-loop",
+}
+
+#: analysis scope, relative to the package root
+SCOPE = ("serving/", "continual/", "obs/", "robustness/", "native/")
+
+_PRAGMA_RE = re.compile(r"#\s*conlint:\s*(?:ok|disable)"
+                        r"(?:\s*=\s*([A-Z0-9,\s]+))?")
+
+_LOCK_CTORS = {"threading.Lock": "lock", "Lock": "lock",
+               "threading.RLock": "rlock", "RLock": "rlock",
+               "threading.Condition": "condition", "Condition": "condition"}
+
+#: container methods that mutate their receiver in place
+_MUTATORS = {"append", "appendleft", "add", "update", "pop", "popitem",
+             "popleft", "clear", "remove", "discard", "extend", "insert",
+             "setdefault", "move_to_end", "rotate"}
+
+#: builtins that *publish* a whole container (multi-element read)
+_AGG_CALLS = {"dict", "list", "sorted", "tuple", "set", "frozenset",
+              "sum", "max", "min"}
+_VIEW_METHODS = {"items", "values", "keys", "copy", "most_common"}
+
+_BLOCKING_EXACT = {"time.sleep", "sleep", "open",
+                   "subprocess.run", "subprocess.check_call",
+                   "subprocess.check_output", "subprocess.Popen",
+                   "shutil.rmtree", "os.replace", "urllib.request.urlopen"}
+_BLOCKING_ATTRS = {"block_until_ready", "predict", "recv", "send",
+                   "sendall", "accept", "connect", "urlopen"}
+_BLOCKING_PREFIXES = ("jax.", "jnp.", "lax.")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str                   # package-relative, e.g. lightgbm_tpu/serving/service.py
+    line: int
+    col: int
+    func: str                   # qualname, e.g. ServingService.stats
+    message: str
+
+    @property
+    def key(self) -> str:
+        return f"{self.rule}:{self.path}:{self.func}"
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+                f"{self.message} [{self.func}]")
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "tier": "C", "rule": self.rule, "title": RULES[self.rule],
+            "path": self.path, "line": self.line, "col": self.col,
+            "func": self.func, "message": self.message, "key": self.key,
+        }, sort_keys=True)
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _pragmas(source: str) -> Dict[int, Optional[Set[str]]]:
+    """lineno -> suppressed rule set (None = all rules)."""
+    out: Dict[int, Optional[Set[str]]] = {}
+    for i, ln in enumerate(source.splitlines(), start=1):
+        m = _PRAGMA_RE.search(ln)
+        if not m:
+            continue
+        if m.group(1):
+            out[i] = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        else:
+            out[i] = None
+    return out
+
+
+def _self_field(node: ast.AST) -> Optional[str]:
+    """``self.f`` / ``self.f[...]`` / ``self.f.attr`` -> ``f``."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    chain: List[str] = []
+    while isinstance(node, ast.Attribute):
+        chain.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name) and node.id == "self" and chain:
+        return chain[-1]            # first attribute after ``self``
+    return None
+
+
+# ---------------------------------------------------------------------------
+# pass 1: per-module collection
+
+@dataclass
+class _Access:
+    field: str
+    kind: str                   # "write" | "readagg"
+    held: Tuple[str, ...]       # lexical held set (normalized lock names)
+    line: int
+    col: int
+    init: bool                  # event sits directly in __init__'s body
+
+
+@dataclass
+class _Acquire:
+    lock: str                   # normalized node name (Class.attr or mod:NAME)
+    lockkind: str               # lock | rlock | condition
+    held: Tuple[str, ...]
+    line: int
+    col: int
+
+
+@dataclass
+class _Call:
+    target: str                 # method name (intra-class) or "attr.method"
+    attr: Optional[str]         # self attr for cross-class calls, else None
+    held: Tuple[str, ...]
+    line: int
+
+
+@dataclass
+class _MethodInfo:
+    qualname: str
+    name: str
+    is_init_body: bool
+    accesses: List[_Access] = field(default_factory=list)
+    acquires: List[_Acquire] = field(default_factory=list)
+    calls: List[_Call] = field(default_factory=list)
+    waits: List[Tuple[str, int, int, bool]] = field(default_factory=list)
+    blocking: List[Tuple[str, str, int, int]] = field(default_factory=list)
+    inherited: Tuple[str, ...] = ()
+
+
+@dataclass
+class _ClassInfo:
+    name: str
+    path: str
+    locks: Dict[str, str] = field(default_factory=dict)      # attr -> kind
+    cond_base: Dict[str, str] = field(default_factory=dict)  # cond attr -> lock attr
+    attr_types: Dict[str, str] = field(default_factory=dict)  # attr -> ClassName
+    methods: Dict[str, _MethodInfo] = field(default_factory=dict)
+
+
+@dataclass
+class _ModuleInfo:
+    path: str
+    pragmas: Dict[int, Optional[Set[str]]]
+    module_locks: Dict[str, str] = field(default_factory=dict)  # NAME -> kind
+    classes: Dict[str, _ClassInfo] = field(default_factory=dict)
+    functions: Dict[str, _MethodInfo] = field(default_factory=dict)
+
+
+def _lock_ctor_kind(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Call):
+        d = _dotted(node.func)
+        if d in _LOCK_CTORS:
+            return _LOCK_CTORS[d]
+    return None
+
+
+class _FuncScan:
+    """Walks one function/method body tracking the lexical held-lock
+    set, ``while`` depth, and collecting events into a _MethodInfo.
+    Nested defs/lambdas restart with an empty held set (they run
+    later, on an unknown thread)."""
+
+    def __init__(self, cls: Optional[_ClassInfo], mod: _ModuleInfo,
+                 info: _MethodInfo, sink: List[_MethodInfo]):
+        self.cls = cls
+        self.mod = mod
+        self.info = info
+        self.sink = sink
+
+    # -- lock identity -----------------------------------------------------
+    def _lock_of(self, expr: ast.AST) -> Optional[Tuple[str, str]]:
+        """(normalized name, kind) when ``expr`` is a known lock."""
+        f = _self_field(expr) if not isinstance(expr, ast.Subscript) else None
+        if f is not None and self.cls is not None and f in self.cls.locks:
+            kind = self.cls.locks[f]
+            base = self.cls.cond_base.get(f, f)
+            return f"{self.cls.name}.{base}", kind
+        if isinstance(expr, ast.Name) and expr.id in self.mod.module_locks:
+            return (f"{self.mod.path}:{expr.id}",
+                    self.mod.module_locks[expr.id])
+        return None
+
+    # -- recursive statement walk ------------------------------------------
+    def scan(self, body: Sequence[ast.stmt], held: Tuple[str, ...],
+             while_depth: int) -> None:
+        for st in body:
+            self._stmt(st, held, while_depth)
+
+    def _stmt(self, st: ast.stmt, held: Tuple[str, ...], wd: int) -> None:
+        if isinstance(st, ast.With):
+            add: List[str] = []
+            for item in st.items:
+                lk = self._lock_of(item.context_expr)
+                if lk is not None:
+                    name, kind = lk
+                    self.info.acquires.append(
+                        _Acquire(name, kind, held, item.context_expr.lineno,
+                                 item.context_expr.col_offset))
+                    if name not in held:
+                        add.append(name)
+                else:
+                    self._expr(item.context_expr, held, wd)
+            self.scan(st.body, held + tuple(add), wd)
+            return
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._nested(st.name, st.body, st.lineno)
+            return
+        if isinstance(st, ast.While):
+            self._expr(st.test, held, wd)
+            self.scan(st.body, held, wd + 1)
+            self.scan(st.orelse, held, wd)
+            return
+        if isinstance(st, ast.For):
+            self._read_target(st.iter, held, st)
+            self._expr(st.iter, held, wd)
+            self.scan(st.body, held, wd)
+            self.scan(st.orelse, held, wd)
+            return
+        if isinstance(st, (ast.If,)):
+            self._expr(st.test, held, wd)
+            self.scan(st.body, held, wd)
+            self.scan(st.orelse, held, wd)
+            return
+        if isinstance(st, ast.Try):
+            self.scan(st.body, held, wd)
+            for h in st.handlers:
+                self.scan(h.body, held, wd)
+            self.scan(st.orelse, held, wd)
+            self.scan(st.finalbody, held, wd)
+            return
+        if isinstance(st, ast.Assign):
+            for tgt in st.targets:
+                self._write_target(tgt, held, st)
+            self._expr(st.value, held, wd)
+            return
+        if isinstance(st, ast.AugAssign):
+            self._write_target(st.target, held, st)
+            self._expr(st.value, held, wd)
+            return
+        if isinstance(st, ast.AnnAssign):
+            if st.value is not None:
+                self._write_target(st.target, held, st)
+                self._expr(st.value, held, wd)
+            return
+        if isinstance(st, ast.Delete):
+            for tgt in st.targets:
+                self._write_target(tgt, held, st)
+            return
+        if isinstance(st, (ast.Expr, ast.Return)):
+            val = st.value
+            if val is not None:
+                self._expr(val, held, wd)
+            return
+        # generic: walk child statements/expressions conservatively
+        for child in ast.iter_child_nodes(st):
+            if isinstance(child, ast.stmt):
+                self._stmt(child, held, wd)
+            elif isinstance(child, ast.expr):
+                self._expr(child, held, wd)
+
+    def _nested(self, name: str, body: Sequence[ast.stmt],
+                lineno: int) -> None:
+        sub = _MethodInfo(qualname=f"{self.info.qualname}.{name}",
+                          name=name, is_init_body=False)
+        self.sink.append(sub)
+        _FuncScan(self.cls, self.mod, sub, self.sink).scan(body, (), 0)
+
+    # -- events ------------------------------------------------------------
+    def _record(self, fieldname: str, kind: str, held: Tuple[str, ...],
+                node: ast.AST) -> None:
+        self.info.accesses.append(
+            _Access(fieldname, kind, held, node.lineno, node.col_offset,
+                    self.info.is_init_body))
+
+    def _write_target(self, tgt: ast.AST, held: Tuple[str, ...],
+                      at: ast.stmt) -> None:
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                self._write_target(el, held, at)
+            return
+        f = _self_field(tgt)
+        if f is not None and (self.cls is None or f not in self.cls.locks):
+            self._record(f, "write", held, tgt)
+
+    def _read_target(self, it: ast.AST, held: Tuple[str, ...],
+                     at: ast.stmt) -> None:
+        f = self._container_of(it)
+        if f is not None:
+            self._record(f, "readagg", held, it)
+
+    def _container_of(self, node: ast.AST) -> Optional[str]:
+        """``self.f`` or ``self.f.items()/values()/keys()/copy()``."""
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _VIEW_METHODS:
+            node = node.func.value
+        f = _self_field(node)
+        if f is not None and (self.cls is None or f not in self.cls.locks):
+            return f
+        return None
+
+    def _expr(self, e: ast.expr, held: Tuple[str, ...], wd: int) -> None:
+        if isinstance(e, ast.Lambda):
+            self._nested("<lambda>", [ast.Expr(value=e.body)], e.lineno)
+            return
+        if isinstance(e, (ast.ListComp, ast.SetComp, ast.DictComp,
+                          ast.GeneratorExp)):
+            for gen in e.generators:
+                self._read_target(gen.iter, held, None)  # type: ignore[arg-type]
+                self._expr(gen.iter, held, wd)
+                for cond in gen.ifs:
+                    self._expr(cond, held, wd)
+            if isinstance(e, ast.DictComp):
+                self._expr(e.key, held, wd)
+                self._expr(e.value, held, wd)
+            else:
+                self._expr(e.elt, held, wd)
+            return
+        if isinstance(e, ast.Call):
+            self._call(e, held, wd)
+            for a in e.args:
+                self._expr(a, held, wd)
+            for kw in e.keywords:
+                self._expr(kw.value, held, wd)
+            if not isinstance(e.func, (ast.Name, ast.Attribute)):
+                self._expr(e.func, held, wd)
+            return
+        for child in ast.iter_child_nodes(e):
+            if isinstance(child, ast.expr):
+                self._expr(child, held, wd)
+
+    def _call(self, e: ast.Call, held: Tuple[str, ...], wd: int) -> None:
+        d = _dotted(e.func)
+        fn = e.func
+        # aggregate publish: dict(self.f) / sorted(self.f.items()) ...
+        if isinstance(fn, ast.Name) and fn.id in _AGG_CALLS and e.args:
+            f = self._container_of(e.args[0])
+            if f is not None:
+                self._record(f, "readagg", held, e)
+        # mutator write: self.f.append(x) ...
+        if isinstance(fn, ast.Attribute) and fn.attr in _MUTATORS:
+            f = _self_field(fn.value)
+            if f is not None and (self.cls is None
+                                  or f not in self.cls.locks):
+                self._record(f, "write", held, e)
+        # condition wait
+        if isinstance(fn, ast.Attribute) and fn.attr == "wait":
+            f = _self_field(fn.value)
+            if (f is not None and self.cls is not None
+                    and self.cls.locks.get(f) == "condition"):
+                self.info.waits.append((f, e.lineno, e.col_offset, wd > 0))
+        # blocking calls (lexically under a lock only)
+        if held:
+            self._blocking(e, d, held)
+        # call-graph edges
+        if isinstance(fn, ast.Attribute):
+            recv = fn.value
+            if isinstance(recv, ast.Name) and recv.id == "self":
+                self.info.calls.append(_Call(fn.attr, None, held, e.lineno))
+            else:
+                f = _self_field(recv)
+                if f is not None and not isinstance(recv, ast.Subscript):
+                    self.info.calls.append(
+                        _Call(f"{f}.{fn.attr}", f, held, e.lineno))
+
+    def _blocking(self, e: ast.Call, d: Optional[str],
+                  held: Tuple[str, ...]) -> None:
+        what: Optional[str] = None
+        if d is not None and d in _BLOCKING_EXACT:
+            what = d
+        elif d is not None and d.startswith(_BLOCKING_PREFIXES):
+            what = d
+        elif isinstance(e.func, ast.Attribute):
+            attr = e.func.attr
+            if attr in _BLOCKING_ATTRS:
+                what = f".{attr}()"
+            elif attr == "join" and not e.args:
+                # str.join always takes a positional iterable; a bare
+                # join() / join(timeout=...) is a thread join
+                what = ".join()"
+        if what is not None:
+            self.info.blocking.append((what, ",".join(held),
+                                       e.lineno, e.col_offset))
+
+
+def _collect_module(source: str, relpath: str) -> Optional[_ModuleInfo]:
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return None
+    mod = _ModuleInfo(path=relpath, pragmas=_pragmas(source))
+    # module-level locks
+    for st in tree.body:
+        if isinstance(st, ast.Assign) and len(st.targets) == 1 \
+                and isinstance(st.targets[0], ast.Name):
+            kind = _lock_ctor_kind(st.value)
+            if kind is not None:
+                mod.module_locks[st.targets[0].id] = kind
+    for st in tree.body:
+        if isinstance(st, ast.ClassDef):
+            mod.classes[st.name] = _collect_class(st, mod, relpath)
+        elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            info = _MethodInfo(qualname=st.name, name=st.name,
+                               is_init_body=False)
+            sink: List[_MethodInfo] = [info]
+            _FuncScan(None, mod, info, sink).scan(st.body, (), 0)
+            for mi in sink:
+                mod.functions[mi.qualname] = mi
+    return mod
+
+
+def _collect_class(cd: ast.ClassDef, mod: _ModuleInfo,
+                   relpath: str) -> _ClassInfo:
+    ci = _ClassInfo(name=cd.name, path=relpath)
+    # pre-pass: lock fields, condition aliases, attr types (any method)
+    init_params: Dict[str, str] = {}
+    for st in cd.body:
+        if isinstance(st, ast.FunctionDef) and st.name == "__init__":
+            for arg in st.args.args + st.args.kwonlyargs:
+                if arg.annotation is not None:
+                    ann = _dotted(arg.annotation)
+                    if ann:
+                        init_params[arg.arg] = ann.split(".")[-1]
+    for node in ast.walk(cd):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        f = _self_field(node.targets[0])
+        if f is None or not isinstance(node.targets[0], ast.Attribute):
+            continue
+        kind = _lock_ctor_kind(node.value)
+        if kind is not None:
+            ci.locks[f] = kind
+            if kind == "condition" and isinstance(node.value, ast.Call) \
+                    and node.value.args:
+                base = _self_field(node.value.args[0])
+                if base is not None:
+                    ci.cond_base[f] = base
+            continue
+        if isinstance(node.value, ast.Call):
+            d = _dotted(node.value.func)
+            if d is not None:
+                last = d.split(".")[-1]
+                if last[:1].isupper():
+                    ci.attr_types[f] = last
+        elif isinstance(node.value, ast.Name) \
+                and node.value.id in init_params:
+            ci.attr_types[f] = init_params[node.value.id]
+    # condition without alias: guard against dangling cond_base
+    for f, base in list(ci.cond_base.items()):
+        if base not in ci.locks:
+            del ci.cond_base[f]
+    # method bodies
+    for st in cd.body:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            info = _MethodInfo(qualname=f"{cd.name}.{st.name}",
+                               name=st.name,
+                               is_init_body=(st.name == "__init__"))
+            sink: List[_MethodInfo] = [info]
+            _FuncScan(ci, mod, info, sink).scan(st.body, (), 0)
+            for mi in sink:
+                ci.methods[mi.qualname] = mi
+    return ci
+
+
+# ---------------------------------------------------------------------------
+# pass 2: inheritance fixpoint + rule emission
+
+def _resolve_inherited(ci: _ClassInfo) -> None:
+    """Private methods called only under lock L inherit L (intersection
+    over intra-class call sites, to a fixpoint)."""
+    by_name: Dict[str, List[_MethodInfo]] = {}
+    for mi in ci.methods.values():
+        by_name.setdefault(mi.name, []).append(mi)
+    for _ in range(10):
+        changed = False
+        for mi in ci.methods.values():
+            if not mi.name.startswith("_") or mi.name.startswith("__"):
+                continue
+            sites: List[Set[str]] = []
+            for caller in ci.methods.values():
+                for call in caller.calls:
+                    if call.attr is None and call.target == mi.name:
+                        sites.append(set(call.held)
+                                     | set(caller.inherited))
+            if not sites:
+                continue
+            new = sites[0]
+            for s in sites[1:]:
+                new &= s
+            newt = tuple(sorted(new))
+            # the same name can appear as several pseudo-methods
+            # (nested defs); inheritance applies to the top-level one
+            if newt != mi.inherited:
+                mi.inherited = newt
+                changed = True
+        if not changed:
+            break
+
+
+class _Emitter:
+    def __init__(self):
+        self.findings: List[Finding] = []
+        self._pragmas: Dict[str, Dict[int, Optional[Set[str]]]] = {}
+
+    def register(self, mod: _ModuleInfo) -> None:
+        self._pragmas[mod.path] = mod.pragmas
+
+    def emit(self, rule: str, path: str, line: int, col: int,
+             func: str, message: str) -> None:
+        file_pragmas = self._pragmas.get(path, {})
+        if line in file_pragmas:
+            s = file_pragmas[line]
+            if s is None or rule in s:
+                return
+        self.findings.append(Finding(rule, path, line, col, func, message))
+
+
+def _effective(mi: _MethodInfo, held: Tuple[str, ...]) -> Set[str]:
+    return set(held) | set(mi.inherited)
+
+
+def _cl001(ci: _ClassInfo, em: _Emitter) -> None:
+    if not ci.locks:
+        return
+    # gather per-field write/readagg events with effective held sets
+    events: Dict[str, List[Tuple[str, Set[str], int, int, str, bool]]] = {}
+    for mi in ci.methods.values():
+        for ev in mi.accesses:
+            events.setdefault(ev.field, []).append(
+                (ev.kind, _effective(mi, ev.held), ev.line, ev.col,
+                 mi.qualname, ev.init))
+    for fieldname, evs in sorted(events.items()):
+        writes = [e for e in evs if e[0] == "write" and not e[5]]
+        guarded = [e for e in writes if e[1]]
+        if not guarded:
+            continue                    # never written under a lock: not ours
+        # owner = most common lock across (non-init) write sites
+        tally: Dict[str, int] = {}
+        for _, held, *_rest in guarded:
+            for lk in held:
+                tally[lk] = tally.get(lk, 0) + 1
+        owner = sorted(tally.items(), key=lambda kv: (-kv[1], kv[0]))[0][0]
+        for kind, held, line, col, qual, init in evs:
+            if init or owner in held:
+                continue
+            verb = ("written" if kind == "write"
+                    else "published (aggregate read)")
+            em.emit("CL001", ci.path, line, col, qual,
+                    f"self.{fieldname} {verb} without {owner} "
+                    f"(held elsewhere when writing it)")
+
+
+def _cl003_cl004(ci_or_mod, methods: Iterable[_MethodInfo], path: str,
+                 em: _Emitter) -> None:
+    for mi in methods:
+        for what, held, line, col in mi.blocking:
+            em.emit("CL003", path, line, col, mi.qualname,
+                    f"blocking call {what} while holding {held}")
+        for f, line, col, in_while in mi.waits:
+            if not in_while:
+                em.emit("CL004", path, line, col, mi.qualname,
+                        f"self.{f}.wait() outside a while predicate loop")
+
+
+def _cl002(modules: List[_ModuleInfo], em: _Emitter) -> None:
+    # class name -> _ClassInfo (global, for cross-class edges)
+    classes: Dict[str, _ClassInfo] = {}
+    for mod in modules:
+        for ci in mod.classes.values():
+            classes.setdefault(ci.name, ci)
+
+    def lexical_locks(ci: _ClassInfo, method: str) -> Set[str]:
+        out: Set[str] = set()
+        mi = ci.methods.get(f"{ci.name}.{method}")
+        if mi is not None:
+            out.update(a.lock for a in mi.acquires)
+        return out
+
+    # edges: (src, dst) -> (path, line, qualname, detail)
+    edges: Dict[Tuple[str, str], Tuple[str, int, str, str]] = {}
+
+    def add_edge(src: str, dst: str, path: str, line: int,
+                 qual: str, detail: str) -> None:
+        if src == dst:
+            return
+        edges.setdefault((src, dst), (path, line, qual, detail))
+
+    for mod in modules:
+        for ci in mod.classes.values():
+            for mi in ci.methods.values():
+                for acq in mi.acquires:
+                    heldset = _effective(mi, acq.held)
+                    if acq.lock in heldset:
+                        if acq.lockkind == "lock":
+                            em.emit("CL002", ci.path, acq.line, acq.col,
+                                    mi.qualname,
+                                    f"non-reentrant {acq.lock} re-acquired "
+                                    f"while already held (self-deadlock)")
+                        continue
+                    for h in sorted(heldset):
+                        add_edge(h, acq.lock, ci.path, acq.line,
+                                 mi.qualname,
+                                 f"acquires {acq.lock} while holding {h}")
+                for call in mi.calls:
+                    if call.attr is None:
+                        continue
+                    heldset = _effective(mi, call.held)
+                    if not heldset:
+                        continue
+                    tgt_cls = classes.get(ci.attr_types.get(call.attr, ""))
+                    if tgt_cls is None:
+                        continue
+                    method = call.target.split(".", 1)[1]
+                    for dst in sorted(lexical_locks(tgt_cls, method)):
+                        for h in sorted(heldset):
+                            if h == dst:
+                                continue
+                            add_edge(h, dst, ci.path, call.line,
+                                     mi.qualname,
+                                     f"calls {call.target}() (acquires "
+                                     f"{dst}) while holding {h}")
+
+    # cycle detection: iterative DFS over the digraph
+    graph: Dict[str, List[str]] = {}
+    for (src, dst) in edges:
+        graph.setdefault(src, []).append(dst)
+        graph.setdefault(dst, [])
+    color: Dict[str, int] = {}
+    cyclic_edges: Set[Tuple[str, str]] = set()
+
+    def dfs(start: str) -> None:
+        stack: List[Tuple[str, Iterable[str]]] = [(start, iter(sorted(graph[start])))]
+        path: List[str] = [start]
+        color[start] = 1
+        while stack:
+            node, it = stack[-1]
+            advanced = False
+            for nxt in it:
+                if color.get(nxt, 0) == 1:      # back edge -> cycle
+                    i = path.index(nxt)
+                    cyc = path[i:] + [nxt]
+                    for a, b in zip(cyc, cyc[1:]):
+                        cyclic_edges.add((a, b))
+                elif color.get(nxt, 0) == 0:
+                    color[nxt] = 1
+                    path.append(nxt)
+                    stack.append((nxt, iter(sorted(graph[nxt]))))
+                    advanced = True
+                    break
+            if not advanced:
+                color[node] = 2
+                path.pop()
+                stack.pop()
+
+    for n in sorted(graph):
+        if color.get(n, 0) == 0:
+            dfs(n)
+
+    for (src, dst) in sorted(cyclic_edges):
+        path, line, qual, detail = edges[(src, dst)]
+        em.emit("CL002", path, line, 0, qual,
+                f"lock-order inversion: edge {src} -> {dst} is part of a "
+                f"cycle ({detail})")
+
+
+def _analyze(modules: List[_ModuleInfo]) -> List[Finding]:
+    em = _Emitter()
+    for mod in modules:
+        em.register(mod)
+    for mod in modules:
+        for ci in mod.classes.values():
+            _resolve_inherited(ci)
+    for mod in modules:
+        for ci in mod.classes.values():
+            _cl001(ci, em)
+            _cl003_cl004(ci, ci.methods.values(), ci.path, em)
+        _cl003_cl004(mod, mod.functions.values(), mod.path, em)
+    _cl002(modules, em)
+    return sorted(em.findings,
+                  key=lambda f: (f.path, f.line, f.col, f.rule))
+
+
+# ---------------------------------------------------------------------------
+# entry points
+
+def _in_scope(relpath: str, package_root: str = "lightgbm_tpu") -> bool:
+    rel = relpath
+    prefix = package_root.replace(os.sep, "/") + "/"
+    if rel.startswith(prefix):
+        rel = rel[len(prefix):]
+    return rel.startswith(SCOPE)
+
+
+def lint_source(source: str, path: str,
+                package_root: str = "lightgbm_tpu") -> List[Finding]:
+    """Lint one module's source.  ``path`` should be package-relative
+    (``lightgbm_tpu/serving/service.py``); out-of-scope paths return []
+    so tier A fixtures can share a test harness."""
+    if not _in_scope(path, package_root):
+        return []
+    mod = _collect_module(source, path)
+    if mod is None:
+        return []
+    return _analyze([mod])
+
+
+def iter_scope_files(repo_root: str, package: str = "lightgbm_tpu"
+                     ) -> Iterable[Tuple[str, str]]:
+    pkg_dir = os.path.join(repo_root, package)
+    for dirpath, dirnames, filenames in os.walk(pkg_dir):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            full = os.path.join(dirpath, fn)
+            rel = os.path.relpath(full, repo_root).replace(os.sep, "/")
+            if _in_scope(rel, package):
+                yield full, rel
+
+
+def lint_tree(repo_root: str, package: str = "lightgbm_tpu"
+              ) -> List[Finding]:
+    """Cross-module lint of every in-scope file (the CL002 graph spans
+    files: service -> registry edges need both sides)."""
+    modules: List[_ModuleInfo] = []
+    for full, rel in iter_scope_files(repo_root, package):
+        with open(full, "r", encoding="utf-8") as fh:
+            source = fh.read()
+        mod = _collect_module(source, rel)
+        if mod is not None:
+            modules.append(mod)
+    return _analyze(modules)
+
+
+def finding_counts(findings: Iterable[Finding]) -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for f in findings:
+        counts[f.key] = counts.get(f.key, 0) + 1
+    return dict(sorted(counts.items()))

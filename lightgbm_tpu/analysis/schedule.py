@@ -1,0 +1,549 @@
+"""Tier C dynamic half: a seeded deterministic schedule explorer.
+
+The static pass (:mod:`.conlint`) proves what it can from the AST; this
+module *executes* the threaded planes under adversarially permuted —
+but fully deterministic — interleavings.  The trick is cooperative
+serialization over real threads: every thread the explorer manages
+parks on its own gate Event, the scheduler wakes exactly ONE at a
+time, and the woken thread runs until its next *yield point* (a lock
+acquire/release, a condition wait, or a source line tier C flagged as
+a CL001 hazard, hit via a per-thread ``sys.settrace`` watchlist).
+Which thread runs next is drawn from ``random.Random(seed)`` over the
+runnable set in spawn order — so the same seed replays the same
+schedule byte-for-byte, and a seed sweep is a bounded, replayable
+search over interleavings instead of a flaky stress test.
+
+:class:`SchedLock` / :class:`SchedCondition` mirror
+``threading.Lock/RLock/Condition`` closely enough to monkeypatch into
+a live :class:`~lightgbm_tpu.serving.service.ServingService` +
+:class:`~lightgbm_tpu.serving.registry.ModelRegistry`
+(:func:`instrument_service`); they need no OS lock at all because only
+one managed thread ever runs.  A schedule where nothing can run but
+threads still hold/await locks is a DEADLOCK — recorded with the full
+wait-for state, which is exactly the dynamic form of conlint's CL002.
+
+Three serving-plane drills ride on top (``run_schedule_drill``):
+
+* ``"publish_pump"``  — a hot publish lands while the pump drains
+  coalesced traffic: every ticket must complete with predictions
+  bit-equal to the OLD or the NEW version's oracle (a torn registry
+  view — CL001 dynamic — fails), warm compiles stay ≤1 per bucket.
+* ``"evict_dispatch"`` — a pack-budget eviction races dispatch: the
+  engine re-packs on demand, every ticket still matches the oracle,
+  counters stay consistent.
+* ``"swap_rollback"``  — a retrain-style swap followed by a rollback
+  watchdog races traffic: per-ticket results match exactly one
+  version's oracle, the registry lands on the rolled-back version,
+  breaker state stays consistent.
+
+Like :mod:`..serving.drill`, reports are pure functions of ``seed`` on
+a ManualClock — two runs with the same seed are byte-identical, which
+tier-1 asserts.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import sys
+import threading
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+__all__ = ["Scheduler", "SchedLock", "SchedCondition",
+           "instrument_service", "run_schedule_drill", "report_bytes",
+           "SCHEDULE_SCENARIOS"]
+
+SCHEDULE_SCENARIOS = ("publish_pump", "evict_dispatch", "swap_rollback")
+
+_UNMANAGED = "<unmanaged>"
+
+
+class _TState:
+    __slots__ = ("name", "fn", "thread", "gate", "done", "blocked_on",
+                 "waiting_cv", "cv_timed", "failure")
+
+    def __init__(self, name: str, fn: Callable[[], None]):
+        self.name = name
+        self.fn = fn
+        self.thread: Optional[threading.Thread] = None
+        self.gate = threading.Event()
+        self.done = False
+        self.blocked_on: Optional["SchedLock"] = None
+        self.waiting_cv: Optional["SchedCondition"] = None
+        self.cv_timed = False
+        self.failure: Optional[BaseException] = None
+
+
+class SchedLock:
+    """Cooperative stand-in for threading.Lock/RLock.  Owner/count
+    bookkeeping only — mutual exclusion comes from the scheduler
+    running one thread at a time, so there is no OS lock to leak."""
+
+    def __init__(self, sched: "Scheduler", name: str,
+                 reentrant: bool = False):
+        self._sched = sched
+        self.name = name
+        self._reentrant = reentrant
+        self._owner: Optional[object] = None
+        self._count = 0
+
+    # threading.Lock API ---------------------------------------------------
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        st = self._sched._me()
+        if st is None:                  # outside a managed schedule
+            if self._owner is None or (self._reentrant
+                                       and self._owner == _UNMANAGED):
+                self._owner = _UNMANAGED
+                self._count += 1
+                return True
+            raise RuntimeError(
+                f"{self.name} still held at unmanaged acquire "
+                "(a managed thread deadlocked holding it?)")
+        self._sched._yield_point(("acquire", self.name, st.name))
+        while not self._try(st):
+            if not blocking:
+                return False
+            st.blocked_on = self
+            self._sched._yield_point(("blocked", self.name, st.name))
+        self._sched._trace("acq", self.name, st.name)
+        return True
+
+    def release(self) -> None:
+        st = self._sched._me()
+        if self._count <= 0:
+            raise RuntimeError(f"release of unheld {self.name}")
+        self._count -= 1
+        if self._count == 0:
+            self._owner = None
+            self._sched._wake_blocked(self)
+        if st is not None:
+            self._sched._trace("rel", self.name, st.name)
+            self._sched._yield_point(("release", self.name, st.name))
+
+    def _try(self, st: _TState) -> bool:
+        if self._owner is None or (self._reentrant and self._owner is st):
+            self._owner = st
+            self._count += 1
+            return True
+        return False
+
+    def locked(self) -> bool:
+        return self._owner is not None
+
+    __enter__ = acquire
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class SchedCondition:
+    """Cooperative threading.Condition over a :class:`SchedLock`.
+    ``wait(timeout=...)`` is DETERMINISTIC: a timed waiter stays
+    runnable (the scheduler may resume it = the timeout fired, on the
+    manual clock's schedule); an untimed waiter only wakes on
+    notify."""
+
+    def __init__(self, lock: SchedLock):
+        self._lock = lock
+        self._sched = lock._sched
+        self._waiters: List[_TState] = []
+
+    def acquire(self, *a, **kw):
+        return self._lock.acquire(*a, **kw)
+
+    def release(self) -> None:
+        self._lock.release()
+
+    __enter__ = acquire
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        st = self._sched._me()
+        if st is None:
+            raise RuntimeError("cv.wait() outside a managed thread")
+        if self._lock._owner is not st:
+            raise RuntimeError("cv.wait() without holding its lock")
+        saved = self._lock._count       # full release, RLock-style
+        self._lock._count = 0
+        self._lock._owner = None
+        self._sched._wake_blocked(self._lock)
+        st.waiting_cv = self
+        st.cv_timed = timeout is not None
+        self._waiters.append(st)
+        self._sched._trace("cv_wait", self._lock.name, st.name)
+        self._sched._yield_point(("cv_wait", self._lock.name, st.name))
+        # resumed: notified (removed from _waiters) or timed out
+        notified = st not in self._waiters
+        if not notified:
+            self._waiters.remove(st)
+        st.waiting_cv = None
+        st.cv_timed = False
+        while not self._lock._try(st):  # reacquire before returning
+            st.blocked_on = self._lock
+            self._sched._yield_point(("reacquire", self._lock.name,
+                                      st.name))
+        self._lock._count = saved
+        return notified
+
+    def notify_all(self) -> None:
+        for st in self._waiters:
+            st.waiting_cv = None
+            st.cv_timed = False
+        self._waiters.clear()
+
+    def notify(self, n: int = 1) -> None:
+        for st in self._waiters[:n]:
+            st.waiting_cv = None
+            st.cv_timed = False
+        del self._waiters[:n]
+
+
+class Scheduler:
+    """Seeded cooperative scheduler: spawn threads, then :meth:`run`
+    serializes them, picking each next step with ``Random(seed)``."""
+
+    def __init__(self, seed: int = 0, max_steps: int = 20000):
+        self.seed = int(seed)
+        self._rnd = random.Random(int(seed))
+        self.max_steps = int(max_steps)
+        self._threads: List[_TState] = []
+        self._local = threading.local()
+        self._ctl = threading.Event()
+        self.schedule: List[str] = []   # thread name per scheduling step
+        self.trace: List[Tuple[str, str, str]] = []   # lock events
+        self.deadlock: Optional[Dict[str, Any]] = None
+        self.stalled = False            # a thread blocked outside us
+        self.livelock = False
+        self._watch: Dict[str, set] = {}
+        self._steps = 0
+
+    # -- construction ------------------------------------------------------
+    def lock(self, name: str, reentrant: bool = False) -> SchedLock:
+        return SchedLock(self, name, reentrant=reentrant)
+
+    def condition(self, lock: SchedLock) -> SchedCondition:
+        return SchedCondition(lock)
+
+    def spawn(self, name: str, fn: Callable[[], None]) -> None:
+        self._threads.append(_TState(name, fn))
+
+    def watch_lines(self, filename: str, lines: Iterable[int]) -> None:
+        """Add (filename, line) yield points — the bridge from the
+        static half: pass each CL001 finding's location so the explorer
+        can interleave exactly at the flagged access."""
+        self._watch.setdefault(filename, set()).update(int(x) for x in lines)
+
+    def watch_findings(self, findings, filename: str) -> None:
+        """Register every CL001 finding from :mod:`.conlint` as a
+        yield point in ``filename`` (the runtime co_filename — for an
+        exec'd fixture, whatever was passed to compile())."""
+        for f in findings:
+            if f.rule == "CL001":
+                self.watch_lines(filename, [f.line])
+
+    # -- internals ---------------------------------------------------------
+    def _me(self) -> Optional[_TState]:
+        return getattr(self._local, "st", None)
+
+    def _trace(self, op: str, lock: str, thread: str) -> None:
+        self.trace.append((op, lock, thread))
+
+    def _wake_blocked(self, lock: "SchedLock") -> None:
+        for st in self._threads:
+            if st.blocked_on is lock:
+                st.blocked_on = None
+
+    def _yield_point(self, tag) -> None:
+        st = self._me()
+        if st is None:
+            return
+        st.gate.clear()
+        self._ctl.set()                 # hand control to the scheduler
+        st.gate.wait()                  # park until scheduled again
+
+    def _lines_for(self, filename: str) -> Optional[set]:
+        got = self._watch.get(filename)
+        if got is not None:
+            return got
+        for k, v in self._watch.items():
+            if filename.endswith(k):
+                return v
+        return None
+
+    def _global_trace(self, frame, event, arg):
+        if event == "call" and \
+                self._lines_for(frame.f_code.co_filename) is not None:
+            return self._line_trace
+        return None
+
+    def _line_trace(self, frame, event, arg):
+        if event == "line":
+            lines = self._lines_for(frame.f_code.co_filename)
+            if lines and frame.f_lineno in lines:
+                # yield BEFORE the flagged line runs: the scheduler can
+                # slot another thread between this access and the next
+                self._yield_point(("line", frame.f_code.co_filename,
+                                   frame.f_lineno))
+        return self._line_trace
+
+    def _body(self, st: _TState) -> None:
+        self._local.st = st
+        if self._watch:
+            sys.settrace(self._global_trace)
+        st.gate.wait()                  # first schedule starts us
+        try:
+            st.fn()
+        except BaseException as exc:    # noqa: BLE001 — reported below
+            st.failure = exc
+        finally:
+            sys.settrace(None)
+            st.done = True
+            st.blocked_on = None
+            self._ctl.set()
+
+    def _runnable(self) -> List[_TState]:
+        out = []
+        for st in self._threads:
+            if st.done or st.blocked_on is not None:
+                continue
+            if st.waiting_cv is not None and not st.cv_timed:
+                continue                # untimed cv wait: notify only
+            out.append(st)
+        return out
+
+    # -- the loop ----------------------------------------------------------
+    def run(self, stall_timeout_s: float = 120.0) -> None:
+        for st in self._threads:
+            t = threading.Thread(target=self._body, args=(st,),
+                                 daemon=True, name=f"sched-{st.name}")
+            st.thread = t
+            t.start()
+        while True:
+            live = [st for st in self._threads if not st.done]
+            if not live:
+                break
+            runnable = self._runnable()
+            if not runnable:
+                self.deadlock = {
+                    "blocked": {st.name: st.blocked_on.name
+                                for st in live
+                                if st.blocked_on is not None},
+                    "cv_waiting": sorted(st.name for st in live
+                                         if st.waiting_cv is not None),
+                }
+                break
+            if self._steps >= self.max_steps:
+                self.livelock = True
+                break
+            self._steps += 1
+            pick = runnable[self._rnd.randrange(len(runnable))]
+            self.schedule.append(pick.name)
+            self._ctl.clear()
+            pick.gate.set()
+            if not self._ctl.wait(stall_timeout_s):
+                # the thread never came back to a yield point: it is
+                # blocked on something the scheduler doesn't manage
+                self.stalled = True
+                break
+
+    @property
+    def steps(self) -> int:
+        return self._steps
+
+    def failures(self) -> Dict[str, str]:
+        return {st.name: repr(st.failure) for st in self._threads
+                if st.failure is not None}
+
+    def check(self) -> None:
+        """Raise on any outcome that is a drill failure by itself."""
+        if self.deadlock is not None:
+            raise AssertionError(
+                f"seed {self.seed}: deadlock (dynamic CL002): "
+                f"{self.deadlock}")
+        if self.stalled:
+            raise AssertionError(
+                f"seed {self.seed}: a managed thread stalled outside "
+                "the scheduler")
+        if self.livelock:
+            raise AssertionError(
+                f"seed {self.seed}: exceeded {self.max_steps} steps")
+        bad = self.failures()
+        if bad:
+            raise AssertionError(
+                f"seed {self.seed}: thread failures: {bad}")
+
+
+# ---------------------------------------------------------------------------
+# instrumentation of the real serving plane
+# ---------------------------------------------------------------------------
+
+def instrument_service(service, sched: Scheduler):
+    """Swap the service's and its registry's locks for scheduler-owned
+    cooperative ones (post-construction, pre-drill: anything published
+    BEFORE this ran under the real locks).  Lock kinds mirror the real
+    fields: ``_lock`` is an RLock with a Condition on it, ``_pump_lock``
+    and ``_cohort_lock`` are plain Locks."""
+    service._lock = sched.lock("service._lock", reentrant=True)
+    service._cv = sched.condition(service._lock)
+    service._pump_lock = sched.lock("service._pump_lock")
+    reg = service.registry
+    reg._lock = sched.lock("registry._lock", reentrant=True)
+    reg._cohort_lock = sched.lock("registry._cohort_lock")
+    return service
+
+
+# ---------------------------------------------------------------------------
+# drills
+# ---------------------------------------------------------------------------
+
+_BOOSTERS: Dict[int, Any] = {}
+
+
+def _boosters(seed: int):
+    """Two tiny trained versions + their rows, cached per seed (the
+    drills only need *different* forests, and retraining per drill
+    call would dominate tier-1 time)."""
+    got = _BOOSTERS.get(seed)
+    if got is None:
+        from ..serving.drill import _train_small
+        b1, X = _train_small(seed, rows=160, features=5, trees=3)
+        b2, _ = _train_small(seed + 1000, rows=160, features=5, trees=4)
+        got = _BOOSTERS[seed] = (b1, b2, X[:16])
+    return got
+
+
+def _mk_plane(seed: int, **reg_kw):
+    from ..robustness.retry import ManualClock
+    from ..serving.registry import ModelRegistry
+    from ..serving.service import ServingService
+    clock = ManualClock()
+    reg = ModelRegistry(clock=clock, **reg_kw)
+    svc = ServingService(reg, flush_rows=8, max_delay=0.0,
+                         queue_depth=16, seed=seed, clock=clock)
+    return reg, svc
+
+
+def _oracles(b1, b2, rows):
+    import numpy as np
+    return (np.asarray(b1.predict(rows, raw_score=True)),
+            np.asarray(b2.predict(rows, raw_score=True)))
+
+
+def _match(res, i, o1, o2) -> str:
+    """Which version's oracle does this ticket's result agree with?
+    Tolerance-based like drill.py's swap parity check (the compiled
+    serving path vs booster.predict differ in float association);
+    anything agreeing with NEITHER is a torn registry view."""
+    import numpy as np
+    r = np.asarray(res).reshape(-1)
+    if np.allclose(r, o1[i:i + 1].reshape(-1), rtol=1e-6, atol=1e-6):
+        return "v1"
+    if np.allclose(r, o2[i:i + 1].reshape(-1), rtol=1e-6, atol=1e-6):
+        return "v2"
+    return "torn"
+
+
+def _ticket_rows(tickets) -> List[Dict[str, Any]]:
+    return [{"status": t.status, "reason": t.reason} for t in tickets]
+
+
+def run_schedule_drill(scenario: str, seed: int = 0) -> Dict[str, Any]:
+    """Run one scenario under the seed's schedule and return a
+    JSON-able report that is a pure function of (scenario, seed)."""
+    if scenario not in SCHEDULE_SCENARIOS:
+        raise ValueError(f"unknown scenario {scenario!r}; "
+                         f"pick from {SCHEDULE_SCENARIOS}")
+    b1, b2, rows = _boosters(0)
+    o1, o2 = _oracles(b1, b2, rows)
+    n = 4
+    sched = Scheduler(seed=seed)
+    reg, svc = _mk_plane(seed,
+                         **({"pack_budget_bytes": 1}
+                            if scenario == "evict_dispatch" else {}))
+    reg.publish("m", b1, gate_rows=rows)
+    if scenario == "swap_rollback":
+        reg.publish("m", b2, gate_rows=rows)
+    instrument_service(svc, sched)
+
+    tickets: List[Any] = []
+    stats_seen: List[Dict[str, Any]] = []
+
+    def t_traffic():
+        for i in range(n):
+            tickets.append(svc.submit(rows[i:i + 1], model="m"))
+        svc.pump(force=True)
+        svc.pump(force=True)            # drain anything a racer re-queued
+
+    def t_racer():
+        if scenario == "publish_pump":
+            reg.publish("m", b2, gate_rows=rows)
+        elif scenario == "evict_dispatch":
+            reg.enforce_budget()
+            stats_seen.append({"evictions": int(reg.evictions)})
+        else:                           # swap_rollback: watchdog rolls back
+            stats_seen.append({"pre": svc.stats()["counters"].get(
+                "served", 0)})
+            reg.rollback("m")
+            stats_seen.append({"post": svc.stats()["counters"].get(
+                "served", 0)})
+
+    sched.spawn("traffic", t_traffic)
+    sched.spawn("racer", t_racer)
+    sched.run()
+    sched.check()
+
+    stats = svc.stats()
+    counters = stats["counters"]
+    matched = [(_match(t.result, i, o1, o2) if t.status == "ok"
+                else t.status)
+               for i, t in enumerate(tickets)]
+
+    # invariants --------------------------------------------------------
+    if any(m == "torn" for m in matched):
+        raise AssertionError(
+            f"seed {seed}: torn registry view — a ticket's predictions "
+            f"match NEITHER version's oracle: {matched}")
+    if any(t.status != "ok" for t in tickets):
+        raise AssertionError(
+            f"seed {seed}: dropped/failed tickets: "
+            f"{_ticket_rows(tickets)}")
+    if counters.get("served", 0) != counters.get("submitted", 0):
+        raise AssertionError(
+            f"seed {seed}: served {counters.get('served')} != "
+            f"submitted {counters.get('submitted')} (torn counters)")
+    for m, br in stats["breakers"].items():
+        if br["state"] != "closed" or br["trips"] != 0:
+            raise AssertionError(
+                f"seed {seed}: breaker {m} inconsistent: {br}")
+    version = reg.version("m")
+    if scenario == "publish_pump" and version != 2:
+        raise AssertionError(f"seed {seed}: publish lost ({version})")
+    if scenario == "swap_rollback":
+        if version != 3:
+            raise AssertionError(
+                f"seed {seed}: rollback mints a NEW version (expected "
+                f"3, got {version})")
+        rb = reg.stats()["models"]["m"]["rollbacks"]
+        if rb != 1:
+            raise AssertionError(f"seed {seed}: rollbacks {rb} != 1")
+
+    return {
+        "scenario": scenario,
+        "seed": seed,
+        "steps": sched.steps,
+        "schedule": ",".join(s[0] for s in sched.schedule),
+        "lock_events": len(sched.trace),
+        "tickets": _ticket_rows(tickets),
+        "matched": matched,
+        "counters": {k: int(v) for k, v in sorted(counters.items())},
+        "version": int(version),
+        "racer": stats_seen,
+        "deadlock": sched.deadlock,
+    }
+
+
+def report_bytes(report: Dict[str, Any]) -> bytes:
+    """Canonical serialized form — what tier-1 compares across runs."""
+    return json.dumps(report, sort_keys=True, default=str).encode()

@@ -531,7 +531,19 @@ class ContinualBooster:
 
         if self.background:
             # attempt_state rides the holder so status() reads the LIVE
-            # attempt count while the worker runs, not a post-hoc copy
+            # attempt count while the worker runs, not a post-hoc copy.
+            #
+            # Lock-free handoff protocol (audited for ISSUE 19 with the
+            # tier C schedule explorer; tests/test_conlint.py replays
+            # it under permuted interleavings): exactly ONE writer (the
+            # worker) and two readers (status(), _poll_background, both
+            # on the tick thread).  Each dict write is a single
+            # GIL-atomic store, and "done" flips LAST, so a reader that
+            # observes done=True is guaranteed to see result/error and
+            # attempts; a reader that doesn't stays on the "pending"
+            # path, which touches only attempt_state (monotone int,
+            # single store).  Inverting the write order is the bug the
+            # explorer provokes (a poll sees done without result).
             holder: Dict[str, Any] = {"done": False,
                                       "attempt_state": attempt_state}
 

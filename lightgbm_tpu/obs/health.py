@@ -394,15 +394,23 @@ class SkewMonitor:
 
     def _check_thresholds(self) -> None:
         for s in self.scores(topk=0):
-            if s["psi"] > self.threshold and s["feature"] not in \
-                    self._alerted:
-                with self._lock:
-                    self._alerted.add(s["feature"])
-                    self.alerts += 1
-                obs.counter("health.skew.alerts")
-                obs.instant("health.skew", feature=s["feature"],
-                            feature_name=s["name"], psi=s["psi"],
-                            threshold=self.threshold)
+            if s["psi"] <= self.threshold:
+                continue
+            with self._lock:
+                # membership test and insert under ONE lock hold: two
+                # threads crossing the same feature's threshold in the
+                # same scan window must not both count the alert
+                # (check-then-act race on _alerted)
+                if s["feature"] in self._alerted:
+                    continue
+                self._alerted.add(s["feature"])
+                self.alerts += 1
+            # telemetry emission outside the lock: the session has its
+            # own lock and must never nest inside a monitor's
+            obs.counter("health.skew.alerts")
+            obs.instant("health.skew", feature=s["feature"],
+                        feature_name=s["name"], psi=s["psi"],
+                        threshold=self.threshold)
 
     def report(self) -> Dict[str, Any]:
         # a report is an explicit snapshot point: crossings observed

@@ -116,12 +116,15 @@ class MemoryLedger:
         off and snapshot() (the other pruning point) never runs — and
         several instances may share one owner name (their bytes sum)."""
         key = (owner, id(obj))
-        # dict.pop is GIL-atomic; no lock in the GC callback (taking
-        # self._lock there could deadlock against a holder that
-        # triggers collection)
+
+        def _gone(_r, _k=key):
+            # invariant: ONE GIL-atomic dict.pop, no lock — taking
+            # self._lock inside a GC callback could deadlock against a
+            # lock holder whose allocation triggers collection
+            self._providers.pop(_k, None)  # conlint: ok=CL001
+
         try:
-            ref = weakref.ref(
-                obj, lambda _r, _k=key: self._providers.pop(_k, None))
+            ref = weakref.ref(obj, _gone)
         except TypeError:
             return                      # unweakrefable: skip, never crash
         with self._lock:

@@ -272,8 +272,15 @@ class Telemetry:
                              "args": args or {}})
 
     def _event(self, ev: Dict[str, Any]) -> None:
-        # lock held by the caller; the deque's maxlen evicts the OLDEST
-        # event so the ring always keeps the most recent window
+        # lock held by the caller (conlint verifies this statically:
+        # every call site sits in a `with self._lock:` block, and the
+        # private-method inheritance rule analyzes _event as holding
+        # it).  The ring append therefore never races report()'s
+        # `len(self.events)` / snapshot_events()' `list(self.events)`
+        # drains, which take the same lock — audited for ISSUE 19's
+        # append-vs-drain sweep; nothing to fix, nothing pinned.
+        # The deque's maxlen evicts the OLDEST event so the ring
+        # always keeps the most recent window
         if len(self.events) >= self.max_events:
             self.events_dropped += 1
         ev.setdefault("pid", os.getpid())

@@ -28,6 +28,14 @@ classes cover the runtime's failure surface:
 Injections are process-local and explicit (no env vars): tests call
 ``inject(...)`` / ``clear()``, or use the ``injected(...)`` context
 manager which always clears.
+
+Concurrency contract (conlint tier C): module state is deliberately
+lock-free.  Arming/clearing happens on the test thread BEFORE the
+threads under test run (the drills are single-threaded on a manual
+clock; the schedule explorer serializes its threads cooperatively),
+and each hot-path check is a single GIL-atomic module-global read —
+a lock here would put a blocking point inside every dispatch for state
+that is never mutated concurrently with it.
 """
 
 from __future__ import annotations

@@ -24,6 +24,13 @@ bit-for-bit):
   probe success closes the breaker, a failure re-opens it at the next
   backoff step.  Jitter is seeded, never wall-clock, so a drill's trip
   and recovery ticks replay identically.
+
+Concurrency contract (conlint tier C): none of these classes carries a
+lock of its own — they are owned by :class:`ServingService` and only
+ever touched under ``service._lock`` (submit/stats paths) or from the
+single pump holding ``service._pump_lock`` (dispatch outcomes on the
+breaker).  Breaker state reads on the dispatch fast path are
+single-attribute GIL-atomic reads by design.
 """
 
 from __future__ import annotations

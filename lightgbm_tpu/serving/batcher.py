@@ -24,6 +24,12 @@ Flush policy is size-OR-deadline:
 The batcher holds NO thread of its own and reads only the injected
 clock: the service's pump (or a drill) asks :meth:`due` and drains —
 which is what makes flood/deadline drills bit-reproducible.
+
+Concurrency contract (conlint tier C): the batcher has no lock of its
+own — every mutation (``add`` on submit, ``due``/drain from the pump)
+and every ``stats()`` read happens under the owning
+``ServingService._lock``; the service, not the batcher, is the unit of
+mutual exclusion.
 """
 
 from __future__ import annotations

@@ -33,7 +33,7 @@ from __future__ import annotations
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Dict, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
@@ -367,15 +367,44 @@ def run_serve_task(cfg) -> None:
     except KeyboardInterrupt:
         log.info("serve: shutting down")
     finally:
-        server.server_close()
+        shutdown_server(server, service=service)
+
+
+def shutdown_server(server: ThreadingHTTPServer,
+                    thread: "Optional[threading.Thread]" = None,
+                    service: "Optional[ServingService]" = None,
+                    deadline_s: float = 5.0) -> bool:
+    """Deadline-bounded shutdown of a server (+ optional serve thread
+    and service) from :func:`run_serve_task` /
+    :func:`serve_in_background`.
+
+    The conlint CL003 contract for the whole teardown path: every join
+    carries a timeout and NO lock is held while joining — a wedged
+    handler (or a pump stuck in dispatch) costs at most ``deadline_s``,
+    never a hang, and can never deadlock against a handler thread that
+    is blocked on the service lock.  Returns True when every thread
+    exited inside the deadline (the HTTP thread is a daemon either
+    way, so a False here is diagnostic, not a leak).
+    """
+    server.shutdown()               # stop serve_forever's poll loop
+    clean = True
+    if thread is not None:
+        thread.join(deadline_s)     # bounded; lock-free by contract
+        clean = not thread.is_alive()
+    server.server_close()
+    if service is not None:
+        # ServingService.stop drains, then joins its pump worker with
+        # its own bounded timeout — also without holding service locks
         service.stop()
+    return clean
 
 
 def serve_in_background(service: ServingService, host: str = "127.0.0.1",
                         port: int = 0) -> Tuple[ThreadingHTTPServer,
                                                 threading.Thread]:
     """Test/tool helper: worker pump + HTTP server on a daemon thread;
-    returns (server, thread) — the caller owns shutdown."""
+    returns (server, thread) — the caller owns shutdown (pass both,
+    plus the service, to :func:`shutdown_server`)."""
     service.start()
     server = make_server(service, host=host, port=port)
     t = threading.Thread(target=server.serve_forever, daemon=True,

@@ -500,8 +500,13 @@ class ModelRegistry:
 def _registry_arrays(reg: ModelRegistry):
     """Telemetry memory provider: every resident version's packs plus
     the stacked cohort tensors."""
+    # snapshot the entry list under the registry lock: a concurrent
+    # publish/remove mutates _entries while a span-boundary snapshot
+    # walks providers from another thread (conlint CL001)
+    with reg._lock:
+        entries = list(reg._entries.values())
     out = []
-    for ent in list(reg._entries.values()):
+    for ent in entries:
         for bst in (ent.active, ent.previous):
             if bst is not None:
                 out.append(_pack_memory_arrays(bst._gbdt.serving))

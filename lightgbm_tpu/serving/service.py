@@ -162,8 +162,7 @@ class ServingService:
         # backoff ladder) must not gate the fresh one — without this, a
         # fixed model keeps serving the stale fallback until the broken
         # version's next scheduled probe
-        registry.subscribe_version_change(
-            lambda name: self.breakers.pop(name, None))
+        registry.subscribe_version_change(self._on_version_change)
 
     # -- submit ----------------------------------------------------------
     def submit(self, rows, *, model: str = "default",
@@ -373,9 +372,10 @@ class ServingService:
             log.warning("serve: cohort dispatch failed (%s); "
                         "falling back to per-model dispatch", exc)
             return singles()
-        self.counters["dispatches"] += 1
-        self.counters["cohort_dispatches"] += 1
-        self.counters["cohort_models"] += len(pack.names)
+        with self._lock:
+            self.counters["dispatches"] += 1
+            self.counters["cohort_dispatches"] += 1
+            self.counters["cohort_models"] += len(pack.names)
         for name, out in zip(pack.names, outs):
             # a cohort dispatch IS a successful serve of the member:
             # reset its consecutive-failure count like the per-model
@@ -403,8 +403,15 @@ class ServingService:
             if br is not None:
                 br.probe_inconclusive()
 
+    def _on_version_change(self, name: str) -> None:
+        """Registry listener: retire the outgoing version's breaker.
+        Fires OUTSIDE the registry lock (see _notify_version_change),
+        so taking the service lock here adds no lock-order edge."""
+        with self._lock:
+            self.breakers.pop(name, None)
+
     def _breaker(self, model: str) -> CircuitBreaker:
-        br = self.breakers.get(model)
+        br = self.breakers.get(model)   # single read: GIL-atomic
         if br is None:
             # per-model seed offset from a STABLE name hash (not dict
             # size, which shifts as breakers are minted/retired): two
@@ -412,9 +419,13 @@ class ServingService:
             # lockstep, and re-minting after a version change must
             # reproduce the same schedule
             import zlib
-            br = self.breakers[model] = CircuitBreaker(
-                seed=self._seed + (zlib.crc32(model.encode()) & 0xffff),
-                clock=self._clock, **self._breaker_kw)
+            with self._lock:
+                br = self.breakers.get(model)
+                if br is None:          # re-check: lost the mint race
+                    br = self.breakers[model] = CircuitBreaker(
+                        seed=self._seed
+                        + (zlib.crc32(model.encode()) & 0xffff),
+                        clock=self._clock, **self._breaker_kw)
         return br
 
     def _hist(self, model: str, kind: str) -> Histogram:
@@ -449,7 +460,8 @@ class ServingService:
             num_iteration=num))
 
     def _fail_all(self, reqs, reason: str) -> None:
-        self.counters["errors"] += len(reqs)
+        with self._lock:
+            self.counters["errors"] += len(reqs)
         for req in reqs:
             req.ticket._finish("error", reason=reason)
 
@@ -495,7 +507,8 @@ class ServingService:
                 return
         X = (reqs[0].rows if len(reqs) == 1
              else np.concatenate([r.rows for r in reqs], axis=0))
-        self.counters["dispatches"] += 1
+        with self._lock:
+            self.counters["dispatches"] += 1
         # the tenant id the admission layer already knows rides the
         # dispatch span (coalesced multi-tenant batches tag "multi" —
         # per-tenant latency is exact in _complete either way)
@@ -515,7 +528,8 @@ class ServingService:
                                         inject_model=None if fallback
                                         else model)
         except Exception as exc:   # noqa: BLE001 — any model fault
-            self.counters["dispatch_failures"] += 1
+            with self._lock:
+                self.counters["dispatch_failures"] += 1
             # fallback dispatches never blame the client: its rows
             # passed the door check against the ACTIVE version — a
             # width mismatch here means the SERVER chose an
@@ -554,40 +568,55 @@ class ServingService:
     def _complete(self, reqs, out: np.ndarray, model: str, kind: str,
                   fallback: bool = False) -> None:
         now = self._clock()
-        hist = self._hist(model, kind)
         pos = 0
         # per-request copies, not views: a view would pin the WHOLE
         # coalesced batch output for as long as any one ticket lives
         split = len(reqs) > 1
         tel = obs.enabled()
-        for req in reqs:
-            n = req.rows.shape[0]
-            res = out[pos:pos + n].copy() if split else out[pos:pos + n]
-            pos += n
-            lat = now - req.t_submit
-            hist.observe(lat)
-            # tenant is a client-supplied string: bound the per-tenant
-            # map (same hazard as client-supplied model names — an id
-            # rotator would otherwise grow service memory AND the
-            # Prometheus exposition without bound); overflow tenants
-            # fold into one "~other" bucket
-            tkey = req.tenant
-            th = self.tenant_latency.get(tkey)
-            if th is None:
-                if len(self.tenant_latency) >= self.TENANT_MAX:
-                    tkey = "~other"
+        finishes = []
+        samples = []
+        # one lock hold covers every histogram observe and counter
+        # bump: stats() snapshots under the same lock, so a reader can
+        # never see a latency sample without its served count (or a
+        # half-updated Histogram)
+        with self._lock:
+            hist = self._hist(model, kind)
+            for req in reqs:
+                n = req.rows.shape[0]
+                res = (out[pos:pos + n].copy() if split
+                       else out[pos:pos + n])
+                pos += n
+                lat = now - req.t_submit
+                hist.observe(lat)
+                # tenant is a client-supplied string: bound the
+                # per-tenant map (same hazard as client-supplied model
+                # names — an id rotator would otherwise grow service
+                # memory AND the Prometheus exposition without bound);
+                # overflow tenants fold into one "~other" bucket
+                tkey = req.tenant
                 th = self.tenant_latency.get(tkey)
                 if th is None:
-                    th = self.tenant_latency[tkey] = Histogram()
-            th.observe(lat)
-            if tel:
-                # same sample into the telemetry session so the
-                # Prometheus export carries per-tenant p50/p99
-                obs.observe_span(f"serve.tenant.{tkey}.{kind}",
-                                 lat, model=model)
-            self.counters["served"] += 1
-            if fallback:
-                self.counters["fallback_served"] += 1
+                    if len(self.tenant_latency) >= self.TENANT_MAX:
+                        tkey = "~other"
+                    th = self.tenant_latency.get(tkey)
+                    if th is None:
+                        th = self.tenant_latency[tkey] = Histogram()
+                th.observe(lat)
+                if tel:
+                    samples.append((tkey, lat))
+                self.counters["served"] += 1
+                if fallback:
+                    self.counters["fallback_served"] += 1
+                finishes.append((req, res, lat))
+        # ticket completion and telemetry run OUTSIDE the lock:
+        # _finish wakes waiter threads and observe_span takes the
+        # telemetry session lock — neither belongs under self._lock
+        for tkey, lat in samples:
+            # same sample into the telemetry session so the
+            # Prometheus export carries per-tenant p50/p99
+            obs.observe_span(f"serve.tenant.{tkey}.{kind}",
+                             lat, model=model)
+        for req, res, lat in finishes:
             req.ticket._finish("ok", result=res,
                                reason="fallback" if fallback else None,
                                latency=lat)
@@ -637,28 +666,40 @@ class ServingService:
 
     # -- stats -----------------------------------------------------------
     def stats(self) -> Dict[str, Any]:
-        shed_rate = (self.counters["shed"]
-                     / max(self.counters["submitted"], 1))
-        return {
-            "counters": dict(self.counters),
-            "shed_rate": round(shed_rate, 6),
-            "admission": self.admission.stats(),
-            "batcher": self.batcher.stats(),
-            # dict(...) snapshots are GIL-atomic: handler threads read
-            # stats while the pump inserts first-seen models/keys
-            "breakers": {
+        # snapshot every service-owned structure under the owning lock
+        # (conlint CL001): counters vs shed_rate stay mutually
+        # consistent, and a Histogram is never serialized mid-observe.
+        # The admission queues and the batcher are service-lock-owned
+        # too (see their module docstrings), so their stats ride the
+        # same hold.  registry.stats()/_tenant_skew() lock themselves
+        # and run OUTSIDE: self._lock -> registry._lock here would add
+        # a reader edge to the lock-order graph for no benefit.
+        with self._lock:
+            counters = dict(self.counters)
+            admission = self.admission.stats()
+            batcher = self.batcher.stats()
+            breakers = {
                 m: {"state": br.state, "trips": br.trip_count,
                     "consecutive_failures": br.consecutive_failures}
-                for m, br in sorted(dict(self.breakers).items())},
-            "latency": {k: h.to_json()
-                        for k, h in sorted(dict(self.latency).items())},
-            # per-tenant p50/p99 from the admission layer's tenant id
-            # (ROADMAP item 1a): readable straight from /stats
-            "tenant_latency": {
+                for m, br in sorted(self.breakers.items())}
+            latency = {k: h.to_json()
+                       for k, h in sorted(self.latency.items())}
+            tenant_latency = {
                 t: {"count": h.count,
                     "p50_s": round(h.quantile(0.5), 6),
                     "p99_s": round(h.quantile(0.99), 6)}
-                for t, h in sorted(dict(self.tenant_latency).items())},
+                for t, h in sorted(self.tenant_latency.items())}
+        shed_rate = counters["shed"] / max(counters["submitted"], 1)
+        return {
+            "counters": counters,
+            "shed_rate": round(shed_rate, 6),
+            "admission": admission,
+            "batcher": batcher,
+            "breakers": breakers,
+            "latency": latency,
+            # per-tenant p50/p99 from the admission layer's tenant id
+            # (ROADMAP item 1a): readable straight from /stats
+            "tenant_latency": tenant_latency,
             # per-tenant distribution skew (PSI vs the training
             # reference profile) from each live model's SkewMonitor,
             # next to the latency percentiles for the same tenant ids

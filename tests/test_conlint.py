@@ -1,0 +1,539 @@
+"""Tier-1 gate for jaxlint tier C (lightgbm_tpu/analysis/conlint.py +
+analysis/schedule.py, tools/jaxlint.py --tier c).
+
+Static direction: the threaded planes must be CLEAN against the
+committed ``tier_c`` baseline table (goal state: empty — every
+surviving site pragma-documented in code), and each rule CL001–CL004
+must actually fire on an injected violation (fixture modules below),
+including through the subprocess rc contract.
+
+Dynamic direction: the seeded cooperative schedule explorer must
+(a) reproduce the pre-fix torn-read shape on an UNFIXED fixture — the
+regression-test form of the ServingService.stats()/counter races fixed
+in this PR — and never on the fixed twin, (b) reproduce a 2-cycle
+lock-order inversion as a deterministic deadlock, (c) validate the
+continual runtime's "done flips LAST" handoff protocol (runtime.py's
+background retrain holder) by provoking the inverted write order, and
+(d) run the three real serving-plane drills deterministically: same
+seed, byte-identical report.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+sys.path.insert(0, REPO)
+
+from lightgbm_tpu.analysis import baseline, conlint  # noqa: E402
+from lightgbm_tpu.analysis.schedule import (  # noqa: E402
+    SCHEDULE_SCENARIOS, Scheduler, instrument_service, report_bytes,
+    run_schedule_drill)
+
+BASELINE = baseline.load(os.path.join(REPO, "jaxlint_baseline.json"))
+
+
+# ---------------------------------------------------------------------------
+# static half: the repo vs the committed ratchet
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def tier_c_counts():
+    return conlint.finding_counts(conlint.lint_tree(REPO))
+
+
+def test_tier_c_baseline_is_committed():
+    assert BASELINE.get("tier_c") is not None, \
+        "jaxlint_baseline.json must carry the tier_c table"
+
+
+def test_tier_c_clean_against_baseline(tier_c_counts):
+    problems = baseline.compare_tier_c(tier_c_counts, BASELINE)
+    assert not problems, "\n".join(p.render() for p in problems)
+
+
+def test_fixed_serving_races_stay_fixed(tier_c_counts):
+    """The CL001s fixed in this PR (lock-free counter writes on the
+    dispatch path, the lock-free stats() publish) must not come back —
+    and must NOT be pinned in the baseline either."""
+    for qual in ("ServingService.submit", "ServingService.stats",
+                 "ServingService._dispatch", "ServingService._complete",
+                 "ServingService._fail_all"):
+        key = f"CL001:lightgbm_tpu/serving/service.py:{qual}"
+        assert tier_c_counts.get(key, 0) == 0, key
+        assert BASELINE["tier_c"].get(key, 0) == 0, key
+
+
+# ---------------------------------------------------------------------------
+# static half: each rule fires on an injected violation
+# ---------------------------------------------------------------------------
+FX_PATH = "lightgbm_tpu/serving/_fixture.py"
+
+FX_CL001 = '''
+import threading
+
+class Svc:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters = {"a": 0}
+
+    def hit(self):
+        with self._lock:
+            self.counters["a"] += 1
+
+    def leak(self):
+        self.counters["a"] += 1
+
+    def stats(self):
+        return dict(self.counters)
+'''
+
+FX_CL002 = '''
+import threading
+
+class AB:
+    def __init__(self):
+        self._x = threading.Lock()
+        self._y = threading.Lock()
+
+    def xy(self):
+        with self._x:
+            with self._y:
+                pass
+
+    def yx(self):
+        with self._y:
+            with self._x:
+                pass
+'''
+
+FX_CL003 = '''
+import time
+import threading
+
+class Stopper:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._worker = None
+
+    def stop(self):
+        with self._lock:
+            time.sleep(0.1)
+            self._worker.join()
+'''
+
+FX_CL004 = '''
+import threading
+
+class Waiter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+
+    def take(self):
+        with self._cv:
+            self._cv.wait()
+'''
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+def test_cl001_unguarded_write_and_publish_fire():
+    fs = conlint.lint_source(FX_CL001, FX_PATH)
+    assert _rules(fs) == ["CL001"]
+    quals = sorted(f.func for f in fs)
+    assert quals == ["Svc.leak", "Svc.stats"], quals
+    kinds = {f.func: f.message for f in fs}
+    assert "written" in kinds["Svc.leak"]
+    assert "aggregate read" in kinds["Svc.stats"]
+
+
+def test_cl002_two_cycle_inversion_fires():
+    fs = conlint.lint_source(FX_CL002, FX_PATH)
+    assert _rules(fs) == ["CL002"]
+    # one finding per edge of the cycle
+    assert len(fs) == 2
+    assert {f.func for f in fs} == {"AB.xy", "AB.yx"}
+
+
+def test_cl002_cross_class_cycle_fires():
+    """service->registry->service through annotated attr types: the
+    cross-class edge construction the real serving plane relies on."""
+    src = '''
+import threading
+
+class Registry:
+    def __init__(self, svc: Service):
+        self._rlock = threading.Lock()
+        self.svc = svc
+
+    def publish(self):
+        with self._rlock:
+            self.svc.poke()
+
+class Service:
+    def __init__(self, registry: Registry):
+        self._slock = threading.Lock()
+        self.registry = registry
+
+    def poke(self):
+        with self._slock:
+            pass
+
+    def pump(self):
+        with self._slock:
+            self.registry.publish()
+'''
+    fs = conlint.lint_source(src, FX_PATH)
+    assert "CL002" in _rules(fs), [f.render() for f in fs]
+
+
+def test_cl003_blocking_under_lock_fires():
+    fs = conlint.lint_source(FX_CL003, FX_PATH)
+    assert _rules(fs) == ["CL003"]
+    whats = sorted(f.message for f in fs)
+    assert len(fs) == 2                  # sleep + join
+    assert any("time.sleep" in w for w in whats)
+    assert any(".join()" in w for w in whats)
+
+
+def test_cl004_predicate_free_wait_fires():
+    fs = conlint.lint_source(FX_CL004, FX_PATH)
+    assert _rules(fs) == ["CL004"]
+    # and the repaired form — wait inside a while — is clean
+    fixed = FX_CL004.replace(
+        "        with self._cv:\n            self._cv.wait()",
+        "        with self._cv:\n            while self._worker:\n"
+        "                self._cv.wait()")
+    assert conlint.lint_source(fixed, FX_PATH) == []
+
+
+def test_pragma_suppresses_exactly_its_rule():
+    ok = FX_CL001.replace("return dict(self.counters)",
+                          "return dict(self.counters)  # conlint: ok=CL001")
+    fs = conlint.lint_source(ok, FX_PATH)
+    assert sorted(f.func for f in fs) == ["Svc.leak"]
+    # a pragma for a DIFFERENT rule must not suppress
+    other = FX_CL001.replace("return dict(self.counters)",
+                             "return dict(self.counters)  # conlint: ok=CL003")
+    fs = conlint.lint_source(other, FX_PATH)
+    assert "Svc.stats" in {f.func for f in fs}
+
+
+def test_out_of_scope_paths_are_skipped():
+    assert conlint.lint_source(FX_CL001,
+                               "lightgbm_tpu/models/metric.py") == []
+
+
+def test_caller_holds_lock_inheritance_stays_quiet():
+    """Telemetry._event's contract: a private method whose every call
+    site holds the lock is analyzed as holding it — no pragma needed."""
+    src = '''
+import threading
+
+class Tel:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.events = []
+
+    def record(self, ev):
+        with self._lock:
+            self._event(ev)
+
+    def instant(self, ev):
+        with self._lock:
+            self._event(ev)
+
+    def _event(self, ev):
+        self.events.append(ev)
+'''
+    assert conlint.lint_source(src, FX_PATH) == []
+
+
+# ---------------------------------------------------------------------------
+# ratchet semantics
+# ---------------------------------------------------------------------------
+def test_ratchet_fails_on_new_and_stale_pins():
+    measured = conlint.finding_counts(
+        conlint.lint_source(FX_CL001, FX_PATH))
+    # new finding vs an empty table
+    probs = baseline.compare_tier_c(measured, {"tier_c": {}})
+    assert probs and all(p.kind == "new" for p in probs)
+    # exact pin: clean
+    assert baseline.compare_tier_c(measured, {"tier_c": dict(measured)}) \
+        == []
+    # stale pin: a ghost key that no longer measures fails too
+    stale = dict(measured)
+    stale["CL001:lightgbm_tpu/serving/ghost.py:Ghost.stats"] = 1
+    probs = baseline.compare_tier_c(measured, {"tier_c": stale})
+    assert [p.kind for p in probs] == ["stale"]
+
+
+# ---------------------------------------------------------------------------
+# subprocess rc contract
+# ---------------------------------------------------------------------------
+def _jaxlint(*argv):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "jaxlint.py"),
+         *argv],
+        capture_output=True, text=True, timeout=300)
+
+
+def test_cli_tier_c_clean_on_repo():
+    r = _jaxlint("--tier", "c", "--check")
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_cli_tier_c_fails_on_injected_fixture_tree(tmp_path):
+    pkg = tmp_path / "lightgbm_tpu" / "serving"
+    pkg.mkdir(parents=True)
+    (pkg / "bad.py").write_text(
+        FX_CL001 + FX_CL002 + FX_CL003 + FX_CL004)
+    r = _jaxlint("--tier", "c", "--check", "--json",
+                 "--root", str(tmp_path))
+    assert r.returncode == 1, r.stdout + r.stderr
+    recs = [json.loads(ln) for ln in r.stdout.splitlines() if ln]
+    rules = {rec["rule"] for rec in recs if rec.get("tier") == "C"}
+    assert rules == {"CL001", "CL002", "CL003", "CL004"}, rules
+    assert all(rec.get("tier") == "C" or "problem" in rec
+               for rec in recs)
+
+
+def test_cli_tier_c_fails_on_stale_pin(tmp_path):
+    bl = {"version": 1, "tier_a": {}, "tier_b": {}, "tier_c":
+          {"CL001:lightgbm_tpu/serving/ghost.py:Ghost.stats": 1}}
+    path = tmp_path / "baseline.json"
+    path.write_text(json.dumps(bl))
+    r = _jaxlint("--tier", "c", "--check", "--baseline", str(path))
+    assert r.returncode == 1
+    assert "stale" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# dynamic half: scheduler fixtures (regression form of the fixed races)
+# ---------------------------------------------------------------------------
+FX_TORN = '''
+import threading
+
+class MiniService:
+    """The pre-fix ServingService shape: counters written lock-free on
+    the serve path, published lock-free by stats()."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters = {"submitted": 0, "served": 0}
+
+    def reset(self):
+        with self._lock:
+            self.counters = {"submitted": 0, "served": 0}
+
+    def tick(self):
+        self.counters["submitted"] += 1
+        self.counters["served"] += 1
+
+    def stats(self):
+        return dict(self.counters)
+'''
+
+FX_TORN_FIXED = '''
+import threading
+
+class MiniService:
+    """The post-fix shape: every write and the publish hold the lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters = {"submitted": 0, "served": 0}
+
+    def reset(self):
+        with self._lock:
+            self.counters = {"submitted": 0, "served": 0}
+
+    def tick(self):
+        with self._lock:
+            self.counters["submitted"] += 1
+            self.counters["served"] += 1
+
+    def stats(self):
+        with self._lock:
+            return dict(self.counters)
+'''
+
+
+def _mini(src, filename):
+    ns = {}
+    exec(compile(src, filename, "exec"), ns)   # noqa: S102 — fixture
+    return ns["MiniService"]()
+
+
+def _run_torn(src, seed, filename):
+    """One seeded run: a writer ticking the invariant-coupled counter
+    pair against an atomic reader; returns (torn, schedule)."""
+    findings = conlint.lint_source(src, FX_PATH)
+    sched = Scheduler(seed=seed)
+    svc = _mini(src, filename)
+    svc._lock = sched.lock("mini._lock")
+    seen = []
+
+    def writer():
+        for _ in range(3):
+            svc.tick()
+
+    def reader():
+        for _ in range(2):
+            seen.append(svc.stats())
+
+    sched.spawn("writer", writer)
+    sched.spawn("reader", reader)
+    sched.watch_findings(findings, filename)
+    sched.run()
+    sched.check()
+    torn = any(s["submitted"] != s["served"] for s in seen)
+    return torn, list(sched.schedule)
+
+
+def test_explorer_reproduces_prefix_torn_read():
+    """The static pass finds the CL001 lines, the explorer interleaves
+    at exactly those lines, and SOME seed exposes the torn pair — on
+    the unfixed fixture only.  This is the regression test for the
+    stats()/counter races fixed in this PR."""
+    findings = conlint.lint_source(FX_TORN, FX_PATH)
+    assert {f.rule for f in findings} == {"CL001"}, \
+        [f.render() for f in findings]
+    seeds = range(30)
+    torn_seeds = [s for s in seeds
+                  if _run_torn(FX_TORN, s, "<fx-torn>")[0]]
+    assert torn_seeds, "no seed in range(30) provoked the torn read"
+    # the fixed twin is CL001-clean AND never torn on the same seeds
+    assert conlint.lint_source(FX_TORN_FIXED, FX_PATH) == []
+    for s in torn_seeds[:5]:
+        torn, _ = _run_torn(FX_TORN_FIXED, s, "<fx-torn-fixed>")
+        assert not torn, f"fixed fixture torn at seed {s}"
+    # determinism: the provoking seed replays the identical schedule
+    s = torn_seeds[0]
+    a = _run_torn(FX_TORN, s, "<fx-torn>")
+    b = _run_torn(FX_TORN, s, "<fx-torn>")
+    assert a == b
+
+
+def _run_inversion(seed):
+    sched = Scheduler(seed=seed)
+    x = sched.lock("X")
+    y = sched.lock("Y")
+
+    def xy():
+        with x:
+            with y:
+                pass
+
+    def yx():
+        with y:
+            with x:
+                pass
+
+    sched.spawn("xy", xy)
+    sched.spawn("yx", yx)
+    sched.run()
+    return sched
+
+
+def test_explorer_reproduces_lock_order_inversion_deadlock():
+    """The dynamic form of CL002: opposite acquisition order deadlocks
+    under some schedule, deterministically per seed."""
+    dead = [s for s in range(20)
+            if _run_inversion(s).deadlock is not None]
+    assert dead, "no seed in range(20) deadlocked the 2-cycle"
+    s = dead[0]
+    a, b = _run_inversion(s), _run_inversion(s)
+    assert a.deadlock == b.deadlock
+    assert a.schedule == b.schedule
+    # the deadlock report names both locks (the wait-for cycle)
+    assert set(a.deadlock["blocked"].values()) == {"X", "Y"}
+
+
+FX_HANDOFF = '''
+class Handoff:
+    """Replica of continual/runtime.py's background-retrain holder
+    protocol: one writer, lock-free dict stores, done flips LAST."""
+
+    def __init__(self):
+        self.holder = {"done": False}
+
+    def worker_good(self):
+        self.holder["result"] = 42
+        self.holder["done"] = True
+
+    def worker_bad(self):
+        self.holder["done"] = True
+        self.holder["result"] = 42
+
+    def poll(self):
+        if self.holder.get("done"):
+            return self.holder.get("result")
+        return "pending"
+'''
+
+
+def _run_handoff(worker_name, seed):
+    filename = f"<fx-handoff-{worker_name}>"
+    ns = {}
+    exec(compile(FX_HANDOFF, filename, "exec"), ns)  # noqa: S102
+    h = ns["Handoff"]()
+    sched = Scheduler(seed=seed)
+    lines = [i for i, ln in enumerate(FX_HANDOFF.splitlines(), 1)
+             if 'self.holder["' in ln]
+    sched.watch_lines(filename, lines)
+    polled = []
+
+    def poller():
+        for _ in range(4):
+            polled.append(h.poll())
+
+    sched.spawn("worker", getattr(h, worker_name))
+    sched.spawn("poller", poller)
+    sched.run()
+    sched.check()
+    return polled
+
+
+def test_handoff_done_flips_last_protocol():
+    """runtime.py:~548's documented invariant, replayed under permuted
+    interleavings: writing done BEFORE result lets a poll read a
+    missing result; the real order never does."""
+    bad_seeds = [s for s in range(30)
+                 if None in _run_handoff("worker_bad", s)]
+    assert bad_seeds, "inverted write order never produced a torn poll"
+    for s in bad_seeds[:5]:
+        got = _run_handoff("worker_good", s)
+        assert None not in got, (s, got)
+        assert all(g in ("pending", 42) for g in got)
+
+
+# ---------------------------------------------------------------------------
+# dynamic half: real serving-plane drills
+# ---------------------------------------------------------------------------
+def test_schedule_drills_fixed_seed():
+    for scenario in SCHEDULE_SCENARIOS:
+        rep = run_schedule_drill(scenario, seed=1)
+        assert rep["deadlock"] is None
+        assert all(m in ("v1", "v2") for m in rep["matched"]), rep
+
+
+def test_schedule_drill_byte_identical_reports():
+    a = run_schedule_drill("publish_pump", seed=3)
+    b = run_schedule_drill("publish_pump", seed=3)
+    assert report_bytes(a) == report_bytes(b)
+
+
+@pytest.mark.slow
+def test_schedule_drill_seed_sweep():
+    """Wider interleaving search (out of the tier-1 window): every
+    scenario, many seeds, every invariant asserted inside the drill."""
+    for scenario in SCHEDULE_SCENARIOS:
+        for seed in range(12):
+            rep = run_schedule_drill(scenario, seed=seed)
+            assert rep["deadlock"] is None

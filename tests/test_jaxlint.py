@@ -24,7 +24,7 @@ import pytest
 REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
 sys.path.insert(0, REPO)
 
-from lightgbm_tpu.analysis import astlint, baseline  # noqa: E402
+from lightgbm_tpu.analysis import astlint, baseline, conlint  # noqa: E402
 
 BASELINE = baseline.load(os.path.join(REPO, "jaxlint_baseline.json"))
 
@@ -41,6 +41,16 @@ def test_baseline_is_committed():
     assert BASELINE.get("tier_a") is not None
     assert BASELINE.get("tier_b"), \
         "jaxlint_baseline.json must pin the tier B budgets"
+    assert BASELINE.get("tier_c") is not None, \
+        "jaxlint_baseline.json must carry the tier_c table"
+
+
+def test_tier_c_clean_against_baseline():
+    """The tier-C concurrency gate (full rule/fixture coverage lives
+    in tests/test_conlint.py; this is the suite-level clean check)."""
+    measured = conlint.finding_counts(conlint.lint_tree(REPO))
+    problems = baseline.compare_tier_c(measured, BASELINE)
+    assert not problems, "\n".join(p.render() for p in problems)
 
 
 def test_tier_a_clean_against_baseline(tier_a_counts):

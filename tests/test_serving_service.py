@@ -430,7 +430,7 @@ def test_http_endpoints_round_trip(tmp_path):
     import urllib.error
     import urllib.request
 
-    from lightgbm_tpu.serving.httpd import serve_in_background
+    from lightgbm_tpu.serving.httpd import serve_in_background, shutdown_server
     v1, X = _train(seed=81)
     v2, _ = _train(seed=82, rounds=6)
     path2 = str(tmp_path / "v2.txt")
@@ -438,7 +438,7 @@ def test_http_endpoints_round_trip(tmp_path):
     reg = ModelRegistry()
     svc = ServingService(reg, flush_rows=128, max_delay=0.002)
     reg.publish("default", v1, gate_rows=X[:128])
-    server, _th = serve_in_background(svc, port=0)
+    server, th = serve_in_background(svc, port=0)
     host, port = server.server_address[:2]
     url = f"http://{host}:{port}"
 
@@ -487,9 +487,9 @@ def test_http_endpoints_round_trip(tmp_path):
         except urllib.error.HTTPError as exc:
             assert exc.code == 400
     finally:
-        server.shutdown()
-        server.server_close()
-        svc.stop()
+        # deadline-bounded, lock-free teardown (conlint CL003 contract)
+        clean = shutdown_server(server, th, svc)
+    assert clean, "HTTP serve thread failed to exit inside the deadline"
 
 
 def test_http_admin_token_gates_operator_endpoints(tmp_path):
@@ -528,9 +528,8 @@ def test_http_admin_token_gates_operator_endpoints(tmp_path):
                          "X-Admin-Token": "sesame"}), timeout=10) as r:
             assert json.loads(r.read())["version"] == 2
     finally:
-        server.shutdown()
-        server.server_close()
-        svc.stop()
+        from lightgbm_tpu.serving.httpd import shutdown_server
+        shutdown_server(server, service=svc)
 
 
 def test_wrong_width_requests_rejected_never_trip_breaker():

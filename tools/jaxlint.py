@@ -8,13 +8,15 @@
                                              # budget metric and problem
     python tools/jaxlint.py --tier a         # AST lint only (fast)
     python tools/jaxlint.py --tier b         # artifact budgets only
+    python tools/jaxlint.py --tier c         # concurrency lint only (fast)
     python tools/jaxlint.py --update-baseline  # rewrite the ratchet
 
-Tier A findings and Tier B budgets are compared against the committed
-``jaxlint_baseline.json`` (see lightgbm_tpu/analysis/baseline.py for
-the ratchet rules).  Tier B compiles the designated entry points on the
-current backend, so run it with ``JAX_PLATFORMS=cpu`` for the
-tier-1-equivalent numbers.
+Tier A/C findings and Tier B budgets are compared against the
+committed ``jaxlint_baseline.json`` (see
+lightgbm_tpu/analysis/baseline.py for the ratchet rules).  Tiers A and
+C are pure-stdlib AST passes; tier B compiles the designated entry
+points on the current backend, so run it with ``JAX_PLATFORMS=cpu``
+for the tier-1-equivalent numbers.
 """
 
 from __future__ import annotations
@@ -55,23 +57,26 @@ def main(argv=None) -> int:
                     help="exit non-zero on any non-baseline finding")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="one machine-readable JSON line per finding")
-    ap.add_argument("--tier", choices=("a", "b", "all"), default="all")
+    ap.add_argument("--tier", choices=("a", "b", "c", "all"),
+                    default="all")
     ap.add_argument("--update-baseline", action="store_true")
     ap.add_argument("--root", default=REPO_ROOT)
     ap.add_argument("--baseline", default=None,
                     help="baseline path (default: <root>/jaxlint_baseline.json)")
     args = ap.parse_args(argv)
 
-    if args.tier == "a":
-        # Tier A is pure stdlib: load the lint modules straight from
-        # their files so a lint-only run (CI fast lane, pre-commit)
-        # never pays the package's jax import
+    if args.tier in ("a", "c"):
+        # Tiers A and C are pure stdlib: load the lint modules straight
+        # from their files so a lint-only run (CI fast lane,
+        # pre-commit) never pays the package's jax import
         astlint = _load_standalone("jaxlint_astlint",
                                    "lightgbm_tpu/analysis/astlint.py")
+        conlint = _load_standalone("jaxlint_conlint",
+                                   "lightgbm_tpu/analysis/conlint.py")
         baseline = _load_standalone("jaxlint_baseline_mod",
                                     "lightgbm_tpu/analysis/baseline.py")
     else:
-        from lightgbm_tpu.analysis import astlint, baseline
+        from lightgbm_tpu.analysis import astlint, baseline, conlint
 
     bl_path = args.baseline or os.path.join(args.root,
                                             baseline.DEFAULT_BASELINE)
@@ -80,6 +85,8 @@ def main(argv=None) -> int:
     findings = []
     counts = {}
     tier_b = {}
+    c_findings = []
+    c_counts = {}
 
     if args.tier in ("a", "all"):
         findings = astlint.lint_tree(args.root)
@@ -91,18 +98,26 @@ def main(argv=None) -> int:
         tier_b = artifacts.collect_tier_b()
         problems += baseline.compare_tier_b(tier_b, bl)
 
+    if args.tier in ("c", "all"):
+        c_findings = conlint.lint_tree(args.root)
+        c_counts = conlint.finding_counts(c_findings)
+        problems += baseline.compare_tier_c(c_counts, bl)
+
     if args.update_baseline:
         if args.tier != "all":
             print("--update-baseline needs --tier all (the baseline "
-                  "document covers both tiers)", file=sys.stderr)
+                  "document covers every tier)", file=sys.stderr)
             return 2
         baseline.save(bl_path, baseline.make(counts, tier_b,
-                                             headroom=TIER_B_HEADROOM))
+                                             headroom=TIER_B_HEADROOM,
+                                             tier_c_counts=c_counts))
         print(f"wrote {bl_path}")
         return 0
 
     if args.as_json:
         for f in findings:
+            print(f.to_json())
+        for f in c_findings:
             print(f.to_json())
         for check, metrics in sorted(tier_b.items()):
             budgets = bl.get("tier_b", {}).get(check, {})
@@ -119,6 +134,11 @@ def main(argv=None) -> int:
             print(f"-- tier A: {len(findings)} finding(s) "
                   f"({len(counts)} key(s); baselined keys are OK)")
             for f in findings:
+                print("  " + f.render())
+        if c_findings:
+            print(f"-- tier C: {len(c_findings)} finding(s) "
+                  f"({len(c_counts)} key(s); baselined keys are OK)")
+            for f in c_findings:
                 print("  " + f.render())
         if tier_b:
             print("-- tier B artifact budgets")
