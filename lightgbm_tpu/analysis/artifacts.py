@@ -27,6 +27,11 @@ would otherwise catch fail tier-1 instead:
 * ``shap.kernel`` — the device TreeSHAP program keeps its unrolled
   D/q-loop structure (at most the single tree scan ``while``), runs
   f64 under the scoped x64 context, and contains no host callbacks.
+* ``linear.gain`` — constant-gain tree builds lower op-for-op
+  identically with the piece-wise-linear (leafwise_gain) machinery in
+  the codebase: ``linear_tree=True`` in refit mode may not change the
+  fused while-body by a single op, and the leafwise body itself keeps
+  a pinned op count.
 * ``continual.tick`` — steady-state continual-runtime ticks add zero
   serving retraces (the in-place refit rides the leaf-refresh fast
   path) and a hot swap compiles each (kind, bucket) at most once,
@@ -547,6 +552,45 @@ def check_perfwatch_off() -> Dict[str, int]:
 
 
 # ---------------------------------------------------------------------------
+# piece-wise-linear gain: constant-mode lowering invariant
+# ---------------------------------------------------------------------------
+def check_linear_gain() -> Dict[str, int]:
+    """The leafwise-gain machinery (models/learner.py NLF_LINEAR rows,
+    ops/split.py:find_best_split_linear) must be invisible to constant
+    trees: the tree-build while body lowers OP-FOR-OP identically
+    between a plain config and ``linear_tree=True`` in the default
+    (refit) mode — the refit happens post-hoc on the host, so the
+    device program may not change by a single op.  The ``_nlf`` gate
+    is a Python-level branch; if it ever leaks into the trace (e.g. an
+    unconditional 28-row leafmat), these deltas light up.
+    ``leafwise_total_ops`` additionally pins that the leafwise body
+    keeps compiling, as a drifting count with headroom.  (The fused
+    single-program step is off under linear_tree, so the lowering
+    vehicle is the tree-build body itself, same as
+    ``while_body.default``.)"""
+    from .hlo import report
+
+    plain = report({})
+    refit = report({"linear_tree": True})
+    leafwise = report(
+        {"linear_tree": True, "linear_tree_mode": "leafwise_gain"})
+    keys = set(plain["ops"]) | set(refit["ops"])
+    hist_delta = sum(abs(plain["ops"].get(k, 0) - refit["ops"].get(k, 0))
+                     for k in keys)
+    shape_keys = set(plain["copies_by_shape"]) | \
+        set(refit["copies_by_shape"])
+    shape_delta = sum(abs(plain["copies_by_shape"].get(k, 0)
+                          - refit["copies_by_shape"].get(k, 0))
+                      for k in shape_keys)
+    return {"body_op_histogram_delta": hist_delta,
+            "total_ops_delta": abs(plain["total_ops"]
+                                   - refit["total_ops"]),
+            "copies_delta": abs(plain["copies"] - refit["copies"]),
+            "copy_shape_histogram_delta": shape_delta,
+            "leafwise_total_ops": leafwise["total_ops"]}
+
+
+# ---------------------------------------------------------------------------
 # continual-runtime tick/swap budgets
 # ---------------------------------------------------------------------------
 def check_continual_tick() -> Dict[str, int]:
@@ -598,6 +642,7 @@ CHECKS = {
     "train.residency": check_train_residency,
     "shap.kernel": check_shap_kernel,
     "continual.tick": check_continual_tick,
+    "linear.gain": check_linear_gain,
     "telemetry.off": check_telemetry_off,
     "health.off": check_health_off,
     "perfwatch.off": check_perfwatch_off,

@@ -272,6 +272,15 @@ _PARAMS: List[_Param] = [
     _p("stochastic_rounding", True, bool),
     # --- IO / dataset ---
     _p("linear_tree", False, bool, ("linear_trees",)),
+    # piece-wise linear trees: "refit" keeps the historical behaviour
+    # (tree structure chosen by constant-leaf gain, leaf-local linear
+    # models fit post-hoc on the host); "leafwise_gain" computes split
+    # gain over leaf-local linear models inside the device search
+    # (ops/split.py:find_best_split_linear) so the STRUCTURE itself is
+    # PL-aware, and the per-leaf models come out of the winning split
+    # candidates — no extra data pass.  Ineligible configs (see
+    # learner._linear_gain_eligible) fall back to refit with a warning
+    _p("linear_tree_mode", "refit", str),
     _p("max_bin", 255, int, ("max_bins",), ">1"),
     _p("max_bin_by_feature", "", str),
     _p("min_data_in_bin", 3, int, (), ">0"),
@@ -638,6 +647,12 @@ class Config:
                 "auto", "fixed", "adaptive", ""):
             log.warning("unknown tpu_chunk_policy=%r; treating as auto",
                         self.tpu_chunk_policy)
+        ltm = str(self.linear_tree_mode).strip().lower() or "refit"
+        if ltm not in ("refit", "leafwise_gain"):
+            log.warning("unknown linear_tree_mode=%r; treating as refit",
+                        self.linear_tree_mode)
+            ltm = "refit"
+        self.linear_tree_mode = ltm
         self.objective = _OBJECTIVE_ALIASES.get(
             str(self.objective).lower(), str(self.objective).lower())
         # boosting aliases; "goss" boosting folds into gbdt + goss strategy

@@ -33,6 +33,10 @@ from .serving import ServingEngine
 from .tree import Tree, tree_from_device_record
 
 K_EPSILON = 1e-15
+# linear-leaf refit: relative ridge added to the normal-equation
+# diagonal so near-singular systems degrade toward the constant leaf
+# instead of emitting large coefficients (_fit_linear_leaves)
+_LINEAR_RIDGE_EPS = 1e-10
 
 
 import os as _os
@@ -1836,20 +1840,71 @@ class GBDT:
                 .astype(np.float64)
             ok = ~np.isnan(Xl).any(axis=1)
             Xl, gi, hi = Xl[ok], g[rows][ok], h[rows][ok]
+            if len(Xl):
+                # a constant column carries no signal but makes its
+                # normal-equation row a multiple of the intercept's:
+                # lstsq on the (numerically) singular system returned
+                # huge mutually-cancelling coefficients that explode
+                # away from the training rows.  The reference drops
+                # such features from the leaf before solving
+                # (linear_tree_learner.cpp CalculateLinear)
+                varying = np.ptp(Xl, axis=0) > 0
+                feats = [f for f, v in zip(feats, varying) if v]
+                Xl = Xl[:, varying]
             d = len(feats)
-            if len(Xl) < d + 1:
+            if d == 0 or len(Xl) < d + 1:
                 continue
             Xa = np.concatenate([Xl, np.ones((len(Xl), 1))], axis=1)
             XTHX = (Xa * hi[:, None]).T @ Xa
             XTHX[np.arange(d), np.arange(d)] += lam
+            # the reference's ridge epsilon on the whole diagonal keeps
+            # a near-singular system (collinear columns survive the
+            # constant-column drop) from emitting large coefficients
+            diag = np.arange(d + 1)
+            XTHX[diag, diag] += _LINEAR_RIDGE_EPS * (1.0 +
+                                                     XTHX[diag, diag])
             XTg = Xa.T @ gi
-            coeffs = -np.linalg.lstsq(XTHX, XTg, rcond=None)[0]
+            try:
+                coeffs = -np.linalg.solve(XTHX, XTg)
+            except np.linalg.LinAlgError:
+                continue                    # keep the constant leaf
+            if not np.all(np.isfinite(coeffs)):
+                continue                    # keep the constant leaf
             keep = np.abs(coeffs[:d]) > 1e-35   # reference: kZeroThreshold
             tree.leaf_features[leaf] = [feats[i] for i in range(d)
                                         if keep[i]]
             tree.leaf_coeff[leaf] = [float(coeffs[i] * shr)
                                      for i in range(d) if keep[i]]
             tree.leaf_const[leaf] = float(coeffs[d] * shr)
+
+    def _set_leafwise_linear(self, tree, record, num_nodes: int) -> None:
+        """linear_tree_mode=leafwise_gain: per-leaf linear models come out
+        of the device record — each leaf's (const, coeff, feature) is its
+        OWN best whole-leaf single-feature fit, read off the leaf's own
+        split search (models/learner.py LM_LIN_* rows; ops/split.py:
+        find_best_split_linear self_* fields), so there is NO extra data
+        pass and NO host solve.  ``leaf_lin_feat`` is already an ORIGINAL
+        feature id; shrinkage scales (const, coeff) exactly like the
+        refit path, and ``leaf_value`` stays the constant fallback for
+        NaN rows."""
+        tree.is_linear = True
+        num_leaves = num_nodes + 1
+        shr = self.shrinkage_rate
+        const = np.asarray(record["leaf_lin_const"],
+                           np.float64)[:num_leaves]
+        coeff = np.asarray(record["leaf_lin_coeff"],
+                           np.float64)[:num_leaves]
+        feat = np.asarray(record["leaf_lin_feat"])[:num_leaves]
+        for leaf in range(num_leaves):
+            c = float(coeff[leaf])
+            if abs(c) <= 1e-35:             # reference: kZeroThreshold
+                tree.leaf_features[leaf] = []
+                tree.leaf_coeff[leaf] = []
+                tree.leaf_const[leaf] = float(tree.leaf_value[leaf])
+            else:
+                tree.leaf_features[leaf] = [int(feat[leaf])]
+                tree.leaf_coeff[leaf] = [c * shr]
+                tree.leaf_const[leaf] = float(const[leaf]) * shr
 
     def _linear_tree_deltas(self, nodes, tree, init_score_adjust=0.0):
         """Per-row (train, [valid...]) deltas through the linear leaves;
@@ -2023,9 +2078,15 @@ class GBDT:
                 host_record, num_nodes, self.train_data.bin_mappers,
                 None, shrinkage=self.shrinkage_rate)
             if use_linear:
-                # fit on the TRUE gradients, not the quantized carriers
-                self._fit_linear_leaves(tree, record, num_nodes,
-                                        gk_true, hk_true)
+                if "leaf_lin_const" in record:
+                    # leafwise_gain: the models came out of the winning
+                    # split candidates — no host refit pass
+                    self._set_leafwise_linear(tree, record, num_nodes)
+                else:
+                    # fit on the TRUE gradients, not the quantized
+                    # carriers
+                    self._fit_linear_leaves(tree, record, num_nodes,
+                                            gk_true, hk_true)
                 self._apply_score_update_linear(nodes, tree, k)
             # fold the boost-from-average init score into the first
             # iteration's trees (reference: gbdt.cpp:408-424 AddBias /
@@ -2175,8 +2236,10 @@ class GBDT:
         jitted vmap — the TPU replacement for the reference's OpenMP
         batch predictor (predictor.hpp:30).  ``start``/``end`` slicing
         is a tree mask, so repeated serving calls never re-stack or
-        re-trace.  Returns None when this model can't take the device
-        path (loaded trees, linear leaves, no train data)."""
+        re-trace.  Piece-wise linear forests take this path too (the
+        pack carries coefficient planes and the engine applies them to
+        the raw rows).  Returns None when this model can't take the
+        device path (loaded trees, no train data)."""
         return self.serving.raw_insession(np.asarray(data),
                                           start_iteration, end_iter)
 

@@ -63,6 +63,17 @@ NEG_INF = float("-inf")
  LM_BROUT, LM_BISCAT, LM_FORCED) = range(25)
 NLF = 25
 
+# Piece-wise-linear leafwise-gain rows (linear_tree_mode=leafwise_gain
+# only): the leaf's OWN fitted linear model — const + coeff over the
+# raw value of LM_LIN_FEAT (an ORIGINAL feature id), the best
+# whole-leaf single-feature fit read off the leaf's own split search
+# (ops/split.py:find_best_split_linear self_* fields).  Constant mode
+# keeps the (NLF, L+1) leafmat — self._nlf gates the packing at Python
+# level so constant-gain bodies lower bit-identically to the
+# pre-linear build (jaxlint tier-B `linear.gain` pins this).
+(LM_LIN_CONST, LM_LIN_COEF, LM_LIN_FEAT) = range(NLF, NLF + 3)
+NLF_LINEAR = NLF + 3
+
 (ND_FEATURE, ND_FEATURE_ENUM, ND_THRESHOLD, ND_DL, ND_GAIN, ND_LEFT,
  ND_RIGHT, ND_IVALUE, ND_IWEIGHT, ND_ICOUNT, ND_COL, ND_BIN_START,
  ND_IS_BUNDLED, ND_NUM_BIN, ND_DEFAULT_BIN, ND_MISSING, ND_IS_CAT) = range(17)
@@ -581,6 +592,59 @@ class SerialTreeLearner:
                              and self.path_smooth <= 0.0
                              and self.N < (1 << 24))
 
+        # ---- piece-wise linear leafwise gain (linear_tree_mode) ----
+        # Split gain over leaf-local linear models inside the device
+        # search (ops/split.py:find_best_split_linear).  The eligibility
+        # set is the fast-search envelope minus the split refinements
+        # whose bodies re-derive candidate stats (the linear candidate's
+        # child models ride the packed winner read): ineligible configs
+        # warn once and fall back to the post-hoc refit mode, which
+        # trains exactly like before.
+        want_lin = (bool(config.linear_tree) and
+                    str(getattr(config, "linear_tree_mode", "refit"))
+                    == "leafwise_gain")
+        if want_lin:
+            lin_block = []
+            if not self._fast_search:
+                lin_block.append("categorical/monotone/CEGB/path_smooth"
+                                 "/huge-N configs")
+            if self.forced is not None:
+                lin_block.append("forced splits")
+            if parallel_mode != "serial" or axis_name is not None:
+                lin_block.append("parallel tree learners")
+            if self.l1 > 0.0:
+                lin_block.append("lambda_l1 > 0")
+            if self.max_delta_step > 0.0:
+                lin_block.append("max_delta_step > 0")
+            if self.feature_contri is not None:
+                lin_block.append("feature_contri")
+            if self.F == 0:
+                lin_block.append("no usable features")
+            if lin_block:
+                log.warning("linear_tree_mode=leafwise_gain is not "
+                            "supported with %s; falling back to the "
+                            "post-hoc refit mode", ", ".join(lin_block))
+                want_lin = False
+        self._linear_gain = want_lin
+        self.linear_lambda = float(config.linear_lambda)
+        self._nlf = NLF_LINEAR if self._linear_gain else NLF
+        self._rep_vals = None
+        if self._linear_gain:
+            # per-(feature, bin) representative raw values — the linear
+            # moment planes are rank-1 scalings of the histogram by this
+            # table (ops/histogram.py:linear_moment_planes).  Empirical
+            # within-bin means (one host pass over the retained raw
+            # matrix) rather than bin bounds: bound-reps overestimate x
+            # by up to a bin width, which measurably biases fitted
+            # slopes in wide tail bins.
+            raw = getattr(dataset, "raw_data", None)
+            rep = np.zeros((self.F, self.BF), np.float32)
+            for i, orig in enumerate(meta["feature"]):
+                col = raw[:, orig] if raw is not None else None
+                rep[i] = dataset.bin_mappers[orig].bin_rep_values(
+                    self.BF, values=col)
+            self._rep_vals = jnp.asarray(rep)
+
         # ReduceScatter histogram ownership (reference placement:
         # data_parallel_tree_learner.cpp:282-296) — see _psum.  Plain
         # fast-search geometry only; the forced/monotone/categorical
@@ -603,6 +667,9 @@ class SerialTreeLearner:
                                    and self._fast_search
                                    and self._plain_view
                                    and self.forced is None
+                                   # the pair kernel's 13-scalar tile
+                                   # carries no linear child models
+                                   and not self._linear_gain
                                    and not self.extra_trees
                                    and self.feature_contri is None
                                    and parallel_mode == "serial"
@@ -665,6 +732,9 @@ class SerialTreeLearner:
         frontier_eligible = (parallel_mode == "serial"
                              and axis_name is None
                              and self.forced is None
+                             # leafwise linear gain stays on the K=1
+                             # body (residual, see ROADMAP item 3)
+                             and not self._linear_gain
                              and not self.use_mc
                              and not self.has_cegb
                              and not self.extra_trees
@@ -783,6 +853,11 @@ class SerialTreeLearner:
         self._use_mega = None
         mega_eligible = (self._fast_search and self._plain_view
                          and self.forced is None
+                         # leafwise linear gain: the mega bodies return
+                         # the 13-scalar split tiles, not the linear
+                         # candidate's child models — residual, see
+                         # ROADMAP item 3
+                         and not self._linear_gain
                          and not self.extra_trees
                          and self.feature_contri is None
                          and parallel_mode == "serial"
@@ -1455,6 +1530,12 @@ class SerialTreeLearner:
             lazy_term = self.cegb_lazy * lazy_cnt.astype(jnp.float32)
             cegb_delta = (lazy_term if cegb_delta is None
                           else cegb_delta + lazy_term)
+        if self._linear_gain:
+            return split_ops.find_best_split_linear(
+                feat_hist, self.ctx, sum_g, sum_h, cnt,
+                self.l2, self.min_gain_to_split, self.min_data_in_leaf,
+                self.min_sum_hessian, self._rep_vals, self.linear_lambda,
+                feature_mask, rand_bins=rand_bins)
         if (self._fast_search and cegb_delta is None
                 and not with_feature_gains):
             return split_ops.find_best_split_fast(
@@ -1949,7 +2030,13 @@ class SerialTreeLearner:
             best0.right_sum_g, best0.right_sum_h,
             best0.left_output, best0.right_output,
             best0.is_cat.astype(jnp.float32), _i2f(root_forced)])
-        leafmat = jnp.zeros((NLF, L + 1), jnp.float32) \
+        if self._linear_gain:
+            # the root's own whole-leaf model from its search (a
+            # root-only tree still predicts linearly)
+            col0 = jnp.concatenate([col0, jnp.stack([
+                best0.self_const, best0.self_coeff,
+                _i2f(best0.self_feature)])])
+        leafmat = jnp.zeros((self._nlf, L + 1), jnp.float32) \
             .at[LM_BGAIN].set(jnp.float32(NEG_INF)) \
             .at[LM_CMIN].set(jnp.float32(-jnp.inf)) \
             .at[LM_CMAX].set(jnp.float32(jnp.inf)) \
@@ -2037,7 +2124,7 @@ class SerialTreeLearner:
                 has_f = jnp.any(fids >= 0)
                 forced_node = jnp.maximum(fids[f_leaf], 0)
                 fcol = jax.lax.dynamic_slice(
-                    lm, (0, f_leaf), (NLF, 1))[:, 0]
+                    lm, (0, f_leaf), (self._nlf, 1))[:, 0]
                 forced_info = self._forced_split_info(
                     self._scale_hist(st["hist"][f_leaf], hist_scale),
                     self.forced["feature"][forced_node],
@@ -2061,7 +2148,8 @@ class SerialTreeLearner:
             valid = forced_ok | ((gain > 0) & ~skip_pending)
 
             # one read of the chosen leaf's packed scalars
-            pcol = jax.lax.dynamic_slice(lm, (0, best_leaf), (NLF, 1))[:, 0]
+            pcol = jax.lax.dynamic_slice(lm, (0, best_leaf),
+                                         (self._nlf, 1))[:, 0]
 
             adv_cat_set = None
             adv_reject = jnp.bool_(False)
@@ -2528,6 +2616,15 @@ class SerialTreeLearner:
                         [head_l, seg13(best_l), _i2f(forced_l)[None]])
                     col_r = jnp.concatenate(
                         [head_r, seg13(best_r), _i2f(forced_r)[None]])
+                    if self._linear_gain:
+                        # each child's model comes from its OWN search
+                        # (best whole-leaf single-feature fit)
+                        col_l = jnp.concatenate([col_l, jnp.stack([
+                            best_l.self_const, best_l.self_coeff,
+                            _i2f(best_l.self_feature)])])
+                        col_r = jnp.concatenate([col_r, jnp.stack([
+                            best_r.self_const, best_r.self_coeff,
+                            _i2f(best_r.self_feature)])])
                 lm2 = lm.at[:, wr_a].set(col_l).at[:, wr_b].set(col_r)
 
                 iot_l1 = jax.lax.iota(jnp.int32, L + 1)
@@ -3278,6 +3375,15 @@ class SerialTreeLearner:
             "node_missing_type": ni(ND_MISSING),
             "node_is_cat": nm[ND_IS_CAT] > 0.5,
         })
+        if self._linear_gain:
+            # per-leaf linear model: const + coeff over the raw value
+            # of leaf_lin_feat (ORIGINAL feature id, from the leaf's
+            # own search — boosting._set_leafwise_linear consumes it)
+            rec.update({
+                "leaf_lin_const": lm[LM_LIN_CONST],
+                "leaf_lin_coeff": lm[LM_LIN_COEF],
+                "leaf_lin_feat": li(LM_LIN_FEAT),
+            })
         return rec
 
     # ------------------------------------------------------------------

@@ -28,12 +28,15 @@ compile on almost every call.  The engine removes both costs:
   guard tests and ``tools/profile_predict.py``.
 
 Prediction kinds served: ``raw_score`` (in-session bin-space and
-loaded threshold-index forests), ``pred_leaf``, ``pred_contrib``
-(ops/shap.py vectorized TreeSHAP, f64 under an x64 context), and
+loaded threshold-index forests — including piece-wise LINEAR forests,
+whose per-leaf models ride (T, L, J) coefficient planes applied by one
+FMA over the caller's raw rows after the ordinary traversal; see
+``_insession_pack``), ``pred_leaf``, ``pred_contrib`` (ops/shap.py
+vectorized TreeSHAP, f64 under an x64 context), and
 ``pred_early_stop`` (block-masked device accumulation).  Anything the
-device cannot serve exactly (linear leaves, EFB-bundled categoricals
-without an OOV sentinel, loaded models for SHAP) falls back to the
-host paths, which remain the oracles.
+device cannot serve exactly (EFB-bundled categoricals without an OOV
+sentinel, loaded models for SHAP, loaded or SHAP'd/early-stopped
+linear models) falls back to the host paths, which remain the oracles.
 """
 
 from __future__ import annotations
@@ -257,6 +260,13 @@ class ServingEngine:
                 # stale or structurally changed: no fast path
                 self._packs.pop(name, None)
                 continue
+            if name == "insession" and pack.get("is_linear"):
+                # a refit rewrites linear leaves as constants (the host
+                # trees drop their models) — the coefficient planes are
+                # wholesale stale, so rebuild lazily instead of
+                # refreshing deltas nothing reads
+                self._packs.pop(name, None)
+                continue
             # refresh OUT OF PLACE and install with one reference
             # assignment: a concurrent predict grabs the pack once per
             # call, so it sees all-old or all-new leaf values — never
@@ -334,6 +344,27 @@ class ServingEngine:
                 return forest_tensor.raw_from_leaves(deltas, leaves,
                                                      mask)
             static = ("max_depth",)
+        elif kind == "raw_linear":
+            # piece-wise linear forests: same traversal, then the
+            # coefficient-plane FMA over the caller's raw rows.  Trace
+            # label stays "raw" — the per-(kind, bucket) compile-count
+            # pins are representation-agnostic, like the layered path.
+            def f(nodes, linear, mask, binned, raw_aug):
+                eng._count_trace("raw", binned.shape[0])
+                leaves = jax.vmap(
+                    lambda nd: predict_leaf_binned(binned, nd))(nodes)
+                return forest_tensor.linear_from_leaves(
+                    raw_aug, leaves, linear["const"], linear["coeff"],
+                    linear["fid"], linear["fallback"], mask)
+        elif kind == "raw_linear_layered":
+            def f(layers, linear, mask, binned, raw_aug, max_depth):
+                eng._count_trace("raw", binned.shape[0])
+                leaves = forest_tensor.predict_leaf_layered(
+                    binned, layers, max_depth)
+                return forest_tensor.linear_from_leaves(
+                    raw_aug, leaves, linear["const"], linear["coeff"],
+                    linear["fid"], linear["fallback"], mask)
+            static = ("max_depth",)
         elif kind == "leaf":
             def f(nodes, binned):
                 eng._count_trace("leaf", binned.shape[0])
@@ -369,12 +400,28 @@ class ServingEngine:
             if static else jax.jit(f)
         return self._fns[kind]
 
-    def _run_raw(self, sub, mask, b) -> np.ndarray:
+    def _run_raw(self, sub, mask, b, raw=None) -> np.ndarray:
         """One bucketed raw-score dispatch per class forest, through
         whichever kernel ``predict_kernel`` selects (``sub`` is a full
-        pack or a per-range sub-pack; both carry ``layers_depth``)."""
+        pack or a per-range sub-pack; both carry ``layers_depth``).
+        ``raw`` is the (bucket, F+1) sentinel-augmented raw chunk that
+        linear packs apply their coefficient planes to."""
         bd = jnp.asarray(b)
-        if self._kernel_for(sub) == "layered":
+        layered = self._kernel_for(sub) == "layered"
+        if sub.get("is_linear"):
+            rd = jnp.asarray(raw)
+            if layered:
+                fn = self._fn("raw_linear_layered")
+                d = sub["layers_depth"]
+                return np.stack(
+                    [np.asarray(fn(pk["layers"], pk["linear"], mask,
+                                   bd, rd, max_depth=d))
+                     for pk in sub["per_k"]], axis=1)
+            fn = self._fn("raw_linear")
+            return np.stack(
+                [np.asarray(fn(pk["nodes"], pk["linear"], mask, bd, rd))
+                 for pk in sub["per_k"]], axis=1)
+        if layered:
             fn = self._fn("raw_layered")
             d = sub["layers_depth"]
             return np.stack(
@@ -418,9 +465,12 @@ class ServingEngine:
 
     def _run_bucketed(self, kind: str, rows: np.ndarray, run, out_cols,
                       dtype=np.float64, max_bucket: Optional[int] = None,
-                      observe: bool = True):
+                      observe: bool = True, aux: Optional[np.ndarray] = None):
         """Pad ``rows`` (n, G) to buckets and collect ``run(padded)``
-        slices into an (n, out_cols) host array."""
+        slices into an (n, out_cols) host array.  ``aux`` is an optional
+        second row-aligned matrix (the raw rows a linear pack's FMA
+        reads) chunked and zero-padded in lockstep; when given, ``run``
+        is called as ``run(chunk, aux_chunk)``."""
         n = rows.shape[0]
         # training<->serving skew digests: for bin-space kinds the rows
         # ARE the packed bin matrix, already host-resident — one
@@ -443,6 +493,17 @@ class ServingEngine:
                 pad = np.zeros((bucket - chunk.shape[0],) + chunk.shape[1:],
                                dtype=chunk.dtype)
                 chunk = np.concatenate([chunk, pad], axis=0)
+            args = (chunk,)
+            if aux is not None:
+                a = aux[start:stop]
+                if bucket > a.shape[0]:
+                    # zero padding (never NaN): padded rows pass the
+                    # FMA's NaN test cheaply and are sliced away below
+                    a = np.concatenate(
+                        [a, np.zeros((bucket - a.shape[0],)
+                                     + a.shape[1:], dtype=a.dtype)],
+                        axis=0)
+                args = (chunk, a)
             self._count_call(kind, bucket)
             # per-(kind, bucket) latency histogram: run() materializes
             # its result to the host, so the span measures the real
@@ -450,7 +511,7 @@ class ServingEngine:
             # the name formatting)
             with (obs.span(f"serve.{kind}@{bucket}")
                   if obs.enabled() else obs.NULL):
-                out[start:stop] = run(chunk)[:stop - start]
+                out[start:stop] = run(*args)[:stop - start]
         if mon is not None and kind == "raw":
             mon.observe_margins(out)
         return out
@@ -459,8 +520,13 @@ class ServingEngine:
     # In-session forests (bin-space traversal over the training mappers)
     # ------------------------------------------------------------------
     def _insession_eligible(self) -> bool:
+        # linear-leaf forests are served too: traversal is unchanged and
+        # the per-leaf models ride coefficient planes applied by one FMA
+        # over the caller's raw rows (see _insession_pack), so the old
+        # linear_tree exclusion is gone.  SHAP and early-stop for linear
+        # models still answer from the host paths (their guards below).
         g = self.gbdt
-        return not (g.train_data is None or g.config.linear_tree
+        return not (g.train_data is None
                     or getattr(g.train_data, "bin_mappers", None) is None
                     or not g.models
                     or any(d is None for d in g.device_trees))
@@ -490,6 +556,22 @@ class ServingEngine:
         # already binds other config at build time.
         want_layers = str(getattr(g.config, "predict_kernel", "auto")
                           or "auto") != "loop"
+        # piece-wise linear forests (linear_tree, both refit and
+        # leafwise_gain): the device traversal is identical, the leaf
+        # VALUES become per-leaf FMAs over the caller's raw rows.  The
+        # coefficient planes come from the HOST trees (leaf_const /
+        # leaf_coeff / leaf_features — host and device leaf ids match,
+        # the same contract refit_leaf_values relies on): const (T, L),
+        # coeff/fid (T, L, J) with unused slots pointing fid at the
+        # appended all-zero sentinel column of the raw matrix, and
+        # fallback (T, L) = leaf_value for NaN rows.  ONE global J
+        # across classes keeps uniform shapes (one trace per bucket).
+        is_linear = any(t.is_linear for t in g.models)
+        J = 1
+        if is_linear:
+            J = max([1] + [len(f) for t in g.models
+                           for f in (t.leaf_features or [])])
+        fid_sentinel = g.max_feature_idx + 1
         per_k = []
         depth = 0
         for k in range(K):
@@ -497,7 +579,8 @@ class ServingEngine:
             host_stacked = {name: np.stack([h[0][name] for h in hk])
                             for name in hk[0][0]}
             nodes = jax.tree.map(jnp.asarray, dict(host_stacked))
-            deltas = jnp.asarray(np.stack([h[1] for h in hk]))
+            deltas_np = np.stack([h[1] for h in hk])
+            deltas = jnp.asarray(deltas_np)
             if bf16:
                 # quantized leaf plane: half the gather traffic;
                 # accumulation stays f32 (ops/forest_tensor.py
@@ -509,11 +592,41 @@ class ServingEngine:
                       if want_layers else None)
             if layers is not None:
                 depth = max(depth, layers.pop("max_depth"))
+            linear = None
+            if is_linear:
+                trees = g.models[k::K]
+                W = deltas_np.shape[1]
+                const = np.zeros((len(trees), W), np.float32)
+                coeffp = np.zeros((len(trees), W, J), np.float32)
+                fidp = np.full((len(trees), W, J), fid_sentinel,
+                               np.int32)
+                fall = np.zeros((len(trees), W), np.float32)
+                for i, t in enumerate(trees):
+                    lv = np.asarray(t.leaf_value, np.float64)
+                    m = min(len(lv), W)
+                    fall[i, :m] = lv[:m]
+                    if not t.is_linear:
+                        const[i, :m] = lv[:m]
+                        continue
+                    lc = np.asarray(t.leaf_const, np.float64)
+                    const[i, :min(len(lc), W)] = lc[:W]
+                    for lf in range(min(len(t.leaf_features), W)):
+                        fs = t.leaf_features[lf]
+                        if fs:
+                            d = len(fs)
+                            coeffp[i, lf, :d] = t.leaf_coeff[lf]
+                            fidp[i, lf, :d] = fs
+                linear = {"const": jnp.asarray(const),
+                          "coeff": jnp.asarray(coeffp),
+                          "fid": jnp.asarray(fidp),
+                          "fallback": jnp.asarray(fall)}
             per_k.append({"nodes": nodes, "deltas": deltas,
-                          "layers": layers})
+                          "layers": layers, "linear": linear})
         layered_ok = all(pk["layers"] is not None for pk in per_k)
         return {"per_k": per_k, "has_cat": has_cat, "K": K,
                 "T_k": len(g.models) // K,
+                "is_linear": is_linear,
+                "num_raw_cols": fid_sentinel + 1,
                 # ONE forest-wide unroll depth (max over classes):
                 # per-class depths would compile one program per
                 # distinct depth and break the pinned one-trace-per-
@@ -569,7 +682,10 @@ class ServingEngine:
                 "deltas": pk["deltas"][start:end],
                 "layers": (forest_tensor.slice_layered(
                     pk["layers"], start, end)
-                    if pk.get("layers") is not None else None)}
+                    if pk.get("layers") is not None else None),
+                "linear": ({n: a[start:end]
+                            for n, a in pk["linear"].items()}
+                           if pk.get("linear") is not None else None)}
 
     @staticmethod
     def _slice_loaded(pk, start: int, end: int):
@@ -617,17 +733,29 @@ class ServingEngine:
         sub = self._range_sub("insession", pack, start_iteration,
                               end_iter, self._slice_insession)
         mask = self._tree_mask(sub["T_k"], 0, sub["T_k"])
+        aux = None
+        if pack.get("is_linear"):
+            # sentinel-augmented raw rows for the coefficient-plane FMA
+            # (ops/predict.py linear_leaf_values): unused fid slots
+            # gather the appended zero column
+            F = pack["num_raw_cols"] - 1
+            raw = np.asarray(data, dtype=np.float32)
+            aux = np.concatenate(
+                [raw[:, :F], np.zeros((n, 1), np.float32)], axis=1)
 
-        def run(b):
+        def run(b, r=None):
             # one device put per chunk; the K class forests share it
-            return self._run_raw(sub, mask, b)
+            return self._run_raw(sub, mask, b, raw=r)
 
-        out = self._run_bucketed("raw", binned, run, K)
+        out = self._run_bucketed("raw", binned, run, K, aux=aux)
         # boost-from-average is folded into the first HOST tree only;
-        # the device deltas exclude it
-        for k in range(K):
-            if start_iteration == 0 and abs(g.init_scores[k]) > K_EPSILON:
-                out[:, k] += g.init_scores[k]
+        # the device deltas exclude it — EXCEPT linear packs, whose
+        # planes come from the host trees and so already carry it
+        if not pack.get("is_linear"):
+            for k in range(K):
+                if (start_iteration == 0
+                        and abs(g.init_scores[k]) > K_EPSILON):
+                    out[:, k] += g.init_scores[k]
         return out
 
     def leaves_insession(self, data: np.ndarray, start_iteration: int,
@@ -663,6 +791,11 @@ class ServingEngine:
     # -- device TreeSHAP ------------------------------------------------
     def _contrib_pack(self):
         g = self.gbdt
+        if any(t.is_linear for t in g.models):
+            # TreeSHAP over linear leaves needs the reference's
+            # path-dependent linear redistribution — the host oracle
+            # keeps serving those models
+            return None
         base = self._pack("insession", self._insession_pack)
         if base is None:
             return None
@@ -787,6 +920,12 @@ class ServingEngine:
         if ready is None:
             return None
         n, pack, binned = ready
+        if pack.get("is_linear"):
+            # the block loop re-dispatches shrinking row subsets with
+            # full-forest masks; threading aligned raw-row subsets
+            # through it buys nothing (early stop is a margin check,
+            # not a hot serving path) — host loop serves linear models
+            return None
         K = pack["K"]
         out = np.zeros((n, K), dtype=np.float64)
         # boost-from-average is folded into the first HOST tree, so the
@@ -829,6 +968,9 @@ class ServingEngine:
         if not g.models:
             return None
         trees = g.models
+        # loaded linear models stay host-served: in-session linear packs
+        # get their raw-row alignment from the training mappers, which a
+        # loaded model doesn't carry (threshold-index space only)
         if any(t.is_linear or
                (len(t.decision_type) and
                 (np.asarray(t.decision_type) & K_CATEGORICAL_MASK).any())

@@ -486,6 +486,58 @@ class BinMapper:
             return float(self.bin_2_categorical[bin_idx])
         return self.bin_upper_bound[bin_idx]
 
+    def bin_rep_values(self, width: int | None = None,
+                       values: np.ndarray | None = None) -> np.ndarray:
+        """Per-bin representative raw value for the linear moment planes
+        (linear_tree_mode=leafwise_gain, ops/split.py:
+        find_best_split_linear).
+
+        Within one bin the regressor is treated as a constant ``rep[b]``,
+        so Σx·g / Σx·h / Σx·x·h over a leaf are exact rep-value scalings
+        of the already-accumulated G/H histogram.  When ``values`` (the
+        raw training column) is given, ``rep[b]`` is the empirical
+        within-bin mean E[x | bin=b] — with unit hessians this makes
+        Σrep·h equal Σx·h exactly and leaves only the (second-order)
+        within-bin x–g covariance unmodeled.  Without it, the fallback is
+        ``bin_upper_bound[b]``, which systematically overestimates x by
+        up to one bin width and visibly biases slopes in wide tail bins.
+        The special bins carry 0.0 by contract (the search derives both
+        scan directions from ONE set of moment prefix sums, which is
+        only sound when missing rows contribute zero moment mass):
+
+          * the NaN bin (missing_type == NaN: last bin),
+          * the MISSING_ZERO default bin (rows there ARE ~0),
+          * non-finite bounds clip to ``max_val`` (the top bin's upper
+            bound is +inf).
+
+        ``width`` right-pads with zeros to the caller's BF."""
+        n = self.num_bin
+        out = np.zeros(max(int(width or 0), n), dtype=np.float32)
+        if self.bin_type == BIN_CATEGORICAL or self.is_trivial:
+            return out
+        ub = np.asarray(self.bin_upper_bound, dtype=np.float64)[:n]
+        hi = self.max_val if math.isfinite(self.max_val) else 0.0
+        lo = self.min_val if math.isfinite(self.min_val) else 0.0
+        ub = np.clip(np.nan_to_num(ub, nan=0.0, posinf=hi, neginf=lo),
+                     min(lo, hi), max(lo, hi))
+        out[:len(ub)] = ub.astype(np.float32)
+        if values is not None and len(values):
+            vals = np.asarray(values, dtype=np.float64).ravel()
+            finite = np.isfinite(vals)
+            if finite.any():
+                bins = self.values_to_bins(vals[finite])
+                cnt = np.bincount(bins, minlength=n).astype(np.float64)
+                tot = np.bincount(bins, weights=vals[finite], minlength=n)
+                filled = cnt > 0
+                out[:n][filled[:n]] = (tot[:n][filled[:n]]
+                                       / cnt[:n][filled[:n]]).astype(
+                                           np.float32)
+        if self.missing_type == MISSING_NAN:
+            out[n - 1] = 0.0
+        elif self.missing_type == MISSING_ZERO:
+            out[self.default_bin] = 0.0
+        return out
+
     def feature_info(self) -> str:
         """`feature_infos` entry for the model file (reference: gbdt_model_text)."""
         if self.is_trivial:

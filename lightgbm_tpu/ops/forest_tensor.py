@@ -50,6 +50,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from . import predict as predict_ops
+
 # beyond this depth the unrolled program stops paying for itself (and
 # compile time grows linearly); the engine falls back to the loop
 # oracle.  Depth ~ log2(num_leaves) for balanced trees: 64 covers every
@@ -267,6 +269,25 @@ def raw_from_leaves(deltas: jnp.ndarray, leaves: jnp.ndarray,
         # quantized (bf16) leaf planes accumulate in f32: the cast is
         # the only precision loss, the reduction stays f32
         vals = vals.astype(jnp.float32)
+    return jnp.sum(vals * mask[:, None], axis=0)
+
+
+def linear_from_leaves(raw_aug: jnp.ndarray, leaves: jnp.ndarray,
+                       const: jnp.ndarray, coeff: jnp.ndarray,
+                       fid: jnp.ndarray, fallback: jnp.ndarray,
+                       mask: jnp.ndarray) -> jnp.ndarray:
+    """(n,) masked raw-score sum over a stacked PIECE-WISE-LINEAR
+    forest: per-tree coefficient planes ``const`` (T, L), ``coeff`` /
+    ``fid`` (T, L, J) and the NaN-fallback plane ``fallback`` (T, L),
+    applied to the leaves of every (tree, row) pair via the per-tree
+    FMA (ops/predict.py linear_leaf_values).  ``raw_aug`` is (n, F+1)
+    with the sentinel zero column last; both traversal kernels (loop
+    and layered) feed the same (T, n) ``leaves``, so the linear
+    reduction is kernel-agnostic exactly like :func:`raw_from_leaves`."""
+    vals = jax.vmap(
+        lambda lf, c, cf, ff, fb: predict_ops.linear_leaf_values(
+            raw_aug, lf, c, cf, ff, fb))(
+        leaves, const, coeff, fid, fallback)             # (T, n)
     return jnp.sum(vals * mask[:, None], axis=0)
 
 

@@ -41,6 +41,35 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def linear_moment_planes(feat_hist, rep_vals):
+    """Per-bin linear moment planes (Σx·g, Σx·h, Σx·x·h) of a leaf,
+    derived from its already-accumulated feature-view histogram
+    (linear_tree_mode=leafwise_gain).
+
+    The naive plan — ride extra weighted columns (x·g, x·h, x²·h) in
+    the one-hot MXU matmuls above — is never necessary: the binned
+    regressor is a PER-BIN CONSTANT, so within bin b of feature f
+
+        Σ_{i in bin b} x_i·g_i = rep[f, b] · Σ_{i in bin b} g_i
+                               = rep[f, b] · hist[f, b, 0]
+
+    and likewise for the h-moments.  The moments are therefore exact
+    rank-1 scalings of the (F, BF, 2) histogram by the representative
+    value table (ops/binning.py:bin_rep_values) — zero extra matmul
+    throughput, zero extra histogram state, and the parent-minus-child
+    subtraction trick holds automatically (the derivation is linear in
+    the histogram).  ``rep_vals`` is (F, BF) f32 with 0.0 at the
+    NaN/zero-missing bins, which is what lets both split-scan
+    directions share one set of moment prefix sums (see
+    ops/split.py:find_best_split_linear).
+
+    Returns (3, F, BF): [Σx·g, Σx·h, Σx·x·h].
+    """
+    xg = rep_vals * feat_hist[..., 0]
+    xh = rep_vals * feat_hist[..., 1]
+    return jnp.stack([xg, xh, rep_vals * xh])
+
+
 def leaf_hist_slice(part_bins, part_ghi, start, cnt, *,
                     num_bins: int, row_chunk: int,
                     gblock: int = 0, dtype=jnp.float32, vary=lambda x: x,

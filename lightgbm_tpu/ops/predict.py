@@ -184,3 +184,30 @@ def predict_leaf_binned_t(binned_t: jnp.ndarray, node: dict,
         return -(final + 1)  # decode ~leaf
 
     return jax.lax.cond(num_nodes > 0, run, empty, operand=None)
+
+
+def linear_leaf_values(raw_aug: jnp.ndarray, leaf: jnp.ndarray,
+                       const: jnp.ndarray, coeff: jnp.ndarray,
+                       fid: jnp.ndarray,
+                       fallback: jnp.ndarray) -> jnp.ndarray:
+    """(n,) piece-wise-linear leaf outputs for ONE tree (reference:
+    tree.cpp PredictLinear): ``const[leaf] + Σ_j coeff[leaf, j] *
+    raw_aug[row, fid[leaf, j]]``, with rows carrying NaN in ANY of the
+    leaf's regressors falling back to the constant ``fallback[leaf]``.
+
+    ``raw_aug`` is the raw feature matrix with ONE all-zero column
+    appended: unused coefficient slots point their ``fid`` at it, so the
+    gather stays rectangular (no per-leaf feature counts), the padded
+    terms add exact zeros, and — because the sentinel column is never
+    NaN — the NaN test reduces over exactly the leaf's real regressors.
+    Non-linear leaves are encoded as all-sentinel rows with
+    ``const = leaf_value``, so one FMA serves mixed forests."""
+    c = jnp.take(const, leaf)                        # (n,)
+    fb = jnp.take(fallback, leaf)
+    cf = jnp.take(coeff, leaf, axis=0)               # (n, J)
+    ff = jnp.take(fid, leaf, axis=0)                 # (n, J)
+    x = jnp.take_along_axis(raw_aug, ff, axis=1)     # (n, J)
+    bad = jnp.any(jnp.isnan(x), axis=1)
+    lin = c + jnp.sum(cf * jnp.where(jnp.isnan(x), jnp.float32(0.0), x),
+                      axis=1)
+    return jnp.where(bad, fb, lin)

@@ -63,6 +63,35 @@ class BestSplit(NamedTuple):
     cat_set: jnp.ndarray        # (BF,) bool — feature-local bins going LEFT
 
 
+class BestSplitLinear(NamedTuple):
+    """``BestSplit`` plus the searched leaf's OWN fitted linear model
+    ``value(x) = const + coeff * x`` (linear_tree_mode=leafwise_gain):
+    the best whole-leaf single-feature fit, read off the same moment
+    prefix sums the candidate scan uses (last cumsum entry per feature
+    = whole-leaf totals — zero extra passes).  This model is what the
+    leaf predicts with if it is never split again, and its gain is the
+    shift the split candidates must beat.  ``left_output`` /
+    ``right_output`` keep the constant outputs — they stay the NaN-row
+    fallback value of the linear leaves."""
+    gain: jnp.ndarray
+    feature: jnp.ndarray
+    threshold: jnp.ndarray
+    default_left: jnp.ndarray
+    left_sum_g: jnp.ndarray
+    left_sum_h: jnp.ndarray
+    right_sum_g: jnp.ndarray
+    right_sum_h: jnp.ndarray
+    left_count: jnp.ndarray
+    right_count: jnp.ndarray
+    left_output: jnp.ndarray
+    right_output: jnp.ndarray
+    is_cat: jnp.ndarray
+    cat_set: jnp.ndarray
+    self_const: jnp.ndarray     # f32 — this leaf's model intercept
+    self_coeff: jnp.ndarray     # f32 — this leaf's model slope
+    self_feature: jnp.ndarray   # int32 — ORIGINAL feature id of the model
+
+
 def _threshold_l1(s, l1):
     return jnp.sign(s) * jnp.maximum(0.0, jnp.abs(s) - l1)
 
@@ -442,6 +471,244 @@ def find_best_split_fast(feat_hist: jnp.ndarray, ctx: SplitContext,
         right_output=leaf_output(rg, rh, *args),
         is_cat=jnp.bool_(False),
         cat_set=jnp.zeros((1,), jnp.bool_),
+    )
+
+
+def _linear_side(g, h, xg, xh, xxh, l2: float, lam: float):
+    """Closed-form leaf gain + model over ``f(x) = coeff*x + const``.
+
+    Centered ridge normal equations: with ``xm = Σxh/Σh`` the
+    h-weighted mean, the 2x2 system diagonalizes into the constant part
+    and an independent slope part over the centered regressor —
+
+        gain  = g^2/(h + l2)  +  xgc^2/(var + lam)
+        coeff = -xgc/(var + lam),  const = -g/(h + l2) - coeff*xm
+
+    where ``xgc = Σxg - xm*Σg`` and ``var = Σx^2h - xm*Σxh`` (the
+    h-weighted variance mass).  ``lam`` is ``linear_lambda`` on the
+    slope, ``l2`` stays on the (centered) intercept — the constant term
+    and NaN-fallback value therefore match the constant search exactly.
+    The centered form avoids the catastrophic f32 cancellation of the
+    raw determinant when x barely varies inside a leaf; a
+    non-positive ``var`` (constant regressor, or cancellation noise)
+    falls back to the constant model — the reference's degenerate-leaf
+    behaviour (linear_tree_learner.cpp singular-XTHX guard)."""
+    xm = xh / h
+    xgc = xg - xm * g
+    var = xxh - xm * xh
+    lin_ok = var > 0.0
+    denom = jnp.where(lin_ok, var + lam, jnp.float32(1.0))
+    coeff = jnp.where(lin_ok, -xgc / denom, jnp.float32(0.0))
+    gain = g * g / (h + l2) + jnp.where(lin_ok, xgc * xgc / denom,
+                                        jnp.float32(0.0))
+    const = -g / (h + l2) - coeff * xm
+    return gain, coeff, const
+
+
+def find_best_split_linear(feat_hist: jnp.ndarray, ctx: SplitContext,
+                           sum_g, sum_h, num_data,
+                           l2: float, min_gain_to_split: float,
+                           min_data_in_leaf: int, min_sum_hessian: float,
+                           rep_vals: jnp.ndarray, linear_lambda: float,
+                           feature_mask: jnp.ndarray | None = None,
+                           rand_bins: jnp.ndarray | None = None):
+    """Piece-wise-linear best-split search (linear_tree_mode=
+    leafwise_gain): split gain is computed over leaf-local LINEAR
+    models, vectorized over (feature, bin, direction) exactly like
+    ``find_best_split_fast`` — same masks, same candidate order, same
+    tie-breaking, same packed winner read.
+
+    The linear moment planes Σx·g, Σx·h, Σx·x·h are NOT extra matmul
+    accumulations: within one bin the (binned) regressor is a per-bin
+    constant, so each moment plane is the existing G/H histogram scaled
+    by the per-(feature, bin) representative value ``rep_vals`` (F, BF)
+    (see ops/histogram.py:linear_moment_planes — strictly cheaper than
+    accumulating extra one-hot columns, and the subtraction trick holds
+    automatically).  ``rep_vals`` must be 0 at the NaN bin and at the
+    MISSING_ZERO default bin (the rows routed by ``default_left``), so
+    both scan directions share ONE set of moment prefix sums: missing
+    rows contribute zero moment mass wherever they land.
+
+    Gain per side is the centered closed form of ``_linear_side``.
+
+    The gain shift is the searched leaf's OWN fitted model gain, not
+    the constant parent gain: the leaf already predicts with its best
+    whole-leaf single-feature model (fitted here from the per-feature
+    moment TOTALS — the last prefix-sum entry, so it is free), and a
+    split replaces that model with two children fitted on the split
+    feature only.  Shifting by the constant gain overstates every
+    candidate by (self model gain - constant gain) and measurably
+    picks splits that LOSE realized training loss — the children drop
+    the slope the parent's model carried.  With the self-model shift,
+    ``gain`` is the exact realized surrogate improvement of the split
+    (f32 histogram noise aside).
+
+    ``l1`` / ``max_delta_step`` / monotone / CEGB are ineligible for
+    this mode (the caller gates and falls back to refit).  Returns
+    ``BestSplitLinear`` — the leaf's own (const, coeff, feature) model
+    rides along for the tree builder to record."""
+    F, BF, _ = feat_hist.shape
+    G = feat_hist[..., 0]
+    H = feat_hist[..., 1]
+    sum_h_tot = sum_h + 2 * K_EPSILON
+    num_data = num_data.astype(jnp.float32) if hasattr(num_data, "astype") \
+        else jnp.float32(num_data)
+    cnt_factor = num_data / sum_h_tot
+
+    bins = jax.lax.broadcasted_iota(jnp.int32, (F, BF), 1)
+    nb = ctx.num_bin[:, None]
+    in_range = bins < nb
+    missing = ctx.missing_type[:, None]
+    dflt = ctx.default_bin[:, None]
+    is_zero_miss = missing == MISSING_ZERO
+    is_nan_miss = missing == MISSING_NAN
+    two_scan = (nb > 2) & (missing != MISSING_NONE)
+    cnt_bin = jnp.floor(H * cnt_factor + 0.5) * in_range      # f32, exact
+
+    mask_f = in_range & ~(is_zero_miss & (bins == dflt))
+    bmax = nb - 1 - (is_nan_miss & two_scan).astype(jnp.int32)
+    mask_r = (in_range & ~(two_scan & is_zero_miss & (bins == dflt)) &
+              (bins <= bmax))
+
+    z = jnp.float32(0.0)
+    rep = jnp.where(in_range, rep_vals.astype(jnp.float32), z)
+    XG = rep * G
+    XH = rep * H
+    XXH = rep * XH
+    stacked = jnp.stack([
+        jnp.where(mask_f, G, z), jnp.where(mask_f, H, z),
+        jnp.where(mask_f, cnt_bin, z),
+        jnp.where(mask_r, G, z), jnp.where(mask_r, H, z),
+        jnp.where(mask_r, cnt_bin, z),
+        XG, XH, XXH])                                         # (9, F, BF)
+    if jax.default_backend() == "tpu":
+        tri = (jax.lax.broadcasted_iota(jnp.int32, (BF, BF), 0) <=
+               jax.lax.broadcasted_iota(jnp.int32, (BF, BF), 1)
+               ).astype(jnp.float32)
+        cs = jax.lax.dot_general(
+            stacked, tri, (((2,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # (9, F, BF)
+    else:
+        cs = jnp.cumsum(stacked, axis=2)                      # (9, F, BF)
+
+    left_g_f = cs[0]
+    left_h_f = cs[1] + K_EPSILON
+    left_c_f = cs[2]
+    right_g_f = sum_g - left_g_f
+    right_h_f = sum_h_tot - left_h_f
+    right_c_f = num_data - left_c_f
+
+    right_g_r = cs[3, :, -1:] - cs[3]
+    right_h_r = cs[4, :, -1:] - cs[4] + K_EPSILON
+    right_c_r = cs[5, :, -1:] - cs[5]
+    left_g_r = sum_g - right_g_r
+    left_h_r = sum_h_tot - right_h_r
+    left_c_r = num_data - right_c_r
+
+    # moment prefix sums are direction-agnostic (missing rows carry
+    # zero moment mass): left = inclusive prefix, right = total - left
+    lxg, lxh, lxxh = cs[6], cs[7], cs[8]
+    rxg = cs[6, :, -1:] - lxg
+    rxh = cs[7, :, -1:] - lxh
+    rxxh = cs[8, :, -1:] - lxxh
+
+    lam = jnp.float32(linear_lambda)
+    lgain_f, _, _ = _linear_side(left_g_f, left_h_f,
+                                 lxg, lxh, lxxh, l2, lam)
+    rgain_f, _, _ = _linear_side(right_g_f, right_h_f,
+                                 rxg, rxh, rxxh, l2, lam)
+    lgain_r, _, _ = _linear_side(left_g_r, left_h_r,
+                                 lxg, lxh, lxxh, l2, lam)
+    rgain_r, _, _ = _linear_side(right_g_r, right_h_r,
+                                 rxg, rxh, rxxh, l2, lam)
+    gain_f = lgain_f + rgain_f
+    gain_r = lgain_r + rgain_r
+
+    # the leaf's OWN model: best whole-leaf single-feature fit over the
+    # moment totals (feature_mask-restricted, like the candidates — the
+    # sampled-out features stay invisible to this node).  Degenerate
+    # features (trivial/categorical rep rows are all-zero, or var<=0)
+    # fall back inside _linear_side to the constant model, so the
+    # argmax always yields a usable (coeff, const) pair.
+    sf_gain, sf_coeff, sf_const = _linear_side(
+        sum_g, sum_h_tot, cs[6, :, -1], cs[7, :, -1], cs[8, :, -1],
+        l2, lam)
+    sf_cand = sf_gain if feature_mask is None else \
+        jnp.where(feature_mask, sf_gain, jnp.float32(K_MIN_SCORE))
+    sf_j = jnp.argmax(sf_cand).astype(jnp.int32)
+    self_gain = sf_gain[sf_j]
+    self_coeff = sf_coeff[sf_j]
+    self_const = sf_const[sf_j]
+    self_feature = ctx.feature_index[sf_j]
+
+    # shift: the leaf's own model gain (see docstring) — a split must
+    # beat the model the leaf already predicts with
+    min_gain_shift = self_gain + min_gain_to_split
+    mdl = jnp.float32(min_data_in_leaf)
+
+    def common_valid(lc, rc, lh, rh):
+        return ((lc >= mdl) & (rc >= mdl) &
+                (lh >= min_sum_hessian) & (rh >= min_sum_hessian))
+
+    valid_f = (two_scan & in_range & (bins <= nb - 2) &
+               ~(is_zero_miss & (bins == dflt)) &
+               common_valid(left_c_f, right_c_f, left_h_f, right_h_f) &
+               (gain_f > min_gain_shift))
+    valid_r = (in_range & (bins <= bmax - 1) &
+               ~(two_scan & is_zero_miss & (bins == dflt - 1)) &
+               common_valid(left_c_r, right_c_r, left_h_r, right_h_r) &
+               (gain_r > min_gain_shift))
+    if feature_mask is not None:
+        valid_f &= feature_mask[:, None]
+        valid_r &= feature_mask[:, None]
+    if rand_bins is not None:
+        at_rand = bins == rand_bins[:, None]
+        valid_f &= at_rand
+        valid_r &= at_rand
+
+    neg = jnp.float32(K_MIN_SCORE)
+    cand_f = jnp.where(valid_f, gain_f, neg)
+    cand_r = jnp.where(valid_r, gain_r, neg)
+    gains = jnp.concatenate([cand_r[:, ::-1], cand_f], axis=1)
+    dl_r = jnp.broadcast_to((two_scan | ~is_nan_miss).astype(jnp.float32),
+                            (F, BF))
+    stats = jnp.stack([
+        jnp.concatenate([left_g_r[:, ::-1], left_g_f], axis=1),
+        jnp.concatenate([left_h_r[:, ::-1], left_h_f], axis=1),
+        jnp.concatenate([left_c_r[:, ::-1], left_c_f], axis=1),
+        jnp.concatenate([dl_r, jnp.zeros((F, BF), jnp.float32)], axis=1),
+    ]).reshape(4, F * 2 * BF)
+
+    flat = gains.reshape(F * 2 * BF)
+    widx = jnp.argmax(flat).astype(jnp.int32)
+    best_gain = flat[widx]
+    picked = jax.lax.dynamic_slice(stats, (0, widx), (4, 1))[:, 0]
+    lg, lh, lc_f32, dl = picked[0], picked[1], picked[2], picked[3]
+
+    per_f = 2 * BF
+    best_f = widx // per_f
+    r = widx - best_f * per_f
+    best_t = jnp.where(r < BF, BF - 1 - r, r - BF)
+
+    rg = sum_g - lg
+    rh = sum_h_tot - lh
+    rc = num_data - lc_f32
+    invalid = best_gain <= neg
+    return BestSplitLinear(
+        gain=jnp.where(invalid, neg, best_gain - min_gain_shift),
+        feature=best_f.astype(jnp.int32),
+        threshold=best_t.astype(jnp.int32),
+        default_left=dl > 0.5,
+        left_sum_g=lg, left_sum_h=lh - K_EPSILON,
+        right_sum_g=rg, right_sum_h=rh - K_EPSILON,
+        left_count=lc_f32.astype(jnp.int32),
+        right_count=rc.astype(jnp.int32),
+        left_output=leaf_output(lg, lh, 0.0, l2, 0.0),
+        right_output=leaf_output(rg, rh, 0.0, l2, 0.0),
+        is_cat=jnp.bool_(False),
+        cat_set=jnp.zeros((1,), jnp.bool_),
+        self_const=self_const, self_coeff=self_coeff,
+        self_feature=self_feature,
     )
 
 

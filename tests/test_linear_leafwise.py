@@ -1,0 +1,349 @@
+"""Leafwise-gain piece-wise-linear trees (linear_tree_mode=
+leafwise_gain): the in-search PL split gain must bit-match a dense
+NumPy normal-equations oracle on its discrete decisions, degenerate
+leaves must fall back to constant models, both linear modes must
+round-trip through save/load/pickle, and linear forests must serve
+through the device engine (one trace per (kind, bucket)) in agreement
+with the host oracle."""
+
+import pickle
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.ops import split as so
+
+K_EPS = so.K_EPSILON
+
+
+def _ctx(F, BF, rng):
+    num_bin = rng.randint(3, BF + 1, size=F).astype(np.int32)
+    missing = rng.randint(0, 3, size=F).astype(np.int32)
+    default_bin = np.where(missing == so.MISSING_ZERO,
+                           rng.randint(0, 3, size=F), 0).astype(np.int32)
+    return so.SplitContext(
+        num_bin=jnp.asarray(num_bin),
+        missing_type=jnp.asarray(missing),
+        default_bin=jnp.asarray(default_bin),
+        is_categorical=jnp.zeros(F, jnp.int32),
+        feature_index=jnp.arange(F, dtype=jnp.int32))
+
+
+def _lin_side(g, h, xg, xh, xxh, l2, lam):
+    """Float64 oracle of ops/split.py:_linear_side (centered ridge)."""
+    xm = xh / h
+    xgc = xg - xm * g
+    var = xxh - xm * xh
+    ok = var > 0.0
+    denom = np.where(ok, var + lam, 1.0)
+    coeff = np.where(ok, -xgc / denom, 0.0)
+    gain = g * g / (h + l2) + np.where(ok, xgc * xgc / denom, 0.0)
+    const = -g / (h + l2) - coeff * xm
+    return gain, coeff, const
+
+
+def _oracle(hist, rep, ctx, sum_g, sum_h, num_data, l2, mgts, mdl, msh,
+            lam, feature_mask):
+    """Dense NumPy normal-equations replay of find_best_split_linear:
+    same masks, same candidate order (reverse-reversed ++ forward),
+    same self-model shift, float64 accumulation."""
+    F, BF, _ = hist.shape
+    G = hist[..., 0].astype(np.float64)
+    H = hist[..., 1].astype(np.float64)
+    sum_h_tot = sum_h + 2 * K_EPS
+    cnt_factor = num_data / sum_h_tot
+    bins = np.arange(BF)[None, :]
+    nb = np.asarray(ctx.num_bin)[:, None]
+    in_range = bins < nb
+    missing = np.asarray(ctx.missing_type)[:, None]
+    dflt = np.asarray(ctx.default_bin)[:, None]
+    is_zero = missing == so.MISSING_ZERO
+    is_nan = missing == so.MISSING_NAN
+    two_scan = (nb > 2) & (missing != so.MISSING_NONE)
+    cnt_bin = np.floor(H * cnt_factor + 0.5) * in_range
+    mask_f = in_range & ~(is_zero & (bins == dflt))
+    bmax = nb - 1 - (is_nan & two_scan).astype(np.int64)
+    mask_r = (in_range & ~(two_scan & is_zero & (bins == dflt)) &
+              (bins <= bmax))
+
+    repm = np.where(in_range, rep.astype(np.float64), 0.0)
+    XG, XH = repm * G, repm * H
+    XXH = repm * XH
+    csf = lambda a, m: np.cumsum(np.where(m, a, 0.0), axis=1)  # noqa: E731
+    lgf, lhf, lcf = csf(G, mask_f), csf(H, mask_f) + K_EPS, \
+        csf(cnt_bin, mask_f)
+    lxg, lxh, lxxh = csf(XG, True), csf(XH, True), csf(XXH, True)
+    rxg, rxh, rxxh = (lxg[:, -1:] - lxg, lxh[:, -1:] - lxh,
+                      lxxh[:, -1:] - lxxh)
+    rgf, rhf, rcf = sum_g - lgf, sum_h_tot - lhf, num_data - lcf
+    gr, hr, cr = csf(G, mask_r), csf(H, mask_r), csf(cnt_bin, mask_r)
+    rgr, rhr, rcr = gr[:, -1:] - gr, hr[:, -1:] - hr + K_EPS, \
+        cr[:, -1:] - cr
+    lgr, lhr, lcr = sum_g - rgr, sum_h_tot - rhr, num_data - rcr
+
+    gain_f = (_lin_side(lgf, lhf, lxg, lxh, lxxh, l2, lam)[0] +
+              _lin_side(rgf, rhf, rxg, rxh, rxxh, l2, lam)[0])
+    gain_r = (_lin_side(lgr, lhr, lxg, lxh, lxxh, l2, lam)[0] +
+              _lin_side(rgr, rhr, rxg, rxh, rxxh, l2, lam)[0])
+
+    sf_gain, sf_coeff, sf_const = _lin_side(
+        sum_g, sum_h_tot, lxg[:, -1], lxh[:, -1], lxxh[:, -1], l2, lam)
+    cand = sf_gain if feature_mask is None else \
+        np.where(feature_mask, sf_gain, -np.inf)
+    sf_j = int(np.argmax(cand))
+    shift = sf_gain[sf_j] + mgts
+
+    ok = lambda lc, rc, lh, rh: ((lc >= mdl) & (rc >= mdl) &  # noqa: E731
+                                 (lh >= msh) & (rh >= msh))
+    valid_f = (two_scan & in_range & (bins <= nb - 2) &
+               ~(is_zero & (bins == dflt)) &
+               ok(lcf, rcf, lhf, rhf) & (gain_f > shift))
+    valid_r = (in_range & (bins <= bmax - 1) &
+               ~(two_scan & is_zero & (bins == dflt - 1)) &
+               ok(lcr, rcr, lhr, rhr) & (gain_r > shift))
+    if feature_mask is not None:
+        valid_f &= feature_mask[:, None]
+        valid_r &= feature_mask[:, None]
+    cf = np.where(valid_f, gain_f, -np.inf)
+    crev = np.where(valid_r, gain_r, -np.inf)
+    gains = np.concatenate([crev[:, ::-1], cf], axis=1).ravel()
+    w = int(np.argmax(gains))
+    f, r = w // (2 * BF), w % (2 * BF)
+    t = BF - 1 - r if r < BF else r - BF
+    dl = bool((two_scan | ~is_nan)[f, 0]) if r < BF else False
+    return {"valid": gains[w] > -np.inf, "gain": gains[w] - shift,
+            "feature": f, "threshold": t, "default_left": dl,
+            "self_feature": sf_j, "self_coeff": sf_coeff[sf_j],
+            "self_const": sf_const[sf_j]}
+
+
+# The matrix rides through the histogram contents: bagging zeroes
+# sampled-out mass, GOSS amplifies small-gradient hessian weight,
+# quantized snaps gradients to an int grid, multiclass shrinks
+# hessians to p(1-p) scale.  The search only ever sees (G, H) planes,
+# so shaping them IS exercising those configs at the decision level.
+def _hist_for(scenario, F, BF, nb, rng):
+    hist = np.zeros((F, BF, 2), np.float32)
+    for f in range(F):
+        n = nb[f]
+        g = rng.normal(size=n)
+        h = rng.uniform(0.5, 1.5, size=n)
+        if scenario == "bagging":
+            keep = rng.rand(n) > 0.4
+            g, h = g * keep, h * keep
+        elif scenario == "goss":
+            amp = np.where(np.abs(g) < 0.5, 5.0, 1.0)
+            g, h = g * amp, h * amp
+        elif scenario == "quantized":
+            g = np.round(g * 8) / 8
+        elif scenario == "multiclass":
+            p = rng.uniform(0.05, 0.95, size=n)
+            g, h = p - (rng.rand(n) < p), np.maximum(p * (1 - p), 1e-3)
+        hist[f, :n, 0] = g
+        hist[f, :n, 1] = h
+    return hist
+
+
+@pytest.mark.parametrize("scenario", ["plain", "bagging", "goss",
+                                      "quantized", "multiclass"])
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_leafwise_matches_numpy_oracle(scenario, seed):
+    scen_id = ["plain", "bagging", "goss", "quantized",
+               "multiclass"].index(scenario)
+    rng = np.random.RandomState(100 * seed + 7 * scen_id)
+    F, BF = 6, 31
+    ctx = _ctx(F, BF, rng)
+    nb = np.asarray(ctx.num_bin)
+    hist = _hist_for(scenario, F, BF, nb, rng)
+    # rep values: 0 at the NaN bin and the MISSING_ZERO default bin
+    # (the contract rep tables honour — moment mass of missing rows
+    # must vanish in both scan directions)
+    rep = rng.uniform(-2.0, 2.0, size=(F, BF)).astype(np.float32)
+    missing = np.asarray(ctx.missing_type)
+    dflt = np.asarray(ctx.default_bin)
+    for f in range(F):
+        if missing[f] == so.MISSING_NAN:
+            rep[f, nb[f] - 1] = 0.0
+        if missing[f] == so.MISSING_ZERO:
+            rep[f, dflt[f]] = 0.0
+        rep[f, nb[f]:] = 0.0
+    sum_g = float(hist[0, :, 0].sum())
+    sum_h = float(hist[0, :, 1].sum())
+    num_data = 900.0
+    l2, mgts, mdl, msh, lam = 1e-3, 0.0, 3, 1e-3, 1e-2
+    mask = (rng.rand(F) > 0.25) if seed % 2 else None
+
+    got = so.find_best_split_linear(
+        jnp.asarray(hist), ctx, jnp.float32(sum_g), jnp.float32(sum_h),
+        jnp.int32(num_data), l2, mgts, mdl, msh,
+        jnp.asarray(rep), lam,
+        feature_mask=None if mask is None else jnp.asarray(mask))
+    want = _oracle(hist, rep, ctx, sum_g, sum_h, num_data, l2, mgts,
+                   mdl, msh, lam, mask)
+
+    if not want["valid"]:
+        assert float(got.gain) == -np.inf
+        return
+    # discrete decisions are exact; float stats carry the f32-vs-f64
+    # accumulation noise of the prefix sums
+    for name in ("feature", "threshold", "default_left", "self_feature"):
+        assert int(np.asarray(getattr(got, name))) == int(want[name]), \
+            (scenario, seed, name)
+    np.testing.assert_allclose(float(got.gain), want["gain"],
+                               rtol=3e-4, atol=3e-4)
+    np.testing.assert_allclose(float(got.self_coeff), want["self_coeff"],
+                               rtol=3e-4, atol=3e-4)
+    np.testing.assert_allclose(float(got.self_const), want["self_const"],
+                               rtol=3e-4, atol=3e-4)
+
+
+def _smooth(n=1500, f=5, seed=0, nan_col=None, const_col=None):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    if const_col is not None:
+        X[:, const_col] = 1.5
+    if nan_col is not None:
+        X[rng.rand(n) < 0.9, nan_col] = np.nan
+    y = (2.0 * X[:, 0] + np.sin(2.0 * X[:, 1])
+         + 0.1 * rng.normal(size=n)).astype(np.float32)
+    return X, y
+
+
+BASE = {"objective": "regression", "num_leaves": 15, "verbosity": -1,
+        "learning_rate": 0.2, "min_data_in_leaf": 20}
+LEAFWISE = {**BASE, "linear_tree": True,
+            "linear_tree_mode": "leafwise_gain"}
+
+
+# Regression for the _fit_linear_leaves degenerate-leaf bug: a leaf
+# whose candidate features are constant (or NaN-saturated) used to feed
+# a singular normal-equations solve; it must drop the degenerate
+# columns / ridge the diagonal and fall back to the constant output.
+@pytest.mark.parametrize("mode", ["refit", "leafwise_gain"])
+def test_degenerate_leaves_fall_back_to_constant(mode):
+    X, y = _smooth(seed=3, nan_col=2, const_col=3)
+    p = {**BASE, "linear_tree": True, "linear_tree_mode": mode}
+    bst = lgb.train(p, lgb.Dataset(X, label=y), 8)
+    pred = bst.predict(X)
+    assert np.isfinite(pred).all()
+    # degenerate columns must never be fitted with a slope
+    for t in bst._gbdt.models:
+        for fs, cs in zip(t.leaf_features or [], t.leaf_coeff or []):
+            for f, c in zip(fs, cs):
+                assert f != 3, "constant column fitted with a slope"
+                assert np.isfinite(c)
+    mse_c = np.mean((y - lgb.train(BASE, lgb.Dataset(X, label=y), 8)
+                     .predict(X)) ** 2)
+    assert np.mean((y - pred) ** 2) < mse_c * 1.05
+
+
+@pytest.mark.parametrize("mode", ["refit", "leafwise_gain"])
+def test_linear_save_load_pickle_bit_parity(mode, tmp_path):
+    X, y = _smooth(seed=5)
+    p = {**BASE, "linear_tree": True, "linear_tree_mode": mode}
+    bst = lgb.train(p, lgb.Dataset(X, label=y), 10)
+    ref = bst.predict(X, raw_score=True)
+    # pickle: bit parity (same packs, same kernels)
+    clone = pickle.loads(pickle.dumps(bst))
+    np.testing.assert_array_equal(clone.predict(X, raw_score=True), ref)
+    # save/load: the text round-trip re-serves from the host oracle
+    f = tmp_path / "m.txt"
+    bst.save_model(str(f))
+    loaded = lgb.Booster(model_file=str(f))
+    np.testing.assert_allclose(loaded.predict(X, raw_score=True), ref,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_leafwise_device_engine_matches_host_oracle(tmp_path):
+    """In-session serving of a leafwise-gain forest runs on the device
+    engine; the text-round-tripped booster serves the same trees from
+    the host linear oracle.  They must agree — including NaN fallback
+    rows and start/num_iteration slicing."""
+    X, y = _smooth(n=5000, seed=7)
+    bst = lgb.train(LEAFWISE, lgb.Dataset(X, label=y), 12)
+    assert any(t.is_linear for t in bst._gbdt.models)
+    eng = bst._gbdt.serving
+    bst.predict(X, raw_score=True)      # past the cold-batch gate
+
+    Xq = X[:800].copy()
+    Xq[::7, 0] = np.nan          # NaN in a fitted feature -> fallback
+    Xq[::11, 1] = np.nan
+    pred = bst.predict(Xq, raw_score=True)
+    assert eng._warm("insession"), "linear forest must serve on-device"
+
+    f = tmp_path / "m.txt"
+    bst.save_model(str(f))
+    loaded = lgb.Booster(model_file=str(f))
+    np.testing.assert_allclose(pred, loaded.predict(Xq, raw_score=True),
+                               rtol=1e-5, atol=1e-5)
+    for kw in ({"num_iteration": 5}, {"start_iteration": 4},
+               {"start_iteration": 2, "num_iteration": 6}):
+        np.testing.assert_allclose(
+            bst.predict(Xq, raw_score=True, **kw),
+            loaded.predict(Xq, raw_score=True, **kw),
+            rtol=1e-5, atol=1e-5, err_msg=str(kw))
+
+
+def test_leafwise_serving_one_trace_per_bucket():
+    X, y = _smooth(n=5000, seed=9)
+    bst = lgb.train(LEAFWISE, lgb.Dataset(X, label=y), 10)
+    eng = bst._gbdt.serving
+    snap = eng.trace_snapshot()
+    for _ in range(3):
+        bst.predict(X, raw_score=True)       # same bucket every time
+    assert eng._warm("insession")
+    new = eng.new_traces_since(snap)
+    raw = {k: v for k, v in new.items() if k[0] == "raw"}
+    assert raw and all(v == 1 for v in raw.values()), new
+    # slicing re-traces at most once per distinct range
+    snap = eng.trace_snapshot()
+    bst.predict(X, raw_score=True, num_iteration=5)
+    bst.predict(X, raw_score=True, num_iteration=5)
+    new = eng.new_traces_since(snap)
+    assert all(v == 1 for v in new.values()), new
+
+
+def test_leafwise_falls_back_on_categorical():
+    """Categorical features leave the fast-search envelope: leafwise
+    mode must warn and train as refit, not crash."""
+    from lightgbm_tpu.utils import log
+
+    rng = np.random.RandomState(2)
+    n = 800
+    Xc = rng.randint(0, 5, size=n).astype(np.float32)
+    X = np.column_stack([rng.normal(size=n).astype(np.float32), Xc])
+    y = (X[:, 0] * 2 + (Xc == 2) + 0.1 * rng.normal(size=n)
+         ).astype(np.float32)
+    lines = []
+    old_verbosity = log.get_verbosity()
+    log.register_callback(lines.append)
+    try:
+        bst = lgb.train({**LEAFWISE, "verbosity": 0,
+                         "categorical_feature": [1]},
+                        lgb.Dataset(X, label=y), 5)
+    finally:
+        log.register_callback(None)
+        log.set_verbosity(old_verbosity)
+    assert any("falling back" in ln for ln in lines), lines
+    assert np.isfinite(bst.predict(X)).all()
+
+
+def test_leafwise_multiclass_and_bagging_smoke():
+    """Training-level matrix ride-along: multiclass + bagging + GOSS
+    configs stay eligible (no fallback warning) and out-predict
+    constant trees on the smooth target."""
+    X, y = _smooth(n=2500, seed=13)
+    for extra in ({"bagging_fraction": 0.7, "bagging_freq": 1},
+                  {"boosting": "goss"}):
+        bst = lgb.train({**LEAFWISE, **extra},
+                        lgb.Dataset(X, label=y), 15)
+        assert any(t.is_linear for t in bst._gbdt.models), extra
+        assert np.isfinite(bst.predict(X)).all(), extra
+    yc = (X[:, 0] > 0).astype(np.float32) + (X[:, 1] > 0)
+    bst = lgb.train({**LEAFWISE, "objective": "multiclass",
+                     "num_class": 3}, lgb.Dataset(X, label=yc), 8)
+    p = bst.predict(X)
+    assert p.shape == (len(X), 3) and np.isfinite(p).all()
+    np.testing.assert_allclose(p.sum(axis=1), 1.0, rtol=1e-5)

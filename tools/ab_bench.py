@@ -530,6 +530,121 @@ def _chunk_smoke(args, guard):
         raise SystemExit("--chunk: " + "; ".join(problems))
 
 
+def _linear_smoke(args, guard):
+    """Piece-wise-linear trees A/B (`--linear`): constant leaves vs
+    linear_tree refit vs linear_tree_mode=leafwise_gain (the in-search
+    PL split gain) on a smooth synthetic, reporting per-arm wall clock
+    and TREES-TO-TARGET-RMSE — the headline is how many fewer trees the
+    linear arms need to reach the constant arm's final validation RMSE.
+    Exits non-zero when the leafwise arm saves fewer than
+    ``--linear-min-tree-save`` %% of the trees, or when it REGRESSES
+    the constant arm's final accuracy (the PL gain must never lose to
+    the model it generalizes)."""
+    import time
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.obs import benchio
+
+    rng = np.random.RandomState(11)
+    n, f = args.linear_rows, args.linear_features
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    # smooth target: one dominant linear direction + a nonlinearity in
+    # a second feature — the regime linear_tree docs target and where
+    # single-feature leaf models shine (with leafwise_gain the search
+    # spends its splits on the sine because the leaf self-models
+    # already carry the x0 ramp; constant trees must staircase it)
+    y = (3.0 * X[:, 0] + np.sin(2.0 * X[:, 1])
+         + 0.1 * rng.normal(size=n)).astype(np.float32)
+    cut = int(n * 0.75)
+    Xtr, Xva, ytr, yva = X[:cut], X[cut:], y[:cut], y[cut:]
+    arms = {
+        "constant": {},
+        "refit": {"linear_tree": True, "linear_tree_mode": "refit"},
+        "leafwise_gain": {"linear_tree": True,
+                          "linear_tree_mode": "leafwise_gain"},
+    }
+    out = {}
+    for name, extra in arms.items():
+        p = {"objective": "regression", "metric": "rmse",
+             "num_leaves": args.linear_leaves, "learning_rate": 0.1,
+             "verbosity": -1, **extra}
+        ds = lgb.Dataset(Xtr, label=ytr)
+        vds = lgb.Dataset(Xva, label=yva, reference=ds)
+        hist = {}
+        t0 = time.perf_counter()
+        lgb.train(p, ds, num_boost_round=args.linear_iters,
+                  valid_sets=[vds], valid_names=["va"],
+                  callbacks=[lgb.record_evaluation(hist)])
+        wall = time.perf_counter() - t0
+        curve = [float(v) for v in hist["va"]["rmse"]]
+        out[name] = {"wall_s": round(wall, 3),
+                     "final_rmse": round(curve[-1], 6),
+                     "curve": [round(v, 6) for v in curve]}
+
+    target = out["constant"]["final_rmse"]
+
+    def trees_to(curve):
+        for i, v in enumerate(curve):
+            if v <= target:
+                return i + 1
+        return None
+
+    report = {"linear_mode": True, "rows": n, "features": f,
+              "leaves": args.linear_leaves, "iters": args.linear_iters,
+              "target_rmse": target}
+    for name in arms:
+        t = trees_to(out[name]["curve"])
+        out[name]["trees_to_target"] = t
+        report[name] = {k: out[name][k] for k in
+                        ("wall_s", "final_rmse", "trees_to_target")}
+    lw = out["leafwise_gain"]["trees_to_target"]
+    save_pct = (None if lw is None else
+                round(100.0 * (1.0 - lw / args.linear_iters), 1))
+    report["leafwise_tree_save_pct"] = save_pct
+    print(json.dumps(report))
+    _write_obs(guard, args, "ab_bench.linear",
+               {"rows": n, "features": f, "leaves": args.linear_leaves,
+                "iters": args.linear_iters},
+               report,
+               metrics={
+                   "constant_wall_s": out["constant"]["wall_s"],
+                   "refit_wall_s": out["refit"]["wall_s"],
+                   "leafwise_wall_s": out["leafwise_gain"]["wall_s"],
+                   "leafwise_final_rmse":
+                       out["leafwise_gain"]["final_rmse"],
+                   "leafwise_trees_to_target": float(lw or -1),
+               },
+               rows=n,
+               fingerprint_extra={"lane": "linear",
+                                  "linear_leaves": args.linear_leaves,
+                                  "linear_iters": args.linear_iters})
+    problems = []
+    if lw is None:
+        problems.append("leafwise_gain never reached the constant "
+                        "arm's final RMSE")
+    elif save_pct < args.linear_min_tree_save:
+        problems.append(
+            f"leafwise_gain needed {lw}/{args.linear_iters} trees "
+            f"({save_pct}% saved) — under the "
+            f"{args.linear_min_tree_save}% tree-save bar")
+    if (out["leafwise_gain"]["final_rmse"]
+            > out["constant"]["final_rmse"] * 1.001):
+        problems.append(
+            "accuracy regression: leafwise_gain final RMSE "
+            f"{out['leafwise_gain']['final_rmse']} vs constant "
+            f"{out['constant']['final_rmse']}")
+    obs_path = args.obs_out or benchio.default_path()
+    try:
+        with open(obs_path) as fh:
+            doc = json.load(fh)
+        problems += [f"BENCH_obs: {p}"
+                     for p in benchio.validate_bench_obs(doc)]
+    except (OSError, ValueError) as exc:
+        problems.append(f"BENCH_obs unreadable: {exc}")
+    if problems:
+        raise SystemExit("--linear: " + "; ".join(problems))
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--rows", type=int, default=1_000_000)
@@ -610,6 +725,28 @@ def main(argv=None):
     ap.add_argument("--chunk-min-x", type=float, default=None,
                     help="--chunk: minimum small-leaf speedup to assert "
                     "(exit non-zero below it; default: report only)")
+    ap.add_argument("--linear", action="store_true",
+                    help="piece-wise-linear tree A/B: constant leaves "
+                    "vs linear_tree refit vs "
+                    "linear_tree_mode=leafwise_gain on a smooth "
+                    "synthetic; reports per-arm wall clock and "
+                    "trees-to-target-RMSE, exiting non-zero when the "
+                    "leafwise arm saves fewer than "
+                    "--linear-min-tree-save %% of the trees or "
+                    "regresses the constant arm's accuracy")
+    ap.add_argument("--linear-rows", type=int, default=24_000,
+                    help="--linear: dataset rows")
+    ap.add_argument("--linear-features", type=int, default=8,
+                    help="--linear: dataset features")
+    ap.add_argument("--linear-leaves", type=int, default=31,
+                    help="--linear: num_leaves for all arms")
+    ap.add_argument("--linear-iters", type=int, default=120,
+                    help="--linear: boosting rounds per arm (also the "
+                    "trees-to-target denominator)")
+    ap.add_argument("--linear-min-tree-save", type=float, default=25.0,
+                    help="--linear: minimum %% of trees the leafwise "
+                    "arm must save vs the full budget to reach the "
+                    "constant arm's final RMSE")
     ap.add_argument("--obs-out", default=None, metavar="PATH",
                     help="BENCH_obs.json artifact path (default: "
                     "$BENCH_OBS_PATH or ./BENCH_obs.json)")
@@ -625,7 +762,8 @@ def main(argv=None):
     mode = ("ab_bench.fault" if args.fault else
             "ab_bench.drift" if args.drift else
             "ab_bench.frontier" if args.frontier else
-            "ab_bench.chunk" if args.chunk else "ab_bench")
+            "ab_bench.chunk" if args.chunk else
+            "ab_bench.linear" if args.linear else "ab_bench")
     # export-on-failure: a lane that dies mid-measurement still leaves
     # an aborted BENCH_obs artifact + trajectory entry; lanes that
     # wrote their artifact and THEN failed an assertion keep the real
@@ -646,6 +784,9 @@ def main(argv=None):
             return
         if args.chunk:
             _chunk_smoke(args, guard)
+            return
+        if args.linear:
+            _linear_smoke(args, guard)
             return
         _ab_body(args, guard)
 
